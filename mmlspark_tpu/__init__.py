@@ -1,0 +1,20 @@
+"""mmlspark_tpu — a TPU-native ML framework with the capabilities of
+eisber/mmlspark (pipeline-composable estimators/transformers, distributed
+histogram-GBDT, a jit-compiled deep-model runner/trainer, image pipelines,
+auto-featurization, hyperparameter tuning, evaluation, interpretation, a SAR
+recommender, HTTP integration, and low-latency serving) built on
+JAX / XLA / Pallas / jax.sharding."""
+
+__version__ = "0.1.0"
+
+from . import core, parallel
+from .core import (
+    Table,
+    Pipeline,
+    PipelineModel,
+    Transformer,
+    Estimator,
+    Model,
+    Param,
+    Params,
+)

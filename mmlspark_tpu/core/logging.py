@@ -1,0 +1,39 @@
+"""Logging helpers.
+
+Reference: `core/env/src/main/scala/Logging.scala:14-23` (log4j logger with
+config-derived root). TPU-first: std-lib logging under root "mmlspark_tpu",
+level from config key `log.level` (env MMLSPARK_TPU_LOG__LEVEL).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from .config import get_config
+
+__all__ = ["get_logger"]
+
+_ROOT = "mmlspark_tpu"
+_configured = False
+
+
+def _configure() -> None:
+    global _configured
+    if _configured:
+        return
+    logger = logging.getLogger(_ROOT)
+    if not logger.handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
+        )
+        logger.addHandler(handler)
+    level = str(get_config("log.level", "WARNING")).upper()
+    logger.setLevel(getattr(logging, level, logging.WARNING))
+    logger.propagate = False
+    _configured = True
+
+
+def get_logger(name: str | None = None) -> logging.Logger:
+    _configure()
+    return logging.getLogger(f"{_ROOT}.{name}" if name else _ROOT)

@@ -1,0 +1,12 @@
+from .mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    initialize_runtime,
+    get_mesh,
+    set_default_mesh,
+    make_mesh,
+    data_sharding,
+    replicated_sharding,
+    shard_rows,
+    local_device_count,
+)

@@ -1,0 +1,135 @@
+"""Runtime bootstrap: the single distributed-communication backend.
+
+The reference has THREE comm backends (SURVEY.md §5.8): LightGBM's C++ TCP
+ring bootstrapped by a hand-rolled driver-socket rendezvous
+(`LightGBMUtils.scala:97-136`, `TrainUtils.scala:152-224`), `mpirun` over ssh
+for CNTK (`CommandBuilders.scala:102-147`), and Spark broadcast/shuffle.
+
+TPU-first: ONE backend. `jax.distributed.initialize` is the host rendezvous
+(replacing driver sockets and ssh/MPI); a `jax.sharding.Mesh` over all
+devices carries every collective (`psum`/`all_gather`/`reduce_scatter`
+compiled onto ICI within a slice, DCN across slices). No ports, no node
+lists, no NativeLoader.
+
+Mesh axes (reserved up front so models can shard later without API change —
+SURVEY.md §2.2 last row):
+  - "data"  : batch/data parallelism (the only axis needed for reference parity)
+  - "model" : tensor/model parallelism (size 1 by default)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "initialize_runtime",
+    "get_mesh",
+    "set_default_mesh",
+    "make_mesh",
+    "data_sharding",
+    "replicated_sharding",
+    "shard_rows",
+    "local_device_count",
+]
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+_lock = threading.Lock()
+_default_mesh: Mesh | None = None
+_initialized = False
+
+
+def initialize_runtime(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Multi-host rendezvous. Single-process (the common test/bench case) is a
+    no-op; multi-host wires `jax.distributed.initialize`, after which
+    `jax.devices()` spans all hosts and collectives ride ICI/DCN."""
+    global _initialized
+    with _lock:
+        addr = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
+        if addr is None:
+            return  # single-process: nothing to do (and nothing to latch)
+        if _initialized:
+            return
+        jax.distributed.initialize(
+            coordinator_address=addr,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        _initialized = True
+
+
+def make_mesh(
+    n_data: int | None = None,
+    n_model: int = 1,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a (data, model) mesh over the given (default: all) devices."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if n_data is None:
+        n_data = len(devs) // n_model
+    if n_data * n_model > len(devs):
+        raise ValueError(
+            f"mesh {n_data}x{n_model} needs {n_data * n_model} devices, have {len(devs)}"
+        )
+    grid = np.asarray(devs[: n_data * n_model]).reshape(n_data, n_model)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def get_mesh() -> Mesh:
+    """The process-default mesh (created lazily over all devices)."""
+    global _default_mesh
+    with _lock:
+        if _default_mesh is None:
+            _default_mesh = make_mesh()
+        return _default_mesh
+
+
+def set_default_mesh(mesh: Mesh | None) -> None:
+    global _default_mesh
+    with _lock:
+        _default_mesh = mesh
+
+
+def data_sharding(mesh: Mesh | None = None, *trailing_axes: str | None) -> NamedSharding:
+    """Sharding that splits the leading (row/batch) axis over the data axis."""
+    mesh = mesh or get_mesh()
+    return NamedSharding(mesh, P(DATA_AXIS, *trailing_axes))
+
+
+def replicated_sharding(mesh: Mesh | None = None) -> NamedSharding:
+    mesh = mesh or get_mesh()
+    return NamedSharding(mesh, P())
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def shard_rows(array, mesh: Mesh | None = None, pad_value=0):
+    """Put a host array on device, row-sharded over the data axis. Pads the
+    leading dim up to a multiple of the data-axis size (XLA needs static,
+    divisible shapes) and returns (device_array, original_n_rows)."""
+    mesh = mesh or get_mesh()
+    arr = np.asarray(array)
+    n = arr.shape[0]
+    d = mesh.shape[DATA_AXIS]
+    padded = ((n + d - 1) // d) * d
+    if padded != n:
+        pad_width = [(0, padded - n)] + [(0, 0)] * (arr.ndim - 1)
+        arr = np.pad(arr, pad_width, constant_values=pad_value)
+    sharded = jax.device_put(arr, data_sharding(mesh, *([None] * (arr.ndim - 1))))
+    return sharded, n

@@ -1,0 +1,1 @@
+from .async_utils import buffered_map, buffered_map_safe, retry_with_backoff, RetryError

@@ -1,0 +1,41 @@
+"""Test session bootstrap.
+
+Role of the reference's TestBase + SparkSessionFactory (`core/test/base/
+TestBase.scala:42-206`): one shared local session for all suites. Here the
+"local[*] session" analogue is the CPU XLA backend with 8 virtual devices, so
+multi-chip sharding logic (mesh + collectives) runs inside one process —
+matching how the reference simulates multi-node with partitions-in-one-JVM.
+
+Must set env BEFORE jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # tests always run on the CPU backend
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# The environment may pre-register a TPU PJRT plugin via sitecustomize and
+# pin jax_platforms before this file runs; backends are lazy, so overriding
+# the config here still wins as long as no test touched a device yet.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from mmlspark_tpu.parallel import make_mesh
+
+    return make_mesh(n_data=8)
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(42)
