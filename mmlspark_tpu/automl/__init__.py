@@ -1,0 +1,7 @@
+from .metrics import (
+    MetricConstants,
+    ComputeModelStatistics,
+    ComputePerInstanceStatistics,
+    roc_curve,
+    auc,
+)

@@ -1,0 +1,217 @@
+"""Model evaluation metrics.
+
+Reference: `src/compute-model-statistics/ComputeModelStatistics.scala:57-467`
+(classification confusion-matrix / micro-macro metrics, binary ROC/AUC,
+regression mse/rmse/r2/mae; rocCurve DataFrame at :89),
+`src/compute-per-instance-statistics/ComputePerInstanceStatistics.scala:42+`,
+metric names from `core/metrics/MetricConstants.scala:7-60`.
+
+TPU-first: metrics are jit-compiled JAX reductions over device arrays —
+one fused pass per metric family, no per-row JVM loops.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.params import Param
+from ..core.pipeline import Transformer
+from ..core.schema import SCORE_KIND, Table
+from ..core.serialize import register_stage
+
+__all__ = [
+    "MetricConstants",
+    "ComputeModelStatistics",
+    "ComputePerInstanceStatistics",
+    "roc_curve",
+    "auc",
+]
+
+
+class MetricConstants:
+    """Reference: core/metrics/MetricConstants.scala:7-60."""
+
+    MSE = "mean_squared_error"
+    RMSE = "root_mean_squared_error"
+    R2 = "R^2"
+    MAE = "mean_absolute_error"
+    AUC = "AUC"
+    ACCURACY = "accuracy"
+    PRECISION = "precision"
+    RECALL = "recall"
+    ALL = "all"
+
+    CLASSIFICATION_METRICS = [AUC, ACCURACY, PRECISION, RECALL]
+    REGRESSION_METRICS = [MSE, RMSE, R2, MAE]
+
+
+@partial(jax.jit, static_argnames=("num_classes",))
+def _confusion_matrix(labels, preds, num_classes: int):
+    idx = labels.astype(jnp.int32) * num_classes + preds.astype(jnp.int32)
+    counts = jnp.zeros(num_classes * num_classes, jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
+    counts = counts.at[idx].add(1.0)
+    return counts.reshape(num_classes, num_classes)
+
+
+@jax.jit
+def _regression_metrics(labels, preds):
+    err = preds - labels
+    mse = jnp.mean(err * err)
+    mae = jnp.mean(jnp.abs(err))
+    ss_res = jnp.sum(err * err)
+    ss_tot = jnp.sum((labels - jnp.mean(labels)) ** 2)
+    r2 = 1.0 - ss_res / jnp.where(ss_tot == 0, 1.0, ss_tot)
+    return mse, jnp.sqrt(mse), r2, mae
+
+
+def roc_curve(labels: np.ndarray, scores: np.ndarray) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(fpr, tpr, thresholds), computed by a sort + cumulative sums (a scan,
+    not a per-threshold loop). Reference rocCurve ComputeModelStatistics.scala:89."""
+    labels = np.asarray(labels, np.float64)
+    scores = np.asarray(scores, np.float64)
+    order = np.argsort(-scores, kind="stable")
+    y = labels[order]
+    s = scores[order]
+    tps = np.cumsum(y)
+    fps = np.cumsum(1.0 - y)
+    # keep last index of each distinct threshold
+    distinct = np.r_[np.nonzero(np.diff(s))[0], y.size - 1]
+    tps, fps, thr = tps[distinct], fps[distinct], s[distinct]
+    p = labels.sum()
+    n = labels.size - p
+    tpr = np.r_[0.0, tps / max(p, 1.0)]
+    fpr = np.r_[0.0, fps / max(n, 1.0)]
+    return fpr, tpr, np.r_[np.inf, thr]
+
+
+_trapezoid = getattr(np, "trapezoid", None) or np.trapz  # numpy<2 fallback
+
+
+def auc(labels: np.ndarray, scores: np.ndarray) -> float:
+    fpr, tpr, _ = roc_curve(labels, scores)
+    return float(_trapezoid(tpr, fpr))
+
+
+@register_stage
+class ComputeModelStatistics(Transformer):
+    """Emit a one-row metrics table for a scored dataset."""
+
+    label_col = Param("label", "true-label column", ptype=str)
+    scores_col = Param(None, "raw score / probability column (binary)", ptype=str)
+    scored_labels_col = Param("scored_labels", "predicted-label column", ptype=str)
+    evaluation_metric = Param("all", "classification | regression | all | <metric>", ptype=str)
+
+    # most recent confusion matrix (reference keeps it as a side output)
+    confusion_matrix: np.ndarray | None = None
+
+    def _transform(self, table: Table) -> Table:
+        labels = np.asarray(table[self.get("label_col")], np.float64)
+        metric = self.get("evaluation_metric")
+        is_classification = self._infer_is_classification(table, labels, metric)
+        if is_classification:
+            return self._classification(table, labels)
+        return self._regression(table, labels)
+
+    def _infer_is_classification(self, table: Table, labels: np.ndarray, metric: str) -> bool:
+        if metric in MetricConstants.CLASSIFICATION_METRICS + ["classification"]:
+            return True
+        if metric in MetricConstants.REGRESSION_METRICS + ["regression"]:
+            return False
+        kind = table.meta(self.get("scored_labels_col")).get(SCORE_KIND)
+        if kind:
+            return kind == "classification"
+        # all integral labels with few distinct values -> classification
+        return bool(
+            np.all(labels == np.round(labels)) and np.unique(labels).size <= 100
+        )
+
+    def _classification(self, table: Table, labels: np.ndarray) -> Table:
+        preds = np.asarray(table[self.get("scored_labels_col")], np.float64)
+        # remap arbitrary label values (negative, sparse, large) to dense ids
+        classes, remapped = np.unique(np.concatenate([labels, preds]), return_inverse=True)
+        num_classes = int(classes.size) if classes.size else 1
+        lab_ids = remapped[: labels.size]
+        pred_ids = remapped[labels.size :]
+        cm = np.asarray(
+            _confusion_matrix(jnp.asarray(lab_ids), jnp.asarray(pred_ids), num_classes)
+        )
+        self.confusion_matrix = cm
+        total = cm.sum()
+        tp_per_class = np.diag(cm)
+        accuracy = tp_per_class.sum() / max(total, 1.0)
+        # micro precision == micro recall == accuracy for single-label
+        with np.errstate(divide="ignore", invalid="ignore"):
+            prec_c = np.where(cm.sum(0) > 0, tp_per_class / cm.sum(0), 0.0)
+            rec_c = np.where(cm.sum(1) > 0, tp_per_class / cm.sum(1), 0.0)
+        row: dict[str, Any] = {
+            MetricConstants.ACCURACY: float(accuracy),
+            "macro_precision": float(prec_c.mean()),
+            "macro_recall": float(rec_c.mean()),
+        }
+        if num_classes == 2:
+            row[MetricConstants.PRECISION] = float(prec_c[1])
+            row[MetricConstants.RECALL] = float(rec_c[1])
+        scores_col = self.get("scores_col")
+        if scores_col and scores_col in table and num_classes == 2:
+            scores = np.asarray(table[scores_col], np.float64)
+            if scores.ndim == 2:
+                scores = scores[:, -1]
+            # positive class = larger label value = class id 1 after remap
+            row[MetricConstants.AUC] = auc(lab_ids.astype(np.float64), scores)
+        return Table.from_rows([row])
+
+    def _regression(self, table: Table, labels: np.ndarray) -> Table:
+        pred_col = self.get("scores_col") or self.get("scored_labels_col")
+        preds = np.asarray(table[pred_col], np.float64)
+        mse, rmse, r2, mae = (
+            float(x) for x in _regression_metrics(jnp.asarray(labels), jnp.asarray(preds))
+        )
+        return Table.from_rows(
+            [
+                {
+                    MetricConstants.MSE: mse,
+                    MetricConstants.RMSE: rmse,
+                    MetricConstants.R2: r2,
+                    MetricConstants.MAE: mae,
+                }
+            ]
+        )
+
+
+@register_stage
+class ComputePerInstanceStatistics(Transformer):
+    """Per-row metrics: L1/L2 loss for regression, log-loss for
+    classification. Reference ComputePerInstanceStatistics.scala:42+."""
+
+    label_col = Param("label", "true-label column", ptype=str)
+    scores_col = Param(None, "probability column (classification)", ptype=str)
+    scored_labels_col = Param("scored_labels", "prediction column", ptype=str)
+    evaluation_metric = Param("all", "classification | regression | all", ptype=str)
+
+    def _transform(self, table: Table) -> Table:
+        labels = np.asarray(table[self.get("label_col")], np.float64)
+        scores_col = self.get("scores_col")
+        if self.get("evaluation_metric") == "classification" and not (
+            scores_col and scores_col in table
+        ):
+            raise ValueError(
+                "ComputePerInstanceStatistics: classification mode requires "
+                "scores_col pointing at a probability column"
+            )
+        if scores_col and scores_col in table:
+            probs = np.asarray(table[scores_col], np.float64)
+            if probs.ndim == 1:  # binary: p(class 1)
+                probs = np.stack([1.0 - probs, probs], axis=1)
+            idx = labels.astype(np.int64)
+            p_true = np.clip(probs[np.arange(labels.size), idx], 1e-15, 1.0)
+            return table.with_column("log_loss", -np.log(p_true))
+        preds = np.asarray(table[self.get("scored_labels_col")], np.float64)
+        err = preds - labels
+        return table.with_column("L1_loss", np.abs(err)).with_column(
+            "L2_loss", err * err
+        )

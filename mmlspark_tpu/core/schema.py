@@ -49,7 +49,11 @@ class ColumnMeta(dict):
 
 
 def _as_column(values: Any) -> Any:
-    """Normalize input into a column: numpy array, or list for ragged/object."""
+    """Normalize input into a column: numpy array, or list for ragged/object.
+    jax.Arrays pass through untouched so stages (e.g. Cacher) can keep
+    device-resident columns on a Table."""
+    if type(values).__module__.startswith("jax"):
+        return values
     if isinstance(values, np.ndarray):
         return values
     if isinstance(values, (list, tuple)):

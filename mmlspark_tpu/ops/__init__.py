@@ -1,0 +1,30 @@
+from .stages import (
+    DropColumns,
+    SelectColumns,
+    RenameColumn,
+    Repartition,
+    Explode,
+    Lambda,
+    UDFTransformer,
+    Cacher,
+    CheckpointData,
+    TextPreprocessor,
+    ClassBalancer,
+    ClassBalancerModel,
+    get_value_at,
+    to_vector,
+)
+from .indexer import ValueIndexer, ValueIndexerModel, IndexToValue
+from .missing import CleanMissingData, CleanMissingDataModel
+from .conversion import DataConversion
+from .summarize import SummarizeData
+from .sample import PartitionSample
+from .ensemble import EnsembleByKey
+from .adapter import MultiColumnAdapter, MultiColumnAdapterModel
+from .featurize import Featurize, AssembleFeatures, AssembleFeaturesModel
+from .minibatch import (
+    FixedMiniBatchTransformer,
+    DynamicMiniBatchTransformer,
+    TimeIntervalMiniBatchTransformer,
+    FlattenBatch,
+)

@@ -1,0 +1,331 @@
+"""Tests for the L3 ops layer: utility stages, indexing, imputation,
+featurization, minibatching, metrics."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import Table, load_stage, save_stage
+from mmlspark_tpu.ops import (
+    DropColumns,
+    SelectColumns,
+    RenameColumn,
+    Explode,
+    Lambda,
+    UDFTransformer,
+    TextPreprocessor,
+    ClassBalancer,
+    ValueIndexer,
+    IndexToValue,
+    CleanMissingData,
+    DataConversion,
+    SummarizeData,
+    PartitionSample,
+    EnsembleByKey,
+    MultiColumnAdapter,
+    Featurize,
+    AssembleFeatures,
+    FixedMiniBatchTransformer,
+    DynamicMiniBatchTransformer,
+    TimeIntervalMiniBatchTransformer,
+    FlattenBatch,
+)
+from mmlspark_tpu.automl import (
+    ComputeModelStatistics,
+    ComputePerInstanceStatistics,
+    MetricConstants,
+    auc,
+)
+
+
+class TestColumnStages:
+    def test_drop_select_rename(self):
+        t = Table({"a": [1], "b": [2], "c": [3]})
+        assert DropColumns(cols=["a"]).transform(t).columns == ["b", "c"]
+        assert SelectColumns(cols=["c", "a"]).transform(t).columns == ["c", "a"]
+        assert "z" in RenameColumn(input_col="a", output_col="z").transform(t)
+        with pytest.raises(KeyError):
+            DropColumns(cols=["nope"]).transform(t)
+
+    def test_explode(self):
+        t = Table({"k": [1, 2], "vs": [[10, 20], [30]]})
+        out = Explode(input_col="vs").transform(t)
+        assert out["k"].tolist() == [1, 1, 2]
+        assert list(out["vs"]) == [10, 20, 30]
+
+    def test_lambda_and_udf(self):
+        t = Table({"x": np.array([1.0, 2.0])})
+        out = Lambda(lambda tb: tb.with_column("y", tb["x"] * 10)).transform(t)
+        assert out["y"].tolist() == [10.0, 20.0]
+        out2 = UDFTransformer(
+            input_col="x", output_col="y", udf=lambda v: v + 1
+        ).transform(t)
+        assert out2["y"].tolist() == [2.0, 3.0]
+
+    def test_text_preprocessor_longest_match(self):
+        t = Table({"s": ["the cat sat", "category"]})
+        out = TextPreprocessor(
+            input_col="s", output_col="o", map={"cat": "dog", "category": "class"}
+        ).transform(t)
+        assert out["o"] == ["the dog sat", "class"]
+
+    def test_class_balancer(self):
+        t = Table({"y": [0, 0, 0, 1]})
+        model = ClassBalancer(input_col="y").fit(t)
+        out = model.transform(t)
+        np.testing.assert_allclose(out["weight"], [1.0, 1.0, 1.0, 3.0])
+
+
+class TestIndexer:
+    def test_roundtrip(self):
+        t = Table({"c": ["b", "a", "b", None]})
+        model = ValueIndexer(input_col="c", output_col="i").fit(t)
+        out = model.transform(t)
+        assert out["i"].tolist() == [1, 0, 1, 2]  # sorted levels, null last
+        back = IndexToValue(input_col="i", output_col="c2").transform(out)
+        assert back["c2"] == ["b", "a", "b", None]
+
+    def test_unseen_value_raises(self):
+        model = ValueIndexer(input_col="c", output_col="i").fit(Table({"c": ["a"]}))
+        with pytest.raises(ValueError):
+            model.transform(Table({"c": ["zzz"]}))
+
+    def test_save_load(self, tmp_path):
+        model = ValueIndexer(input_col="c", output_col="i").fit(
+            Table({"c": ["x", "y"]})
+        )
+        save_stage(model, str(tmp_path / "vi"))
+        loaded = load_stage(str(tmp_path / "vi"))
+        assert loaded.transform(Table({"c": ["y"]}))["i"].tolist() == [1]
+
+
+class TestCleanMissing:
+    def test_mean_median_custom(self):
+        t = Table({"x": np.array([1.0, np.nan, 3.0])})
+        mean_m = CleanMissingData(input_cols=["x"], output_cols=["x"]).fit(t)
+        assert mean_m.transform(t)["x"].tolist() == [1.0, 2.0, 3.0]
+        med = CleanMissingData(
+            input_cols=["x"], output_cols=["x"], cleaning_mode="Median"
+        ).fit(t)
+        assert med.transform(t)["x"][1] == 2.0
+        cust = CleanMissingData(
+            input_cols=["x"], output_cols=["x"], cleaning_mode="Custom", custom_value=-1
+        ).fit(t)
+        assert cust.transform(t)["x"][1] == -1.0
+
+
+class TestConversionSummarySample:
+    def test_conversion(self):
+        t = Table({"x": np.array([1.5, 2.5]), "s": ["1", "2"]})
+        out = DataConversion(cols=["x"], convert_to="integer").transform(t)
+        assert out["x"].dtype == np.int32
+        out2 = DataConversion(cols=["x"], convert_to="string").transform(t)
+        assert out2["x"] == ["1.5", "2.5"]
+        out3 = DataConversion(cols=["s"], convert_to="double").transform(t)
+        assert out3["s"].dtype == np.float64
+
+    def test_summarize(self):
+        t = Table({"x": np.array([1.0, 2.0, 3.0, np.nan]), "s": ["a", "b", "a", None]})
+        out = SummarizeData().transform(t)
+        assert out.num_rows == 2
+        row_x = next(r for r in out.rows() if r["Feature"] == "x")
+        assert row_x["Missing Value Count"] == 1.0
+        assert row_x["Mean"] == 2.0
+
+    def test_partition_sample(self):
+        t = Table({"x": np.arange(100)})
+        assert PartitionSample(mode="Head", count=5).transform(t).num_rows == 5
+        s = PartitionSample(mode="RandomSample", percent=0.5, seed=1).transform(t)
+        assert 25 < s.num_rows < 75
+        b = PartitionSample(mode="AssignToPartition", num_parts=4).transform(t)
+        assert set(b["Partition"].tolist()) <= {0, 1, 2, 3}
+
+
+class TestEnsembleAdapter:
+    def test_ensemble_by_key(self):
+        t = Table({"k": ["a", "a", "b"], "v": np.array([1.0, 3.0, 5.0])})
+        out = EnsembleByKey(keys=["k"], cols=["v"]).transform(t)
+        assert out.num_rows == 2
+        m = dict(zip(out["k"], out["mean(v)"]))
+        assert m["a"] == 2.0 and m["b"] == 5.0
+
+    def test_multi_column_adapter(self):
+        t = Table({"c1": ["a", "b"], "c2": ["x", "x"]})
+        adapter = MultiColumnAdapter(
+            base_stage=ValueIndexer(),
+            input_cols=["c1", "c2"],
+            output_cols=["i1", "i2"],
+        )
+        out = adapter.fit(t).transform(t)
+        assert out["i1"].tolist() == [0, 1]
+        assert out["i2"].tolist() == [0, 0]
+
+
+class TestFeaturize:
+    def test_assemble_numeric_categorical_string(self):
+        t = Table(
+            {
+                "num": np.array([1.0, 2.0]),
+                "vec": np.array([[1.0, 2.0], [3.0, 4.0]]),
+                "cat": ["p", "q"],
+                "txt": ["hello world", "hello"],
+            }
+        )
+        t = ValueIndexer(input_col="cat", output_col="cat").fit(t).transform(t)
+        model = AssembleFeatures(number_of_features=16).fit(t)
+        out = model.transform(t)
+        f = out["features"]
+        assert f.shape == (2, 1 + 2 + 2 + 16)
+        assert f.dtype == np.float32
+        # categorical one-hot
+        names = out.meta("features")["feature_names"]
+        assert "cat=0" in names and "vec_1" in names
+        # hashing: row 0 has two tokens, row 1 one token
+        hash_part = f[:, 5:]
+        assert hash_part[0].sum() == 2.0 and hash_part[1].sum() == 1.0
+
+    def test_featurize_multi_output(self):
+        t = Table({"a": np.array([1.0]), "b": np.array([2.0])})
+        model = Featurize(feature_columns={"f1": ["a"], "f2": ["a", "b"]}).fit(t)
+        out = model.transform(t)
+        assert out["f1"].shape == (1, 1) and out["f2"].shape == (1, 2)
+
+    def test_save_load(self, tmp_path):
+        t = Table({"num": np.array([1.0, 2.0]), "txt": ["a b", "c"]})
+        model = AssembleFeatures(number_of_features=8).fit(t)
+        save_stage(model, str(tmp_path / "af"))
+        loaded = load_stage(str(tmp_path / "af"))
+        assert loaded.transform(t).equals(model.transform(t))
+
+
+class TestMiniBatch:
+    def test_fixed_and_flatten(self):
+        t = Table({"x": np.arange(5), "s": [str(i) for i in range(5)]})
+        batched = FixedMiniBatchTransformer(batch_size=2).transform(t)
+        assert batched.num_rows == 3
+        assert [len(b) for b in batched["x"]] == [2, 2, 1]
+        flat = FlattenBatch().transform(batched)
+        assert flat.num_rows == 5
+        assert list(flat["s"]) == [str(i) for i in range(5)]
+
+    def test_dynamic(self):
+        t = Table({"x": np.arange(4)})
+        b = DynamicMiniBatchTransformer().transform(t)
+        assert b.num_rows == 1 and len(b["x"][0]) == 4
+
+    def test_time_interval(self):
+        t = Table({"x": np.arange(4), "t": np.array([0, 10, 500, 510])})
+        b = TimeIntervalMiniBatchTransformer(
+            interval_ms=100, arrival_time_col="t"
+        ).transform(t)
+        assert b.num_rows == 2
+        assert [len(v) for v in b["x"]] == [2, 2]
+
+
+class TestMetrics:
+    def test_classification_metrics(self):
+        t = Table(
+            {
+                "label": np.array([0, 0, 1, 1]),
+                "scored_labels": np.array([0, 1, 1, 1]),
+                "scores": np.array([0.1, 0.6, 0.7, 0.9]),
+            }
+        )
+        cms = ComputeModelStatistics(scores_col="scores")
+        out = cms.transform(t)
+        row = next(out.rows())
+        assert row[MetricConstants.ACCURACY] == 0.75
+        assert row[MetricConstants.PRECISION] == pytest.approx(2 / 3)
+        assert row[MetricConstants.RECALL] == 1.0
+        assert row[MetricConstants.AUC] == 1.0  # scores perfectly separate
+        assert cms.confusion_matrix.tolist() == [[1.0, 1.0], [0.0, 2.0]]
+
+    def test_auc_random(self):
+        rng = np.random.default_rng(0)
+        labels = rng.integers(0, 2, 2000)
+        scores = rng.random(2000)
+        assert abs(auc(labels, scores) - 0.5) < 0.05
+
+    def test_regression_metrics(self):
+        t = Table(
+            {"label": np.array([1.0, 2.0, 3.0]), "pred": np.array([1.0, 2.0, 4.0])}
+        )
+        out = ComputeModelStatistics(
+            scores_col="pred", evaluation_metric="regression"
+        ).transform(t)
+        row = next(out.rows())
+        assert row[MetricConstants.MSE] == pytest.approx(1 / 3)
+        assert row[MetricConstants.MAE] == pytest.approx(1 / 3)
+
+    def test_per_instance(self):
+        t = Table(
+            {
+                "label": np.array([0, 1]),
+                "scores": np.array([0.2, 0.9]),
+            }
+        )
+        out = ComputePerInstanceStatistics(scores_col="scores").transform(t)
+        np.testing.assert_allclose(
+            out["log_loss"], [-np.log(0.8), -np.log(0.9)], rtol=1e-6
+        )
+
+    def test_multiclass(self):
+        t = Table(
+            {
+                "label": np.array([0, 1, 2, 2]),
+                "scored_labels": np.array([0, 1, 2, 1]),
+            }
+        )
+        out = ComputeModelStatistics().transform(t)
+        row = next(out.rows())
+        assert row[MetricConstants.ACCURACY] == 0.75
+
+
+class TestReviewRegressions:
+    def test_interval_zero_rejected(self):
+        with pytest.raises(ValueError):
+            TimeIntervalMiniBatchTransformer(interval_ms=0)
+
+    def test_per_instance_classification_without_scores_raises(self):
+        t = Table({"label": np.array([0, 1]), "scored_labels": np.array([0, 1])})
+        with pytest.raises(ValueError):
+            ComputePerInstanceStatistics(evaluation_metric="classification").transform(t)
+
+    def test_negative_labels_confusion(self):
+        t = Table(
+            {
+                "label": np.array([-1, -1, 1, 1]),
+                "scored_labels": np.array([-1, 1, 1, 1]),
+            }
+        )
+        cms = ComputeModelStatistics(evaluation_metric="classification")
+        row = next(cms.transform(t).rows())
+        assert cms.confusion_matrix.tolist() == [[1.0, 1.0], [0.0, 2.0]]
+        assert row[MetricConstants.PRECISION] == pytest.approx(2 / 3)
+
+    def test_index_to_value_preserves_types(self):
+        t = Table({"c": [10, 20, 10]})
+        model = ValueIndexer(input_col="c", output_col="i").fit(t)
+        out = model.transform(t)
+        back = IndexToValue(input_col="i", output_col="c2").transform(out)
+        assert np.asarray(back["c2"]).tolist() == [10, 20, 10]
+
+    def test_cacher_keeps_device_array(self):
+        import jax
+
+        from mmlspark_tpu.ops.stages import Cacher
+
+        t = Table({"x": np.arange(4, dtype=np.float32), "s": ["a"] * 4})
+        out = Cacher().transform(t)
+        assert isinstance(out["x"], jax.Array)
+        assert np.asarray(out["x"]).tolist() == [0, 1, 2, 3]
+        assert out.gather([0, 2]).num_rows == 2  # table ops still work
+
+    def test_checkpoint_suffixless_path(self, tmp_path):
+        from mmlspark_tpu.ops.stages import CheckpointData
+
+        t = Table({"x": np.arange(3, dtype=np.float64)})
+        p = str(tmp_path / "snap")
+        CheckpointData(to_disk=True, path=p).transform(t)
+        assert (tmp_path / "snap.npz").exists()
+        CheckpointData(to_disk=True, path=p, remove_checkpoint=True).transform(t)
+        assert (tmp_path / "snap.npz").exists()
