@@ -122,9 +122,25 @@ class ComputeModelStatistics(Transformer):
             return True
         if metric in MetricConstants.REGRESSION_METRICS + ["regression"]:
             return False
-        kind = table.meta(self.get("scored_labels_col")).get(SCORE_KIND)
-        if kind:
-            return kind == "classification"
+        # a probability/raw_prediction score column marks classifier output
+        # (GBDTClassificationModel et al. tag columns with SCORE_KIND)
+        has_prob = any(
+            table.meta(c).get(SCORE_KIND) in ("probability", "raw_prediction")
+            for c in table.columns
+        )
+        if self.get("scored_labels_col") not in table:
+            if has_prob:
+                raise ValueError(
+                    f"table looks classifier-scored but scored_labels_col="
+                    f"{self.get('scored_labels_col')!r} is absent; available "
+                    f"columns: {table.columns}"
+                )
+            return False
+        if has_prob:
+            return True
+        if table.meta(self.get("scored_labels_col")).get(SCORE_KIND) == "prediction":
+            # tagged prediction without probabilities: regressor output
+            return False
         # all integral labels with few distinct values -> classification
         return bool(
             np.all(labels == np.round(labels)) and np.unique(labels).size <= 100
@@ -203,11 +219,32 @@ class ComputePerInstanceStatistics(Transformer):
                 "ComputePerInstanceStatistics: classification mode requires "
                 "scores_col pointing at a probability column"
             )
-        if scores_col and scores_col in table:
+        use_probs = (
+            scores_col
+            and scores_col in table
+            and self.get("evaluation_metric") != "regression"
+        )
+        if use_probs:
             probs = np.asarray(table[scores_col], np.float64)
             if probs.ndim == 1:  # binary: p(class 1)
                 probs = np.stack([1.0 - probs, probs], axis=1)
-            idx = labels.astype(np.int64)
+            # column order comes from the model's class list when the scorer
+            # tagged it; a batch-local unique() would misalign whenever a
+            # class is absent from this batch
+            cls_meta = table.meta(scores_col).get("class_labels")
+            if cls_meta is not None:
+                classes = np.asarray(cls_meta, np.float64)
+            elif np.all(labels == np.round(labels)) and labels.min() >= 0 and (
+                labels.max() < probs.shape[1]
+            ):
+                classes = np.arange(probs.shape[1], dtype=np.float64)
+            else:
+                classes = np.unique(labels)
+            if np.setdiff1d(labels, classes).size:
+                raise ValueError(
+                    f"labels {np.setdiff1d(labels, classes)} not in class set {classes}"
+                )
+            idx = np.searchsorted(classes, labels)
             p_true = np.clip(probs[np.arange(labels.size), idx], 1e-15, 1.0)
             return table.with_column("log_loss", -np.log(p_true))
         preds = np.asarray(table[self.get("scored_labels_col")], np.float64)

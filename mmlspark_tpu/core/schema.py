@@ -27,6 +27,7 @@ __all__ = [
     "CATEGORY_VALUES",
     "SCORE_KIND",
     "IMAGE_SPEC",
+    "as_scalar",
     "find_unused_column_name",
 ]
 
@@ -278,6 +279,11 @@ class Table:
                 if list(a) != list(b):
                     return False
         return True
+
+
+def as_scalar(v: Any) -> Any:
+    """Normalize a cell to a plain Python scalar (numpy/jax 0-d -> item)."""
+    return v.item() if hasattr(v, "item") else v
 
 
 def find_unused_column_name(prefix: str, table: Table) -> str:

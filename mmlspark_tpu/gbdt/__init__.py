@@ -1,0 +1,32 @@
+"""TPU-native histogram GBDT — the LightGBM-on-Spark equivalent.
+
+Reference: src/lightgbm/ (LightGBMClassifier.scala, LightGBMRegressor.scala,
+LightGBMBooster.scala, TrainUtils.scala, LightGBMUtils.scala). The reference
+binds the C++ lib_lightgbm via SWIG/JNI and synchronizes workers with a TCP
+socket ring (SURVEY.md §2.1, §3.1). Here the entire learner is JAX: quantile
+binning on host, jit-compiled leaf-wise tree growth with histogram kernels on
+device, and `psum` over the data mesh axis instead of LightGBM's socket
+reduce-scatter.
+"""
+
+from .binning import BinMapper
+from .booster import Booster
+from .estimators import (
+    GBDTClassifier,
+    GBDTClassificationModel,
+    GBDTRegressor,
+    GBDTRegressionModel,
+    LightGBMClassifier,
+    LightGBMRegressor,
+)
+
+__all__ = [
+    "BinMapper",
+    "Booster",
+    "GBDTClassifier",
+    "GBDTClassificationModel",
+    "GBDTRegressor",
+    "GBDTRegressionModel",
+    "LightGBMClassifier",
+    "LightGBMRegressor",
+]

@@ -1,0 +1,654 @@
+"""Booster: the trained GBDT model — array-of-trees SoA + jit predict.
+
+Reference: src/lightgbm/src/main/scala/LightGBMBooster.scala:15-181 (model
+string, per-row JNI predict via LGBM_BoosterPredictForMat) and TrainUtils.scala
+:74-121 (boosting loop). The reference predicts ONE ROW PER JNI CALL
+(LightGBMBooster.scala:38-113, a known perf sink noted in SURVEY.md §3.1);
+here prediction is a single jitted batched traversal: `lax.scan` over trees,
+vectorized gather-walk over nodes, all rows at once on the MXU-fed VPU.
+
+Training (`Booster.train`) drives the jitted grow function from engine.py:
+  host loop over boosting rounds (compiled once, dispatched ~num_iterations
+  times), objective grad/hess fused on device, bagging / GOSS masks on
+  device, optional early stopping against a validation split.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .binning import BinMapper
+from .engine import GrowConfig, TreeArrays, make_grow_fn, pad_rows
+from .objectives import get_objective, init_raw_score
+from ..parallel.mesh import DATA_AXIS
+
+__all__ = ["Booster", "TrainOptions"]
+
+_FORMAT_VERSION = 1
+
+
+@dataclass
+class TrainOptions:
+    """Training hyperparameters (reference: the 19 params of
+    src/lightgbm/src/main/scala/LightGBMParams.scala:11-149 plus regressor
+    objective extras, LightGBMRegressor.scala:17-36)."""
+
+    objective: str = "regression"
+    boosting_type: str = "gbdt"       # gbdt | rf | dart | goss
+    num_iterations: int = 100
+    learning_rate: float = 0.1
+    num_leaves: int = 31
+    max_bin: int = 255
+    max_depth: int = -1
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_gain_to_split: float = 0.0
+    bagging_fraction: float = 1.0
+    bagging_freq: int = 0
+    bagging_seed: int = 3
+    feature_fraction: float = 1.0
+    feature_fraction_seed: int = 2
+    # goss
+    top_rate: float = 0.2
+    other_rate: float = 0.1
+    # dart
+    drop_rate: float = 0.1
+    drop_seed: int = 4
+    # objective extras
+    alpha: float = 0.9                 # huber/quantile
+    tweedie_variance_power: float = 1.5
+    fair_c: float = 1.0
+    num_class: int = 1
+    boost_from_average: bool = True
+    is_unbalance: bool = False
+    early_stopping_round: int = 0
+    categorical_indexes: tuple[int, ...] = ()
+    init_model: "Booster | None" = None   # warm start (reference modelString)
+    seed: int = 0
+
+
+@dataclass
+class Booster:
+    """Immutable trained model. Trees are stacked SoA arrays (T, M)."""
+
+    feature: np.ndarray          # (T, M) int32
+    threshold_bin: np.ndarray    # (T, M) int32
+    threshold_value: np.ndarray  # (T, M) float64 — raw-space numeric threshold
+    is_categorical: np.ndarray   # (T, M) bool
+    left: np.ndarray             # (T, M) int32
+    right: np.ndarray            # (T, M) int32
+    value: np.ndarray            # (T, M) float32 (shrunk leaf values)
+    gain: np.ndarray             # (T, M) float32
+    tree_class: np.ndarray       # (T,) int32 — class id per tree (multiclass)
+    bin_mapper: BinMapper
+    objective: str = "regression"
+    num_class: int = 1
+    init_score: float = 0.0
+    best_iteration: int = -1
+    feature_names: list[str] = field(default_factory=list)
+    class_labels: list[float] | None = None   # original classifier label values
+    _predict_cache: dict = field(default_factory=dict, repr=False, compare=False)
+
+    # ------------------------------------------------------------------ #
+    # training                                                           #
+    # ------------------------------------------------------------------ #
+
+    @staticmethod
+    def train(
+        x: np.ndarray,
+        y: np.ndarray,
+        opts: TrainOptions,
+        weights: np.ndarray | None = None,
+        valid: tuple[np.ndarray, np.ndarray] | None = None,
+        mesh=None,
+        feature_names: list[str] | None = None,
+        log: Callable[[str], None] | None = None,
+    ) -> "Booster":
+        x = np.asarray(x, dtype=np.float64)
+        y = np.asarray(y, dtype=np.float64)
+        n, f = x.shape
+        k = opts.num_class if opts.objective == "multiclass" else 1
+
+        warm = opts.init_model
+        if warm is not None:
+            mapper = warm.bin_mapper
+        else:
+            mapper = BinMapper(
+                max_bin=opts.max_bin, categorical_indexes=tuple(opts.categorical_indexes)
+            ).fit(x)
+        bins_np = mapper.transform(x)
+        num_bins = max(int(mapper.num_bins.max(initial=2)), 2)
+
+        # pad rows so the data mesh axis divides evenly
+        shards = mesh.shape.get(DATA_AXIS, 1) if mesh is not None else 1
+        n_pad = pad_rows(n, shards)
+        pad = n_pad - n
+        if pad:
+            bins_np = np.concatenate([bins_np, np.zeros((pad, f), np.int32)])
+        bins_dev = jnp.asarray(bins_np, jnp.int32)
+
+        w = np.ones(n, np.float64) if weights is None else np.asarray(weights, np.float64)
+        if opts.is_unbalance and opts.objective == "binary":
+            # reference is_unbalance: scale positive class by neg/pos ratio
+            npos = max(float((y == 1).sum()), 1.0)
+            nneg = max(float((y == 0).sum()), 1.0)
+            w = np.where(y == 1, w * nneg / npos, w)
+        base_mask_np = np.concatenate([w, np.zeros(pad)]).astype(np.float32)
+        base_mask = jnp.asarray(base_mask_np)
+
+        obj_fn = get_objective(
+            opts.objective,
+            alpha=opts.alpha,
+            tweedie_variance_power=opts.tweedie_variance_power,
+            fair_c=opts.fair_c,
+        )
+
+        cfg = GrowConfig(
+            num_leaves=opts.num_leaves,
+            max_depth=opts.max_depth,
+            max_bin=opts.max_bin,
+            min_data_in_leaf=float(opts.min_data_in_leaf),
+            min_sum_hessian_in_leaf=opts.min_sum_hessian_in_leaf,
+            lambda_l1=opts.lambda_l1,
+            lambda_l2=opts.lambda_l2,
+            min_gain_to_split=opts.min_gain_to_split,
+            learning_rate=1.0 if opts.boosting_type == "rf" else opts.learning_rate,
+        )
+        cat_mask = np.zeros(f, bool)
+        for ci in opts.categorical_indexes:
+            cat_mask[int(ci)] = True
+        grow = make_grow_fn(f, num_bins, cfg, mapper.num_bins, cat_mask, mesh=mesh)
+
+        if opts.objective == "multiclass":
+            init = 0.0
+            y_enc = np.eye(k)[y.astype(int)]                  # (n, K)
+            y_pad = np.concatenate([y_enc, np.zeros((pad, k))])
+            pred = jnp.zeros((n_pad, k), jnp.float32)
+        else:
+            init = (
+                warm.init_score
+                if warm is not None
+                else init_raw_score(opts.objective, y, w, opts.boost_from_average, opts.alpha)
+            )
+            y_pad = np.concatenate([y, np.zeros(pad)])
+            pred = jnp.full((n_pad,), init, jnp.float32)
+        y_dev = jnp.asarray(y_pad, jnp.float32)
+
+        # warm start: begin from the previous model's raw predictions
+        prev_trees: list[dict[str, np.ndarray]] = []
+        start_iter = 0
+        if warm is not None:
+            raw = warm.predict_raw(x)
+            raw_p = np.concatenate([raw, np.zeros((pad,) + raw.shape[1:])])
+            pred = jnp.asarray(raw_p, jnp.float32).reshape(pred.shape)
+            for t in range(warm.feature.shape[0]):
+                prev_trees.append(warm._tree_dict(t))
+            start_iter = len(prev_trees) // k
+
+        @jax.jit
+        def grad_hess(pred, sel):
+            if opts.objective == "multiclass":
+                g, h = obj_fn(y_dev, pred)
+                return g[:, sel], h[:, sel]
+            g, h = obj_fn(y_dev, pred)
+            return g, h
+
+        rng = np.random.default_rng(opts.bagging_seed)
+        frng = np.random.default_rng(opts.feature_fraction_seed)
+        drng = np.random.default_rng(opts.drop_seed)
+
+        use_goss = opts.boosting_type == "goss"
+        use_bagging = (
+            opts.boosting_type in ("gbdt", "dart", "rf")
+            and opts.bagging_fraction < 1.0
+            and opts.bagging_freq > 0
+        ) or opts.boosting_type == "rf"
+
+        @jax.jit
+        def goss_mask(g, seed):
+            ga = jnp.abs(g)
+            n_top = max(int(opts.top_rate * n), 1)
+            thresh = jax.lax.top_k(ga, n_top)[0][-1]
+            is_top = ga >= thresh
+            key = jax.random.PRNGKey(seed)
+            keep_small = jax.random.uniform(key, ga.shape) < opts.other_rate / max(
+                1.0 - opts.top_rate, 1e-6
+            )
+            amp = (1.0 - opts.top_rate) / max(opts.other_rate, 1e-6)
+            return jnp.where(is_top, 1.0, jnp.where(keep_small, amp, 0.0))
+
+        trees: list[dict[str, np.ndarray]] = list(prev_trees)
+        tree_classes: list[int] = [int(c) for c in (warm.tree_class if warm is not None else [])]
+        # dart bookkeeping: per-tree train-set contribution (host, float32)
+        dart_contribs: list[np.ndarray] = []
+        dart_weights: list[float] = []
+
+        # early stopping state: validation raw scores maintained incrementally
+        # (bin once, add each new tree's contribution — no per-round rebuild).
+        # Undefined for rf (independent trees) and single-class dart (trees
+        # are rescaled after the fact).
+        best_loss, best_iter, since_best = np.inf, -1, 0
+        es_unsupported = opts.boosting_type == "rf" or (
+            opts.boosting_type == "dart" and k == 1
+        )
+        es_active = (
+            valid is not None and opts.early_stopping_round > 0 and not es_unsupported
+        )
+        if valid is not None and opts.early_stopping_round > 0 and es_unsupported and log:
+            log(f"early stopping is not supported for boosting_type={opts.boosting_type}; ignored")
+        if es_active:
+            xv, yv = valid
+            xv = np.asarray(xv, np.float64)
+            yv = np.asarray(yv, np.float64)
+            xv_bins = jnp.asarray(mapper.transform(xv), jnp.int32)
+            nv = len(yv)
+            if warm is not None:
+                # validation scores must include the warm model's trees
+                val_raw = jnp.asarray(warm.predict_raw(xv), jnp.float32)
+            elif k > 1:
+                val_raw = jnp.zeros((nv, k), jnp.float32)
+            else:
+                val_raw = jnp.full((nv,), init, jnp.float32)
+            if k > 1:
+                yv_idx = jnp.asarray(yv.astype(int))
+            else:
+                yv_dev = jnp.asarray(yv, jnp.float32)
+            max_steps = opts.num_leaves
+
+            @jax.jit
+            def tree_val_contrib(tree: TreeArrays):
+                node = jnp.zeros((nv,), jnp.int32)
+
+                def body(_, node):
+                    f = jnp.maximum(tree.feature[node], 0)
+                    col = xv_bins[jnp.arange(nv), f]
+                    go_left = jnp.where(
+                        tree.is_categorical[node],
+                        col == tree.threshold_bin[node],
+                        col <= tree.threshold_bin[node],
+                    )
+                    leaf = tree.feature[node] < 0
+                    return jnp.where(
+                        leaf, node, jnp.where(go_left, tree.left[node], tree.right[node])
+                    )
+
+                node = jax.lax.fori_loop(0, max_steps, body, node)
+                return tree.value[node]
+
+            @jax.jit
+            def val_loss_of(raw):
+                if opts.objective == "binary":
+                    p = jax.nn.sigmoid(raw)
+                    eps = 1e-7
+                    return -jnp.mean(
+                        yv_dev * jnp.log(p + eps) + (1 - yv_dev) * jnp.log(1 - p + eps)
+                    )
+                if opts.objective == "multiclass":
+                    logp = jax.nn.log_softmax(raw, axis=-1)
+                    return -jnp.mean(logp[jnp.arange(nv), yv_idx])
+                return jnp.mean((raw - yv_dev) ** 2)
+
+        bag_mask = base_mask
+        for it in range(start_iter, opts.num_iterations):
+            if use_bagging and (
+                opts.boosting_type == "rf"
+                or opts.bagging_freq == 0
+                or it % max(opts.bagging_freq, 1) == 0
+            ):
+                frac = opts.bagging_fraction if opts.bagging_fraction < 1.0 else 0.632
+                keep = (rng.random(n_pad) < frac) & (base_mask_np > 0)
+                bag_mask = jnp.asarray(np.where(keep, base_mask_np, 0.0), jnp.float32)
+            if opts.feature_fraction < 1.0:
+                fm = (frng.random(f) < opts.feature_fraction).astype(np.float32)
+                if fm.sum() == 0:
+                    fm[frng.integers(f)] = 1.0
+                feat_mask = jnp.asarray(fm)
+            else:
+                feat_mask = jnp.ones((f,), jnp.float32)
+
+            # dart: drop a subset of existing trees for this round's gradients
+            # (multiclass dart falls back to gbdt updates)
+            dart_mode = opts.boosting_type == "dart" and k == 1
+            rf_mode = opts.boosting_type == "rf"
+            pred_round = pred
+            dropped: list[int] = []
+            if dart_mode and dart_contribs:
+                dropped = [i for i in range(len(dart_contribs)) if drng.random() < opts.drop_rate]
+                if dropped:
+                    drop_sum = np.sum(
+                        [dart_contribs[i] * dart_weights[i] for i in dropped], axis=0
+                    )
+                    pred_round = pred - jnp.asarray(drop_sum, jnp.float32)
+
+            for cls in range(k):
+                g, h = grad_hess(pred_round, cls)
+                mask = bag_mask
+                if use_goss:
+                    mask = base_mask * goss_mask(g, opts.bagging_seed + it)
+                tree, row_val = grow(bins_dev, g, h, mask, feat_mask)
+                if es_active:
+                    contrib = tree_val_contrib(tree)
+                    if k > 1:
+                        val_raw = val_raw.at[:, cls].add(contrib)
+                    else:
+                        val_raw = val_raw + contrib
+                if dart_mode:
+                    # new tree and dropped trees renormalized (standard DART)
+                    norm_new = 1.0 / (len(dropped) + 1)
+                    for i in dropped:
+                        dart_weights[i] *= len(dropped) / (len(dropped) + 1.0)
+                    row_val_np = np.asarray(row_val, np.float32)
+                    resum = (
+                        np.sum([dart_contribs[i] * dart_weights[i] for i in dropped], axis=0)
+                        if dropped
+                        else np.zeros_like(row_val_np)
+                    )
+                    pred = pred_round + jnp.asarray(resum + row_val_np * norm_new, jnp.float32)
+                    dart_contribs.append(row_val_np)
+                    dart_weights.append(norm_new)
+                    trees.append(_tree_to_host(tree))  # scaled at the end
+                elif rf_mode:
+                    trees.append(_tree_to_host(tree))  # pred stays at init
+                elif opts.objective == "multiclass":
+                    pred = pred.at[:, cls].add(row_val)
+                    trees.append(_tree_to_host(tree))
+                else:
+                    pred = pred + row_val
+                    trees.append(_tree_to_host(tree))
+                tree_classes.append(cls)
+
+            if es_active:
+                vloss = float(val_loss_of(val_raw))
+                if vloss < best_loss - 1e-9:
+                    best_loss, best_iter, since_best = vloss, it, 0
+                else:
+                    since_best += 1
+                    if since_best >= opts.early_stopping_round:
+                        if log:
+                            log(f"early stop at iter {it} (best {best_iter})")
+                        # drop the trees grown after the best iteration
+                        keep = len(prev_trees) + (best_iter - start_iter + 1) * k
+                        trees = trees[:keep]
+                        tree_classes = tree_classes[:keep]
+                        break
+            if log and (it + 1) % 10 == 0:
+                log(f"iter {it + 1}/{opts.num_iterations}")
+
+        if opts.boosting_type == "dart" and k == 1 and dart_weights:
+            start = len(prev_trees)
+            trees = trees[:start] + [
+                _scale_tree(t, dart_weights[i]) for i, t in enumerate(trees[start:])
+            ]
+        if opts.boosting_type == "rf" and trees:
+            scale = 1.0 / max(len(trees) // k, 1)
+            trees = [_scale_tree(t, scale) for t in trees]
+
+        out = Booster._from_tree_dicts(
+            trees, tree_classes, mapper, opts, init, feature_names or []
+        )
+        out.best_iteration = best_iter
+        return out
+
+    # ------------------------------------------------------------------ #
+    # construction helpers                                               #
+    # ------------------------------------------------------------------ #
+
+    def _tree_dict(self, t: int) -> dict[str, np.ndarray]:
+        return {
+            "feature": self.feature[t],
+            "threshold_bin": self.threshold_bin[t],
+            "is_categorical": self.is_categorical[t],
+            "left": self.left[t],
+            "right": self.right[t],
+            "value": self.value[t],
+            "gain": self.gain[t],
+        }
+
+    @staticmethod
+    def _from_tree_dicts(
+        trees: list[dict[str, np.ndarray]],
+        tree_classes: list[int],
+        mapper: BinMapper,
+        opts: TrainOptions,
+        init: float,
+        feature_names: list[str],
+    ) -> "Booster":
+        if not trees:
+            m = 2 * opts.num_leaves - 1
+            z = lambda dt, fill=0: np.full((0, m), fill, dt)  # noqa: E731
+            return Booster(
+                feature=z(np.int32, -1), threshold_bin=z(np.int32),
+                threshold_value=z(np.float64), is_categorical=z(bool),
+                left=z(np.int32, -1), right=z(np.int32, -1),
+                value=z(np.float32), gain=z(np.float32),
+                tree_class=np.zeros(0, np.int32), bin_mapper=mapper,
+                objective=opts.objective, num_class=opts.num_class,
+                init_score=init, feature_names=feature_names,
+            )
+        stack = lambda key: np.stack([np.asarray(t[key]) for t in trees])  # noqa: E731
+        feature = stack("feature").astype(np.int32)
+        thr_bin = stack("threshold_bin").astype(np.int32)
+        # raw-space thresholds for numeric splits (categorical: the raw
+        # category value of the one-vs-rest bin, NaN if the "other" bin)
+        thr_val = np.zeros(feature.shape, np.float64)
+        is_cat = stack("is_categorical").astype(bool)
+        inv_cat = {
+            j: {b: v for v, b in m.items()} for j, m in mapper.category_maps.items()
+        }
+        for t in range(feature.shape[0]):
+            for node in range(feature.shape[1]):
+                fidx = feature[t, node]
+                if fidx < 0:
+                    continue
+                b = int(thr_bin[t, node])
+                if is_cat[t, node]:
+                    thr_val[t, node] = inv_cat.get(int(fidx), {}).get(b, np.nan)
+                else:
+                    thr_val[t, node] = mapper.bin_to_value(int(fidx), b)
+        return Booster(
+            feature=feature,
+            threshold_bin=thr_bin,
+            threshold_value=thr_val,
+            is_categorical=is_cat,
+            left=stack("left").astype(np.int32),
+            right=stack("right").astype(np.int32),
+            value=stack("value").astype(np.float32),
+            gain=stack("gain").astype(np.float32),
+            tree_class=np.asarray(tree_classes, np.int32),
+            bin_mapper=mapper,
+            objective=opts.objective,
+            num_class=opts.num_class if opts.objective == "multiclass" else 1,
+            init_score=init,
+            feature_names=feature_names,
+        )
+
+    # ------------------------------------------------------------------ #
+    # prediction                                                         #
+    # ------------------------------------------------------------------ #
+
+    @property
+    def num_trees(self) -> int:
+        return int(self.feature.shape[0])
+
+    @property
+    def num_features(self) -> int:
+        return self.bin_mapper.num_features
+
+    def _traverse_fn(self):
+        """Jitted batched traversal over binned inputs: scan over trees,
+        gather-walk num_leaves steps deep (fixed bound)."""
+        key = "traverse"
+        if key in self._predict_cache:
+            return self._predict_cache[key]
+        max_steps = int(self.feature.shape[1] // 2 + 1)  # deepest leaf-wise chain
+        k = self.num_class
+        stacked = dict(
+            feature=jnp.asarray(self.feature),
+            thr=jnp.asarray(self.threshold_bin),
+            cat=jnp.asarray(self.is_categorical),
+            left=jnp.asarray(self.left),
+            right=jnp.asarray(self.right),
+            value=jnp.asarray(self.value),
+            cls=jnp.asarray(self.tree_class),
+        )
+
+        @jax.jit
+        def run(bins):
+            n = bins.shape[0]
+            out0 = jnp.zeros((n, k), jnp.float32) if k > 1 else jnp.full(
+                (n,), self.init_score, jnp.float32
+            )
+
+            def one_tree(acc, tr):
+                node = jnp.zeros((n,), jnp.int32)
+
+                def body(_, node):
+                    f = jnp.maximum(tr["feature"][node], 0)
+                    col = bins[jnp.arange(n), f]
+                    go_left = jnp.where(
+                        tr["cat"][node], col == tr["thr"][node], col <= tr["thr"][node]
+                    )
+                    leaf = tr["feature"][node] < 0
+                    nxt = jnp.where(
+                        leaf, node, jnp.where(go_left, tr["left"][node], tr["right"][node])
+                    )
+                    return nxt
+
+                node = jax.lax.fori_loop(0, max_steps, body, node)
+                val = tr["value"][node]
+                if k > 1:
+                    acc = acc.at[:, tr["cls"]].add(val)
+                else:
+                    acc = acc + val
+                return acc, None
+
+            acc, _ = jax.lax.scan(one_tree, out0, stacked)
+            return acc
+
+        self._predict_cache[key] = run
+        return run
+
+    def predict_raw(self, x: np.ndarray) -> np.ndarray:
+        """Raw margin scores: (n,) or (n, K) for multiclass."""
+        x = np.asarray(x, dtype=np.float64)
+        if self.num_trees == 0:
+            shape = (len(x), self.num_class) if self.num_class > 1 else (len(x),)
+            return np.full(shape, self.init_score, np.float32)
+        bins = jnp.asarray(self.bin_mapper.transform(x), jnp.int32)
+        return np.asarray(self._traverse_fn()(bins))
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        """Probability / transformed prediction (reference
+        LightGBMBooster.score semantics)."""
+        raw = self.predict_raw(x)
+        if self.objective == "binary":
+            return np.asarray(jax.nn.sigmoid(jnp.asarray(raw)))
+        if self.objective == "multiclass":
+            return np.asarray(jax.nn.softmax(jnp.asarray(raw), axis=-1))
+        if self.objective in ("poisson", "gamma", "tweedie"):
+            return np.exp(raw)
+        return raw
+
+    # ------------------------------------------------------------------ #
+    # importances / persistence                                          #
+    # ------------------------------------------------------------------ #
+
+    def feature_importances(self, importance_type: str = "split") -> np.ndarray:
+        """Reference: LightGBMBooster getFeatureImportances(split|gain)."""
+        imp = np.zeros(self.num_features, np.float64)
+        mask = self.feature >= 0
+        if importance_type == "split":
+            np.add.at(imp, self.feature[mask], 1.0)
+        elif importance_type == "gain":
+            np.add.at(imp, self.feature[mask], self.gain[mask])
+        else:
+            raise ValueError("importance_type must be 'split' or 'gain'")
+        return imp
+
+    def to_text(self) -> str:
+        """Portable text model (reference saveNativeModel,
+        LightGBMBooster.scala:115-124)."""
+        payload = {
+            "format": "mmlspark_tpu.gbdt",
+            "version": _FORMAT_VERSION,
+            "objective": self.objective,
+            "num_class": self.num_class,
+            "init_score": self.init_score,
+            "best_iteration": self.best_iteration,
+            "feature_names": self.feature_names,
+            "class_labels": self.class_labels,
+            "tree_class": self.tree_class.tolist(),
+            "trees": {
+                "feature": self.feature.tolist(),
+                "threshold_bin": self.threshold_bin.tolist(),
+                "threshold_value": self.threshold_value.tolist(),
+                "is_categorical": self.is_categorical.tolist(),
+                "left": self.left.tolist(),
+                "right": self.right.tolist(),
+                "value": self.value.tolist(),
+                "gain": self.gain.tolist(),
+            },
+            "bin_mapper": self.bin_mapper.to_dict(),
+        }
+        return json.dumps(payload)
+
+    @staticmethod
+    def from_text(text: str) -> "Booster":
+        d = json.loads(text)
+        if d.get("format") != "mmlspark_tpu.gbdt":
+            raise ValueError("not a mmlspark_tpu gbdt model")
+        t = d["trees"]
+        arr = lambda key, dt: np.asarray(t[key], dtype=dt)  # noqa: E731
+        return Booster(
+            feature=arr("feature", np.int32),
+            threshold_bin=arr("threshold_bin", np.int32),
+            threshold_value=arr("threshold_value", np.float64),
+            is_categorical=arr("is_categorical", bool),
+            left=arr("left", np.int32),
+            right=arr("right", np.int32),
+            value=arr("value", np.float32),
+            gain=arr("gain", np.float32),
+            tree_class=np.asarray(d["tree_class"], np.int32),
+            bin_mapper=BinMapper.from_dict(d["bin_mapper"]),
+            objective=d["objective"],
+            num_class=int(d["num_class"]),
+            init_score=float(d["init_score"]),
+            best_iteration=int(d.get("best_iteration", -1)),
+            feature_names=list(d.get("feature_names", [])),
+            class_labels=d.get("class_labels"),
+        )
+
+    def save_native_model(self, path: str) -> None:
+        with open(path, "w") as fh:
+            fh.write(self.to_text())
+
+    @staticmethod
+    def load_native_model(path: str) -> "Booster":
+        with open(path) as fh:
+            return Booster.from_text(fh.read())
+
+
+def _tree_to_host(tree: TreeArrays) -> dict[str, np.ndarray]:
+    return {
+        "feature": np.asarray(tree.feature),
+        "threshold_bin": np.asarray(tree.threshold_bin),
+        "is_categorical": np.asarray(tree.is_categorical),
+        "left": np.asarray(tree.left),
+        "right": np.asarray(tree.right),
+        "value": np.asarray(tree.value),
+        "gain": np.asarray(tree.gain),
+    }
+
+
+def _scale_tree(t: dict[str, np.ndarray], scale: float) -> dict[str, np.ndarray]:
+    t = dict(t)
+    t["value"] = np.asarray(t["value"]) * scale
+    return t

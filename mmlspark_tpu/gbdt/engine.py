@@ -1,0 +1,283 @@
+"""Histogram-GBDT training engine: jit-compiled leaf-wise tree growth.
+
+Reference semantics: lib_lightgbm's serial/data-parallel tree learner as
+driven by src/lightgbm/src/main/scala/TrainUtils.scala:74-121 (boosting loop
+calling LGBM_BoosterUpdateOneIter) — per-feature histogram build over local
+rows, distributed reduce-scatter of histograms, best-gain split, leaf-wise
+growth bounded by num_leaves/max_depth.
+
+TPU-first redesign:
+  - The whole single-tree growth loop is ONE jitted function
+    (`lax.fori_loop` over num_leaves-1 split steps) on fixed-shape arrays —
+    no per-node Python dispatch, no dynamic shapes.
+  - Histograms are built with segment-sums over (bin + feature*B) ids — a
+    shape XLA lowers well — per split step only for the NEW left child; the
+    right child comes from the classic parent-minus-sibling subtraction.
+  - Data parallelism: rows are sharded over the mesh "data" axis with
+    `shard_map`; the single collective is a `psum` of the (F, B, 3)
+    histogram — the ICI equivalent of LightGBM's socket reduce-scatter
+    (TrainUtils.scala:217 LGBM_NetworkInit ring). All devices then grow
+    identical trees from the identical summed histogram, mirroring the
+    reference's replicated-model-by-construction design
+    (LightGBMClassifier.scala:82-85 `.reduce((b1,_)=>b1)`).
+  - Categorical splits are one-vs-rest on a single bin (LightGBM's
+    cat_smooth/max_cat_threshold refinements are approximated by
+    frequency-ordered bins from binning.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..parallel.mesh import DATA_AXIS
+
+__all__ = ["TreeArrays", "GrowConfig", "make_grow_fn", "pad_rows"]
+
+
+class TreeArrays(NamedTuple):
+    """SoA tree layout (M = 2*num_leaves - 1 nodes, fixed)."""
+
+    feature: jnp.ndarray        # (M,) int32, -1 on leaves
+    threshold_bin: jnp.ndarray  # (M,) int32 (<= goes left; == for categorical)
+    is_categorical: jnp.ndarray # (M,) bool
+    left: jnp.ndarray           # (M,) int32, -1 on leaves
+    right: jnp.ndarray          # (M,) int32
+    value: jnp.ndarray          # (M,) float32 (already shrunk by learning_rate)
+    is_leaf: jnp.ndarray        # (M,) bool
+    gain: jnp.ndarray           # (M,) float32 split gain (importance bookkeeping)
+
+
+class GrowConfig(NamedTuple):
+    num_leaves: int = 31
+    max_depth: int = -1           # <=0: unlimited (bounded by num_leaves)
+    max_bin: int = 255
+    min_data_in_leaf: float = 20.0
+    min_sum_hessian_in_leaf: float = 1e-3
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_gain_to_split: float = 0.0
+    learning_rate: float = 0.1
+
+
+def pad_rows(n: int, shards: int) -> int:
+    """Rows padded up so the data axis divides evenly (mask kills the pad)."""
+    return ((n + shards - 1) // shards) * shards
+
+
+def _l1_threshold(g, l1):
+    return jnp.sign(g) * jnp.maximum(jnp.abs(g) - l1, 0.0)
+
+
+def _leaf_objective(g, h, l1, l2):
+    """-Thr(G)^2 / (H + l2): the (negated) optimal leaf loss."""
+    t = _l1_threshold(g, l1)
+    return (t * t) / (h + l2 + 1e-12)
+
+
+def _histogram(bins, stats, num_bins):
+    """bins: (n, F) int32; stats: (n, 3) [g, h, w] already masked.
+    Returns (F, B, 3). Scans over features to keep memory O(n)."""
+
+    def one_feature(_, bin_col):
+        hist = jax.ops.segment_sum(stats, bin_col, num_segments=num_bins)
+        return None, hist
+
+    _, hists = jax.lax.scan(one_feature, None, bins.T)
+    return hists  # (F, B, 3)
+
+
+def make_grow_fn(
+    num_features: int,
+    num_bins: int,
+    cfg: GrowConfig,
+    feature_num_bins: np.ndarray,
+    categorical_mask: np.ndarray,
+    mesh: Mesh | None = None,
+):
+    """Build the jitted single-tree growth function.
+
+    Returns fn(bins(n,F) i32, grad(n,) f32, hess(n,) f32, sample_mask(n,) f32,
+               feature_mask(F,) f32) -> (TreeArrays, per_row_value(n,) f32)
+
+    When `mesh` has a data axis > 1 the function is shard_mapped: row inputs
+    sharded on DATA_AXIS, histogram psummed, tree state replicated.
+    """
+    nl = cfg.num_leaves
+    m = 2 * nl - 1
+    fbins = jnp.asarray(feature_num_bins, jnp.int32)          # (F,)
+    is_cat_f = jnp.asarray(categorical_mask, bool)            # (F,)
+    max_depth = cfg.max_depth if cfg.max_depth and cfg.max_depth > 0 else nl + 1
+
+    def grow(bins, grad, hess, sample_mask, feature_mask, axis_name=None):
+        n = bins.shape[0]
+
+        def hist_for(mask):
+            stats = jnp.stack([grad * mask, hess * mask, mask], axis=-1)
+            h = _histogram(bins, stats, num_bins)
+            if axis_name is not None:
+                h = jax.lax.psum(h, axis_name)
+            return h  # (F, B, 3)
+
+        # -- static bin-validity masks ---------------------------------
+        bin_idx = jnp.arange(num_bins)                         # (B,)
+        # numeric: can split at any bin except the last real one
+        valid_num = bin_idx[None, :] < (fbins[:, None] - 1)    # (F, B)
+        # categorical: any real bin can be the one-vs-rest bin
+        valid_cat = bin_idx[None, :] < fbins[:, None]
+        valid_bin = jnp.where(is_cat_f[:, None], valid_cat, valid_num)
+        valid_bin = valid_bin & (feature_mask[:, None] > 0)
+
+        def best_split_of(hist, node_g, node_h, node_c):
+            """hist: (F,B,3) for one node -> (gain, feature, bin)."""
+            cum = jnp.cumsum(hist, axis=1)                     # (F,B,3)
+            # numeric: left = bins <= b (cumulative); categorical: left = bin == b
+            left = jnp.where(is_cat_f[:, None, None], hist, cum)
+            gl, hl, cl = left[..., 0], left[..., 1], left[..., 2]
+            gr, hr, cr = node_g - gl, node_h - hl, node_c - cl
+            ok = (
+                valid_bin
+                & (cl >= cfg.min_data_in_leaf)
+                & (cr >= cfg.min_data_in_leaf)
+                & (hl >= cfg.min_sum_hessian_in_leaf)
+                & (hr >= cfg.min_sum_hessian_in_leaf)
+            )
+            parent_obj = _leaf_objective(node_g, node_h, cfg.lambda_l1, cfg.lambda_l2)
+            gain = (
+                _leaf_objective(gl, hl, cfg.lambda_l1, cfg.lambda_l2)
+                + _leaf_objective(gr, hr, cfg.lambda_l1, cfg.lambda_l2)
+                - parent_obj
+            )
+            gain = jnp.where(ok, gain, -jnp.inf)
+            flat = jnp.argmax(gain)
+            f, b = flat // num_bins, flat % num_bins
+            return gain.reshape(-1)[flat], f.astype(jnp.int32), b.astype(jnp.int32)
+
+        # -- state ------------------------------------------------------
+        tree = TreeArrays(
+            feature=jnp.full((m,), -1, jnp.int32),
+            threshold_bin=jnp.zeros((m,), jnp.int32),
+            is_categorical=jnp.zeros((m,), bool),
+            left=jnp.full((m,), -1, jnp.int32),
+            right=jnp.full((m,), -1, jnp.int32),
+            value=jnp.zeros((m,), jnp.float32),
+            is_leaf=jnp.zeros((m,), bool).at[0].set(True),
+            gain=jnp.zeros((m,), jnp.float32),
+        )
+        node_of_row = jnp.zeros((n,), jnp.int32)
+        if axis_name is not None:
+            # constants are replicated under shard_map; row state must carry
+            # the varying-manual-axis type so lax.cond branches agree
+            node_of_row = jax.lax.pcast(node_of_row, (axis_name,), to="varying")
+        hists = jnp.zeros((m, num_features, num_bins, 3), jnp.float32)
+        hists = hists.at[0].set(hist_for(sample_mask))
+        depth = jnp.zeros((m,), jnp.int32)
+        # cached per-leaf best splits (recomputed only for new children)
+        best_gain = jnp.full((m,), -jnp.inf, jnp.float32)
+        best_f = jnp.zeros((m,), jnp.int32)
+        best_b = jnp.zeros((m,), jnp.int32)
+
+        def node_totals(h):
+            # summing any single feature's bins over a node = node totals
+            t = h[:, 0, :, :].sum(axis=1)                      # (M, 3)
+            return t[:, 0], t[:, 1], t[:, 2]
+
+        g0, f0, b0 = best_split_of(hists[0], *(x[0] for x in node_totals(hists)))
+        best_gain = best_gain.at[0].set(g0)
+        best_f = best_f.at[0].set(f0)
+        best_b = best_b.at[0].set(b0)
+
+        State = tuple  # (tree, node_of_row, hists, depth, best_*, num_nodes, done)
+
+        def step(k, state):
+            (tree, node_of_row, hists, depth, best_gain, best_f, best_b,
+             num_nodes, done) = state
+            ng, nh, nc = node_totals(hists)
+            splittable = tree.is_leaf & (depth < max_depth) & (best_gain > cfg.min_gain_to_split)
+            cand_gain = jnp.where(splittable, best_gain, -jnp.inf)
+            p = jnp.argmax(cand_gain).astype(jnp.int32)
+            no_split = (cand_gain[p] <= cfg.min_gain_to_split) | (cand_gain[p] == -jnp.inf)
+            done = done | no_split
+
+            def do_split(args):
+                (tree, node_of_row, hists, depth, best_gain, best_f, best_b,
+                 num_nodes) = args
+                f, b = best_f[p], best_b[p]
+                cat = is_cat_f[f]
+                nl_id, nr_id = num_nodes, num_nodes + 1
+                col = bins[jnp.arange(n), jnp.broadcast_to(f, (n,))]
+                go_left = jnp.where(cat, col == b, col <= b)
+                in_p = node_of_row == p
+                node_of_row2 = jnp.where(
+                    in_p, jnp.where(go_left, nl_id, nr_id), node_of_row
+                )
+                lh = hist_for(sample_mask * (node_of_row2 == nl_id))
+                rh = hists[p] - lh
+                hists2 = hists.at[nl_id].set(lh).at[nr_id].set(rh)
+                tree2 = tree._replace(
+                    feature=tree.feature.at[p].set(f),
+                    threshold_bin=tree.threshold_bin.at[p].set(b),
+                    is_categorical=tree.is_categorical.at[p].set(cat),
+                    left=tree.left.at[p].set(nl_id),
+                    right=tree.right.at[p].set(nr_id),
+                    is_leaf=tree.is_leaf.at[p].set(False).at[nl_id].set(True).at[nr_id].set(True),
+                    gain=tree.gain.at[p].set(best_gain[p]),
+                )
+                depth2 = depth.at[nl_id].set(depth[p] + 1).at[nr_id].set(depth[p] + 1)
+                # refresh cached best splits for the two new leaves
+                ng2, nh2, nc2 = node_totals(hists2)
+                gl_, fl_, bl_ = best_split_of(hists2[nl_id], ng2[nl_id], nh2[nl_id], nc2[nl_id])
+                gr_, fr_, br_ = best_split_of(hists2[nr_id], ng2[nr_id], nh2[nr_id], nc2[nr_id])
+                best_gain2 = best_gain.at[nl_id].set(gl_).at[nr_id].set(gr_).at[p].set(-jnp.inf)
+                best_f2 = best_f.at[nl_id].set(fl_).at[nr_id].set(fr_)
+                best_b2 = best_b.at[nl_id].set(bl_).at[nr_id].set(br_)
+                return (tree2, node_of_row2, hists2, depth2, best_gain2,
+                        best_f2, best_b2, num_nodes + 2)
+
+            def no_op(args):
+                return args
+
+            (tree, node_of_row, hists, depth, best_gain, best_f, best_b,
+             num_nodes) = jax.lax.cond(
+                done,
+                no_op,
+                do_split,
+                (tree, node_of_row, hists, depth, best_gain, best_f, best_b, num_nodes),
+            )
+            return (tree, node_of_row, hists, depth, best_gain, best_f, best_b,
+                    num_nodes, done)
+
+        state = (tree, node_of_row, hists, depth, best_gain, best_f, best_b,
+                 jnp.int32(1), jnp.asarray(False))
+        state = jax.lax.fori_loop(0, nl - 1, step, state)
+        (tree, node_of_row, hists, depth, best_gain, best_f, best_b,
+         num_nodes, done) = state
+
+        # leaf values (shrunk), from final per-node totals
+        ng, nh, nc = node_totals(hists)
+        leaf_val = -_l1_threshold(ng, cfg.lambda_l1) / (nh + cfg.lambda_l2 + 1e-12)
+        leaf_val = jnp.where(tree.is_leaf, leaf_val * cfg.learning_rate, 0.0)
+        tree = tree._replace(value=leaf_val.astype(jnp.float32))
+        per_row_value = tree.value[node_of_row]
+        return tree, per_row_value
+
+    if mesh is not None and mesh.shape.get(DATA_AXIS, 1) > 1:
+        row = P(DATA_AXIS)
+        grow_sharded = functools.partial(grow, axis_name=DATA_AXIS)
+        fn = shard_map(
+            grow_sharded,
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS, None), row, row, row, P()),
+            out_specs=(
+                TreeArrays(*([P()] * len(TreeArrays._fields))),
+                row,
+            ),
+        )
+        return jax.jit(fn)
+    return jax.jit(functools.partial(grow, axis_name=None))

@@ -1,0 +1,310 @@
+"""GBDT pipeline stages: the LightGBMClassifier / LightGBMRegressor surface.
+
+Reference: src/lightgbm/src/main/scala/LightGBMClassifier.scala:27-158,
+LightGBMRegressor.scala:38-156, LightGBMParams.scala:11-149 (shared params),
+TrainParams.scala:8-74. Param names keep the reference's spelling so a
+reference user finds what they expect; `LightGBMClassifier`/`LightGBMRegressor`
+aliases are exported for drop-in familiarity.
+
+TPU redesign notes: there is no coalesce-to-workers / socket rendezvous
+(LightGBMClassifier.scala:50-52, LightGBMUtils.scala:97-136) — the mesh from
+mmlspark_tpu.parallel is the only distribution mechanism, and passing
+`use_mesh=True` shards rows over the DATA axis with psum histogram merge.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from ..core.params import (
+    HasFeaturesCol,
+    HasLabelCol,
+    HasPredictionCol,
+    HasWeightCol,
+    Param,
+)
+from ..core.pipeline import Estimator, Model
+from ..core.schema import SCORE_KIND, Table
+from ..core.serialize import register_stage
+from ..parallel.mesh import get_mesh
+from .booster import Booster, TrainOptions
+
+__all__ = [
+    "GBDTClassifier",
+    "GBDTClassificationModel",
+    "GBDTRegressor",
+    "GBDTRegressionModel",
+    "LightGBMClassifier",
+    "LightGBMRegressor",
+]
+
+
+class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
+    """Shared training params (reference LightGBMParams.scala:11-149)."""
+
+    boosting_type = Param("gbdt", "gbdt|rf|dart|goss", ptype=str)
+    num_iterations = Param(100, "number of boosting rounds", ptype=int)
+    learning_rate = Param(0.1, "shrinkage rate", ptype=float)
+    num_leaves = Param(31, "max leaves per tree", ptype=int)
+    max_bin = Param(255, "max histogram bins per feature", ptype=int)
+    max_depth = Param(-1, "max tree depth (<=0 unlimited)", ptype=int)
+    min_data_in_leaf = Param(20, "min rows per leaf", ptype=int)
+    min_sum_hessian_in_leaf = Param(1e-3, "min hessian sum per leaf", ptype=float)
+    lambda_l1 = Param(0.0, "L1 regularization", ptype=float)
+    lambda_l2 = Param(0.0, "L2 regularization", ptype=float)
+    min_gain_to_split = Param(0.0, "min split gain", ptype=float)
+    bagging_fraction = Param(1.0, "row subsample fraction", ptype=float)
+    bagging_freq = Param(0, "bagging frequency (0=off)", ptype=int)
+    bagging_seed = Param(3, "bagging rng seed", ptype=int)
+    feature_fraction = Param(1.0, "feature subsample fraction per tree", ptype=float)
+    early_stopping_round = Param(0, "stop if no val improvement for N rounds", ptype=int)
+    validation_fraction = Param(0.0, "fraction of rows held out for early stopping", ptype=float)
+    categorical_slot_indexes = Param((), "indexes of categorical feature slots", ptype=(list, tuple))
+    model_string = Param(None, "warm-start model text (reference modelString)", ptype=str)
+    boost_from_average = Param(True, "init score from label average", ptype=bool)
+    use_mesh = Param(False, "shard rows over the data mesh axis (psum histograms)", ptype=bool)
+    verbosity = Param(1, "logging verbosity", ptype=int)
+    seed = Param(0, "master rng seed", ptype=int)
+
+    def _train_options(self, objective: str, num_class: int = 1) -> TrainOptions:
+        init_model = None
+        if self.get("model_string"):
+            init_model = Booster.from_text(self.get("model_string"))
+        return TrainOptions(
+            objective=objective,
+            boosting_type=self.get("boosting_type"),
+            num_iterations=self.get("num_iterations"),
+            learning_rate=self.get("learning_rate"),
+            num_leaves=self.get("num_leaves"),
+            max_bin=self.get("max_bin"),
+            max_depth=self.get("max_depth"),
+            min_data_in_leaf=self.get("min_data_in_leaf"),
+            min_sum_hessian_in_leaf=self.get("min_sum_hessian_in_leaf"),
+            lambda_l1=self.get("lambda_l1"),
+            lambda_l2=self.get("lambda_l2"),
+            min_gain_to_split=self.get("min_gain_to_split"),
+            bagging_fraction=self.get("bagging_fraction"),
+            bagging_freq=self.get("bagging_freq"),
+            bagging_seed=self.get("bagging_seed"),
+            feature_fraction=self.get("feature_fraction"),
+            early_stopping_round=self.get("early_stopping_round"),
+            categorical_indexes=tuple(self.get("categorical_slot_indexes") or ()),
+            num_class=num_class,
+            boost_from_average=self.get("boost_from_average"),
+            init_model=init_model,
+            seed=self.get("seed"),
+        )
+
+    def _fit_arrays(self, table: Table):
+        x = np.asarray(table[self.get("features_col")], dtype=np.float64)
+        if x.ndim == 1:
+            x = x[:, None]
+        y = np.asarray(table[self.get("label_col")], dtype=np.float64)
+        w = None
+        wc = self.get("weight_col")
+        if wc:
+            w = np.asarray(table[wc], dtype=np.float64)
+        valid = None
+        vf = self.get("validation_fraction") or 0.0
+        if vf > 0 and self.get("early_stopping_round"):
+            rng = np.random.default_rng(self.get("seed"))
+            perm = rng.permutation(len(x))
+            cut = int(round(vf * len(x)))
+            vi, ti = perm[:cut], perm[cut:]
+            valid = (x[vi], y[vi])
+            x, y = x[ti], y[ti]
+            if w is not None:
+                w = w[ti]
+        mesh = get_mesh() if self.get("use_mesh") else None
+        return x, y, w, valid, mesh
+
+    def _log(self):
+        if self.get("verbosity") and self.get("verbosity") > 0:
+            from ..core.logging import get_logger
+
+            return get_logger(type(self).__name__).info
+        return None
+
+
+class _BoosterModelMixin:
+    """Fitted-model persistence shared by the two model classes."""
+
+    def _save_state(self) -> dict[str, Any]:
+        return {"booster_text": self.booster.to_text()}
+
+    def _load_state(self, state: dict[str, Any]) -> None:
+        self.booster = Booster.from_text(state["booster_text"])
+
+    def save_native_model(self, path: str) -> None:
+        """Reference: LightGBMClassificationModel.saveNativeModel
+        (LightGBMClassifier.scala:148-151)."""
+        self.booster.save_native_model(path)
+
+    def get_feature_importances(self, importance_type: str = "split") -> list[float]:
+        return list(self.booster.feature_importances(importance_type))
+
+
+@register_stage
+class GBDTClassifier(_GBDTParams, Estimator):
+    """Distributed histogram-GBDT classifier (reference LightGBMClassifier,
+    src/lightgbm/src/main/scala/LightGBMClassifier.scala:27-94)."""
+
+    raw_prediction_col = Param("raw_prediction", "margin scores output column", ptype=str)
+    probability_col = Param("probability", "probability output column", ptype=str)
+    is_unbalance = Param(False, "reweight classes by inverse frequency", ptype=bool)
+    objective = Param("binary", "binary|multiclass (auto-upgraded by label arity)", ptype=str)
+
+    def _fit(self, table: Table) -> "GBDTClassificationModel":
+        x, y, w, valid, mesh = self._fit_arrays(table)
+        # class set must span train AND holdout rows, else a class seen only
+        # in the holdout gets a wrong/overflowing id in the early-stop loss
+        all_labels = y if valid is None else np.concatenate([y, valid[1]])
+        classes = np.unique(all_labels)
+        y_idx = np.searchsorted(classes, y).astype(np.float64)
+        if valid is not None:
+            valid = (valid[0], np.searchsorted(classes, valid[1]).astype(np.float64))
+        num_class = len(classes)
+        if self.is_set("objective"):
+            objective = self.get("objective")
+            if objective == "binary" and num_class > 2:
+                raise ValueError(f"objective='binary' but {num_class} classes found")
+        else:
+            objective = "binary" if num_class <= 2 else "multiclass"
+        opts = self._train_options(objective, num_class=num_class)
+        opts.is_unbalance = self.get("is_unbalance")
+        booster = Booster.train(
+            x, y_idx, opts, weights=w, valid=valid, mesh=mesh, log=self._log()
+        )
+        booster.class_labels = [float(c) for c in classes]
+        model = GBDTClassificationModel(
+            features_col=self.get("features_col"),
+            prediction_col=self.get("prediction_col"),
+            raw_prediction_col=self.get("raw_prediction_col"),
+            probability_col=self.get("probability_col"),
+        )
+        model.booster = booster
+        model.classes = classes
+        return model
+
+
+@register_stage
+class GBDTClassificationModel(_BoosterModelMixin, HasFeaturesCol, HasPredictionCol, Model):
+    """Reference: LightGBMClassificationModel (LightGBMClassifier.scala:98-158)
+    — but scoring is one jitted batched traversal, not per-row JNI calls."""
+
+    raw_prediction_col = Param("raw_prediction", "margin scores output column", ptype=str)
+    probability_col = Param("probability", "probability output column", ptype=str)
+
+    booster: Booster | None = None
+    classes: np.ndarray | None = None
+
+    def _transform(self, table: Table) -> Table:
+        x = np.asarray(table[self.get("features_col")], dtype=np.float64)
+        if x.ndim == 1:
+            x = x[:, None]
+        raw = self.booster.predict_raw(x)
+        prob = self.booster.predict(x)
+        if raw.ndim == 1:  # binary: present as (n, 2) like the reference
+            prob2 = np.stack([1.0 - prob, prob], axis=1)
+            raw2 = np.stack([-raw, raw], axis=1)
+            idx = (prob >= 0.5).astype(int)
+        else:
+            prob2, raw2 = prob, raw
+            idx = np.argmax(prob, axis=1)
+        labels = self.classes[idx] if self.classes is not None else idx
+        out = table.with_column(
+            self.get("raw_prediction_col"), raw2, meta={SCORE_KIND: "raw_prediction"}
+        )
+        cls_meta = None if self.classes is None else [float(c) for c in self.classes]
+        out = out.with_column(
+            self.get("probability_col"),
+            prob2,
+            meta={SCORE_KIND: "probability", "class_labels": cls_meta},
+        )
+        return out.with_column(
+            self.get("prediction_col"), labels.astype(np.float64), meta={SCORE_KIND: "prediction"}
+        )
+
+    def _save_state(self) -> dict[str, Any]:
+        st = _BoosterModelMixin._save_state(self)
+        st["classes"] = None if self.classes is None else self.classes.tolist()
+        return st
+
+    def _load_state(self, state: dict[str, Any]) -> None:
+        _BoosterModelMixin._load_state(self, state)
+        self.classes = None if state.get("classes") is None else np.asarray(state["classes"])
+
+    @staticmethod
+    def load_native_model(path: str, **cols) -> "GBDTClassificationModel":
+        """Reference: LightGBMClassificationModel.loadNativeModelFromFile
+        (LightGBMClassifier.scala:160-184)."""
+        booster = Booster.load_native_model(path)
+        model = GBDTClassificationModel(**cols)
+        model.booster = booster
+        if booster.class_labels is not None:
+            model.classes = np.asarray(booster.class_labels, np.float64)
+        else:
+            k = booster.num_class if booster.num_class > 1 else 2
+            model.classes = np.arange(k, dtype=np.float64)
+        return model
+
+
+@register_stage
+class GBDTRegressor(_GBDTParams, Estimator):
+    """Reference: LightGBMRegressor (LightGBMRegressor.scala:38-101) with the
+    full objective set of :17-36."""
+
+    objective = Param(
+        "regression",
+        "regression|l1|l2|huber|fair|poisson|quantile|mape|gamma|tweedie",
+        ptype=str,
+    )
+    alpha = Param(0.9, "huber/quantile alpha", ptype=float)
+    tweedie_variance_power = Param(1.5, "tweedie variance power (1..2)", ptype=float)
+    fair_c = Param(1.0, "fair-loss c", ptype=float)
+
+    def _fit(self, table: Table) -> "GBDTRegressionModel":
+        x, y, w, valid, mesh = self._fit_arrays(table)
+        opts = self._train_options(self.get("objective"))
+        opts.alpha = self.get("alpha")
+        opts.tweedie_variance_power = self.get("tweedie_variance_power")
+        opts.fair_c = self.get("fair_c")
+        booster = Booster.train(
+            x, y, opts, weights=w, valid=valid, mesh=mesh, log=self._log()
+        )
+        model = GBDTRegressionModel(
+            features_col=self.get("features_col"),
+            prediction_col=self.get("prediction_col"),
+        )
+        model.booster = booster
+        return model
+
+
+@register_stage
+class GBDTRegressionModel(_BoosterModelMixin, HasFeaturesCol, HasPredictionCol, Model):
+    """Reference: LightGBMRegressionModel (LightGBMRegressor.scala:103-156)."""
+
+    booster: Booster | None = None
+
+    def _transform(self, table: Table) -> Table:
+        x = np.asarray(table[self.get("features_col")], dtype=np.float64)
+        if x.ndim == 1:
+            x = x[:, None]
+        pred = self.booster.predict(x)
+        return table.with_column(
+            self.get("prediction_col"), np.asarray(pred, np.float64), meta={SCORE_KIND: "prediction"}
+        )
+
+    @staticmethod
+    def load_native_model(path: str, **cols) -> "GBDTRegressionModel":
+        booster = Booster.load_native_model(path)
+        model = GBDTRegressionModel(**cols)
+        model.booster = booster
+        return model
+
+
+# Drop-in familiar names for reference users.
+LightGBMClassifier = GBDTClassifier
+LightGBMRegressor = GBDTRegressor

@@ -1,0 +1,179 @@
+"""Boosting objectives: gradients/hessians of the training losses.
+
+Reference: objective strings accepted by the native learner — classifier
+"binary"/"multiclass" (src/lightgbm/src/main/scala/TrainParams.scala:40-74)
+and the regressor set regression/l1(mae)/l2(mse)/huber/fair/poisson/quantile/
+mape/gamma/tweedie (src/lightgbm/src/main/scala/LightGBMRegressor.scala:17-36).
+
+All are pure elementwise jnp functions of (label, raw_score) — they fuse into
+the surrounding jit and never touch the host. Each returns (grad, hess) of
+the loss wrt the raw (margin) score; sample weights scale both.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["get_objective", "sigmoid", "softmax", "init_raw_score", "OBJECTIVES"]
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def softmax(x, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+# -- binary / multiclass ----------------------------------------------------
+
+def _binary(y, raw, sigmoid_coef=1.0):
+    p = jax.nn.sigmoid(sigmoid_coef * raw)
+    grad = sigmoid_coef * (p - y)
+    hess = sigmoid_coef * sigmoid_coef * p * (1.0 - p)
+    return grad, hess
+
+
+def _multiclass(y_onehot, raw):
+    """raw: (n, K); y_onehot: (n, K). Diagonal-hessian softmax cross-entropy
+    (same approximation the native learner uses)."""
+    p = jax.nn.softmax(raw, axis=-1)
+    grad = p - y_onehot
+    hess = p * (1.0 - p)
+    # LightGBM scales multiclass hessians by K/(K-1) (factor from the
+    # one-tree-per-class diagonal approximation)
+    k = raw.shape[-1]
+    return grad, hess * (k / max(k - 1.0, 1.0))
+
+
+# -- regression -------------------------------------------------------------
+
+def _l2(y, raw):
+    return raw - y, jnp.ones_like(raw)
+
+
+def _l1(y, raw):
+    return jnp.sign(raw - y), jnp.ones_like(raw)
+
+
+def _huber(y, raw, alpha=0.9):
+    d = raw - y
+    grad = jnp.where(jnp.abs(d) <= alpha, d, alpha * jnp.sign(d))
+    return grad, jnp.ones_like(raw)
+
+
+def _fair(y, raw, c=1.0):
+    d = raw - y
+    denom = jnp.abs(d) + c
+    grad = c * d / denom
+    hess = c * c / (denom * denom)
+    return grad, hess
+
+
+def _poisson(y, raw, max_delta_step=0.7):
+    # loss = exp(raw) - y*raw; hessian stabilised like the native learner
+    e = jnp.exp(raw)
+    return e - y, e * jnp.exp(max_delta_step)
+
+
+def _quantile(y, raw, alpha=0.9):
+    d = raw - y
+    grad = jnp.where(d >= 0, 1.0 - alpha, -alpha)
+    return grad, jnp.ones_like(raw)
+
+
+def _mape(y, raw):
+    denom = jnp.maximum(jnp.abs(y), 1.0)
+    grad = jnp.sign(raw - y) / denom
+    return grad, jnp.ones_like(raw) / denom
+
+
+def _gamma(y, raw):
+    # negative log-likelihood of gamma with log link
+    e = jnp.exp(-raw)
+    return 1.0 - y * e, y * e
+
+
+def _tweedie(y, raw, rho=1.5):
+    e1 = jnp.exp((2.0 - rho) * raw)
+    e2 = jnp.exp((1.0 - rho) * raw)
+    grad = e1 - y * e2
+    hess = (2.0 - rho) * e1 - (1.0 - rho) * y * e2
+    return grad, hess
+
+
+OBJECTIVES: dict[str, Callable] = {
+    "binary": _binary,
+    "multiclass": _multiclass,
+    "regression": _l2,
+    "l2": _l2,
+    "mean_squared_error": _l2,
+    "mse": _l2,
+    "regression_l2": _l2,
+    "l1": _l1,
+    "mae": _l1,
+    "mean_absolute_error": _l1,
+    "regression_l1": _l1,
+    "huber": _huber,
+    "fair": _fair,
+    "poisson": _poisson,
+    "quantile": _quantile,
+    "mape": _mape,
+    "gamma": _gamma,
+    "tweedie": _tweedie,
+}
+
+
+def get_objective(name: str, **kw) -> Callable:
+    """Resolve an objective name to fn(y, raw) -> (grad, hess)."""
+    key = name.lower()
+    if key not in OBJECTIVES:
+        raise ValueError(f"unknown objective {name!r}; choose from {sorted(set(OBJECTIVES))}")
+    fn = OBJECTIVES[key]
+    if key == "huber" and "alpha" in kw:
+        return partial(_huber, alpha=kw["alpha"])
+    if key == "quantile" and "alpha" in kw:
+        return partial(_quantile, alpha=kw["alpha"])
+    if key == "tweedie" and "tweedie_variance_power" in kw:
+        return partial(_tweedie, rho=kw["tweedie_variance_power"])
+    if key == "fair" and "fair_c" in kw:
+        return partial(_fair, c=kw["fair_c"])
+    return fn
+
+
+def init_raw_score(
+    objective: str,
+    y,
+    weights=None,
+    boost_from_average: bool = True,
+    alpha: float = 0.9,
+) -> float:
+    """Initial constant raw score (reference: boost_from_average semantics).
+
+    For binary: log-odds of the base rate; for l2: weighted mean; for
+    poisson/gamma/tweedie: log of the weighted mean; else 0.
+    """
+    import numpy as np
+
+    if not boost_from_average:
+        return 0.0
+    y = np.asarray(y, dtype=np.float64)
+    w = np.ones_like(y) if weights is None else np.asarray(weights, dtype=np.float64)
+    key = objective.lower()
+    mean = float(np.sum(y * w) / max(np.sum(w), 1e-12))
+    if key == "binary":
+        p = min(max(mean, 1e-12), 1 - 1e-12)
+        return float(np.log(p / (1 - p)))
+    if key in ("regression", "l2", "mse", "mean_squared_error", "regression_l2", "huber", "fair"):
+        return mean
+    if key == "quantile":
+        return float(np.quantile(y, alpha))
+    if key in ("l1", "mae", "mean_absolute_error", "regression_l1", "mape"):
+        return float(np.median(y))
+    if key in ("poisson", "gamma", "tweedie"):
+        return float(np.log(max(mean, 1e-12)))
+    return 0.0

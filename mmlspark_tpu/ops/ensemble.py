@@ -10,7 +10,7 @@ import numpy as np
 
 from ..core.params import Param
 from ..core.pipeline import Transformer
-from ..core.schema import Table
+from ..core.schema import Table, as_scalar
 from ..core.serialize import register_stage
 
 __all__ = ["EnsembleByKey"]
@@ -36,7 +36,7 @@ class EnsembleByKey(Transformer):
             raise ValueError("col_names must align with cols")
 
         key_tuples = [
-            tuple(_scalar(table[k][i]) for k in keys) for i in range(table.num_rows)
+            tuple(as_scalar(table[k][i]) for k in keys) for i in range(table.num_rows)
         ]
         order: dict[tuple, list[int]] = {}
         for i, kt in enumerate(key_tuples):
@@ -54,7 +54,7 @@ class EnsembleByKey(Transformer):
                 if self.get("strategy") == "mean":
                     agg[name].append(np.mean(np.asarray(vals, dtype=np.float64), axis=0))
                 else:
-                    agg[name].append([_scalar(v) for v in vals])
+                    agg[name].append([as_scalar(v) for v in vals])
         grouped = Table({k: v for k, v in agg.items()})
         if self.get("collapse_group"):
             return grouped
@@ -68,5 +68,3 @@ class EnsembleByKey(Transformer):
         return out
 
 
-def _scalar(v):
-    return v.item() if hasattr(v, "item") else v

@@ -14,7 +14,7 @@ import numpy as np
 
 from ..core.params import Param
 from ..core.pipeline import Estimator, Model, Transformer
-from ..core.schema import CATEGORY_VALUES, Table
+from ..core.schema import CATEGORY_VALUES, Table, as_scalar
 from ..core.serialize import register_stage
 
 __all__ = ["ValueIndexer", "ValueIndexerModel", "IndexToValue"]
@@ -40,7 +40,7 @@ class ValueIndexer(Estimator):
 
     def _fit(self, table: Table) -> "ValueIndexerModel":
         col = table[self.get("input_col")]
-        vals = [v.item() if hasattr(v, "item") else v for v in col]
+        vals = [as_scalar(v) for v in col]
         non_null = sorted({v for v in vals if not _is_null(v)})
         has_null = any(_is_null(v) for v in vals)
         m = ValueIndexerModel()
@@ -63,7 +63,7 @@ class ValueIndexerModel(Model):
         null_index = len(self.levels)
         out = np.empty(table.num_rows, dtype=np.int32)
         for i, v in enumerate(table[self.get("input_col")]):
-            key = v.item() if hasattr(v, "item") else v
+            key = as_scalar(v)
             if _is_null(key):
                 out[i] = null_index
             elif key in lookup:

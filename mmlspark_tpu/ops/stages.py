@@ -19,7 +19,7 @@ import numpy as np
 
 from ..core.params import Param
 from ..core.pipeline import Estimator, Model, Transformer
-from ..core.schema import Table
+from ..core.schema import Table, as_scalar
 from ..core.serialize import register_stage
 
 __all__ = [
@@ -112,6 +112,28 @@ class Explode(Transformer):
         return base.with_column(out_name, exploded)
 
 
+
+def _fn_to_path(fn, owner: str) -> str:
+    """Serialize an importable module-level function as "module:qualname"."""
+    mod, name = getattr(fn, "__module__", None), getattr(fn, "__qualname__", None)
+    if not mod or not name or "<" in (name or ""):
+        raise TypeError(
+            f"{owner} is only serializable when the function is an importable "
+            "module-level function"
+        )
+    return f"{mod}:{name}"
+
+
+def _fn_from_path(path: str):
+    import importlib
+
+    mod, name = path.split(":")
+    fn = importlib.import_module(mod)
+    for part in name.split("."):
+        fn = getattr(fn, part)
+    return fn
+
+
 @register_stage
 class Lambda(Transformer):
     """Arbitrary Table -> Table function as a stage.
@@ -134,19 +156,10 @@ class Lambda(Transformer):
         return d
 
     def _save_state(self) -> dict[str, Any]:
-        fn = self.get("fn")
-        mod, name = getattr(fn, "__module__", None), getattr(fn, "__qualname__", None)
-        if not mod or not name or "<" in (name or ""):
-            raise TypeError(
-                "Lambda is only serializable when fn is an importable module-level function"
-            )
-        return {"fn_path": f"{mod}:{name}"}
+        return {"fn_path": _fn_to_path(self.get("fn"), "Lambda")}
 
     def _load_state(self, state: dict[str, Any]) -> None:
-        import importlib
-
-        mod, name = state["fn_path"].split(":")
-        self.set(fn=getattr(importlib.import_module(mod), name))
+        self.set(fn=_fn_from_path(state["fn_path"]))
 
 
 @register_stage
@@ -174,19 +187,10 @@ class UDFTransformer(Transformer):
         return d
 
     def _save_state(self) -> dict[str, Any]:
-        fn = self.get("udf")
-        mod, name = getattr(fn, "__module__", None), getattr(fn, "__qualname__", None)
-        if not mod or not name or "<" in (name or ""):
-            raise TypeError(
-                "UDFTransformer is only serializable with an importable module-level udf"
-            )
-        return {"fn_path": f"{mod}:{name}"}
+        return {"fn_path": _fn_to_path(self.get("udf"), "UDFTransformer")}
 
     def _load_state(self, state: dict[str, Any]) -> None:
-        import importlib
-
-        mod, name = state["fn_path"].split(":")
-        self.set(udf=getattr(importlib.import_module(mod), name))
+        self.set(udf=_fn_from_path(state["fn_path"]))
 
 
 @register_stage
@@ -301,7 +305,7 @@ class ClassBalancer(Estimator):
         weights = counts.max() / counts.astype(np.float64)
         m = ClassBalancerModel()
         m.set(input_col=self.get("input_col"), output_col=self.get("output_col"))
-        m.values = [v.item() if hasattr(v, "item") else v for v in vals]
+        m.values = [as_scalar(v) for v in vals]
         m.weights = weights
         return m
 
@@ -317,7 +321,7 @@ class ClassBalancerModel(Model):
     def _transform(self, table: Table) -> Table:
         lookup = {v: w for v, w in zip(self.values, self.weights)}
         col = table[self.get("input_col")]
-        w = np.asarray([lookup[v.item() if hasattr(v, "item") else v] for v in col])
+        w = np.asarray([lookup[as_scalar(v)] for v in col])
         return table.with_column(self.get("output_col"), w)
 
     def _save_state(self) -> dict[str, Any]:

@@ -1,0 +1,325 @@
+"""GBDT engine tests.
+
+Mirrors the reference test strategy (SURVEY.md §4): functional suites like
+src/lightgbm/src/test/scala/VerifyLightGBMClassifier.scala — quality gates on
+small datasets across boosting types — plus save/load roundtrips (the
+SerializationFuzzing role) and a partitions-as-workers distributed check
+(mesh8 = the reference's repartition(2) trick, done with 8 CPU devices).
+"""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.schema import Table
+from mmlspark_tpu.gbdt import (
+    Booster,
+    GBDTClassifier,
+    GBDTClassificationModel,
+    GBDTRegressor,
+    GBDTRegressionModel,
+)
+from mmlspark_tpu.gbdt.binning import BinMapper
+from mmlspark_tpu.gbdt.booster import TrainOptions
+
+
+def make_classification(n=2000, f=10, seed=0, classes=2):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, f))
+    logits = x[:, 0] * 2.0 + x[:, 1] - 0.5 * x[:, 2] + 0.3 * rng.normal(size=n)
+    if classes == 2:
+        y = (logits > 0).astype(np.float64)
+    else:
+        y = np.digitize(logits, np.quantile(logits, np.linspace(0, 1, classes + 1)[1:-1]))
+    return x, y.astype(np.float64)
+
+
+def make_regression(n=2000, f=8, seed=1):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, f))
+    y = 3.0 * x[:, 0] - 2.0 * x[:, 1] + np.sin(x[:, 2]) + 0.1 * rng.normal(size=n)
+    return x, y
+
+
+def table_of(x, y, weight=None):
+    cols = {"features": x, "label": y}
+    if weight is not None:
+        cols["weight"] = weight
+    return Table(cols)
+
+
+# --------------------------------------------------------------------- #
+# binning                                                               #
+# --------------------------------------------------------------------- #
+
+class TestBinMapper:
+    def test_roundtrip_order(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(500, 3))
+        bm = BinMapper(max_bin=16).fit(x)
+        b = bm.transform(x)
+        assert b.shape == x.shape and b.dtype == np.int32
+        # binning preserves order within a feature
+        for j in range(3):
+            order = np.argsort(x[:, j])
+            assert (np.diff(b[order, j]) >= 0).all()
+        assert b.min() >= 1  # no NaNs -> nothing in the missing bin
+
+    def test_missing_goes_to_bin0(self):
+        x = np.array([[1.0], [np.nan], [2.0]])
+        bm = BinMapper(max_bin=4).fit(x)
+        b = bm.transform(x)
+        assert b[1, 0] == 0 and b[0, 0] >= 1
+
+    def test_categorical_frequency_bins(self):
+        x = np.array([[5.0]] * 10 + [[7.0]] * 5 + [[9.0]] * 1)
+        bm = BinMapper(max_bin=8, categorical_indexes=(0,)).fit(x)
+        b = bm.transform(x)
+        assert b[0, 0] == 1  # most frequent category -> bin 1
+        assert b[10, 0] == 2
+        unseen = bm.transform(np.array([[123.0]]))
+        assert unseen[0, 0] == 0  # unseen -> "other" bin
+
+    def test_serialization(self):
+        x = np.random.default_rng(0).normal(size=(200, 4))
+        bm = BinMapper(max_bin=32).fit(x)
+        bm2 = BinMapper.from_dict(bm.to_dict())
+        assert np.array_equal(bm.transform(x), bm2.transform(x))
+
+
+# --------------------------------------------------------------------- #
+# booster core                                                          #
+# --------------------------------------------------------------------- #
+
+class TestBooster:
+    def test_binary_quality(self):
+        x, y = make_classification()
+        opts = TrainOptions(objective="binary", num_iterations=30, num_leaves=15)
+        b = Booster.train(x, y, opts)
+        acc = ((b.predict(x) >= 0.5) == y).mean()
+        assert acc > 0.95
+
+    def test_regression_quality(self):
+        x, y = make_regression()
+        opts = TrainOptions(objective="regression", num_iterations=50, num_leaves=31)
+        b = Booster.train(x, y, opts)
+        rmse = np.sqrt(np.mean((b.predict(x) - y) ** 2))
+        assert rmse < 0.8, rmse
+
+    def test_multiclass(self):
+        x, y = make_classification(classes=4)
+        opts = TrainOptions(
+            objective="multiclass", num_class=4, num_iterations=20, num_leaves=15
+        )
+        b = Booster.train(x, y, opts)
+        p = b.predict(x)
+        assert p.shape == (len(x), 4)
+        np.testing.assert_allclose(p.sum(axis=1), 1.0, atol=1e-5)
+        acc = (np.argmax(p, 1) == y).mean()
+        assert acc > 0.85, acc
+
+    @pytest.mark.parametrize("boosting", ["goss", "dart", "rf"])
+    def test_boosting_modes(self, boosting):
+        x, y = make_classification(n=1500)
+        opts = TrainOptions(
+            objective="binary",
+            boosting_type=boosting,
+            num_iterations=25,
+            num_leaves=15,
+            bagging_fraction=0.8,
+            bagging_freq=1,
+        )
+        b = Booster.train(x, y, opts)
+        acc = ((b.predict(x) >= 0.5) == y).mean()
+        assert acc > 0.85, (boosting, acc)
+
+    @pytest.mark.parametrize(
+        "objective", ["l1", "huber", "fair", "poisson", "quantile", "mape", "gamma", "tweedie"]
+    )
+    def test_regression_objectives_run(self, objective):
+        x, y = make_regression(n=800)
+        if objective in ("poisson", "gamma", "tweedie", "mape"):
+            y = np.abs(y) + 1.0
+        opts = TrainOptions(objective=objective, num_iterations=10, num_leaves=7)
+        b = Booster.train(x, y, opts)
+        pred = b.predict(x)
+        assert np.isfinite(pred).all()
+
+    def test_quantile_coverage(self):
+        x, y = make_regression(n=2000)
+        for alpha in (0.1, 0.9):
+            opts = TrainOptions(
+                objective="quantile", alpha=alpha, num_iterations=40, num_leaves=15
+            )
+            b = Booster.train(x, y, opts)
+            cover = (y <= b.predict(x)).mean()
+            assert abs(cover - alpha) < 0.12, (alpha, cover)
+
+    def test_weights_shift_model(self):
+        x, y = make_classification(n=1000)
+        w_hi = np.where(y == 1, 10.0, 1.0)
+        opts = TrainOptions(objective="binary", num_iterations=10, num_leaves=7)
+        b0 = Booster.train(x, y, opts)
+        b1 = Booster.train(x, y, opts, weights=w_hi)
+        # upweighting positives must raise mean predicted probability
+        assert b1.predict(x).mean() > b0.predict(x).mean()
+
+    def test_early_stopping(self):
+        x, y = make_classification(n=1500)
+        opts = TrainOptions(
+            objective="binary",
+            num_iterations=200,
+            num_leaves=31,
+            early_stopping_round=5,
+        )
+        b = Booster.train(x[:1200], y[:1200], opts, valid=(x[1200:], y[1200:]))
+        assert b.num_trees < 200
+        assert b.best_iteration >= 0
+        # trees after the best iteration must be dropped from the model
+        assert b.num_trees == b.best_iteration + 1
+
+    def test_warm_start(self):
+        x, y = make_classification()
+        opts1 = TrainOptions(objective="binary", num_iterations=5, num_leaves=15)
+        b1 = Booster.train(x, y, opts1)
+        opts2 = TrainOptions(
+            objective="binary", num_iterations=15, num_leaves=15, init_model=b1
+        )
+        b2 = Booster.train(x, y, opts2)
+        assert b2.num_trees == 15
+        acc1 = ((b1.predict(x) >= 0.5) == y).mean()
+        acc2 = ((b2.predict(x) >= 0.5) == y).mean()
+        assert acc2 >= acc1
+
+    def test_text_roundtrip(self):
+        x, y = make_classification(n=500)
+        opts = TrainOptions(objective="binary", num_iterations=5, num_leaves=7)
+        b = Booster.train(x, y, opts)
+        b2 = Booster.from_text(b.to_text())
+        np.testing.assert_allclose(b.predict_raw(x), b2.predict_raw(x), rtol=1e-6)
+
+    def test_feature_importances(self):
+        x, y = make_regression()
+        opts = TrainOptions(objective="regression", num_iterations=10, num_leaves=15)
+        b = Booster.train(x, y, opts)
+        imp = b.feature_importances("split")
+        gain = b.feature_importances("gain")
+        # features 0 and 1 carry the signal
+        assert imp[0] + imp[1] > imp[3:].sum()
+        assert gain[0] > 0
+
+    def test_categorical_feature(self):
+        rng = np.random.default_rng(3)
+        cat = rng.integers(0, 5, size=2000).astype(np.float64)
+        noise = rng.normal(size=2000)
+        y = np.isin(cat, [1.0, 3.0]).astype(np.float64)
+        x = np.stack([cat, noise], axis=1)
+        opts = TrainOptions(
+            objective="binary",
+            num_iterations=20,
+            num_leaves=7,
+            categorical_indexes=(0,),
+            min_data_in_leaf=5,
+        )
+        b = Booster.train(x, y, opts)
+        acc = ((b.predict(x) >= 0.5) == y).mean()
+        assert acc > 0.98, acc
+
+    def test_mesh_training_matches_single_device(self, mesh8):
+        x, y = make_classification(n=1024)
+        opts = TrainOptions(objective="binary", num_iterations=8, num_leaves=15)
+        b_single = Booster.train(x, y, opts)
+        b_mesh = Booster.train(x, y, opts, mesh=mesh8)
+        a1 = ((b_single.predict(x) >= 0.5) == y).mean()
+        a2 = ((b_mesh.predict(x) >= 0.5) == y).mean()
+        assert a2 > 0.9
+        # same histogram sums -> near-identical models (float reduction order
+        # may differ); predictions must agree closely
+        np.testing.assert_allclose(
+            b_single.predict_raw(x), b_mesh.predict_raw(x), rtol=1e-3, atol=1e-3
+        )
+
+
+# --------------------------------------------------------------------- #
+# estimator stages                                                      #
+# --------------------------------------------------------------------- #
+
+class TestEstimators:
+    def test_classifier_pipeline(self):
+        x, y = make_classification(n=1200)
+        t = table_of(x, y)
+        est = GBDTClassifier(num_iterations=15, num_leaves=15)
+        model = est.fit(t)
+        out = model.transform(t)
+        assert "prediction" in out and "probability" in out and "raw_prediction" in out
+        acc = (out["prediction"] == y).mean()
+        assert acc > 0.93
+        assert out["probability"].shape == (1200, 2)
+
+    def test_classifier_string_labelish_classes(self):
+        # non-contiguous numeric labels must map back to original values
+        x, y = make_classification(n=800)
+        y = np.where(y == 1, 7.0, 3.0)
+        t = table_of(x, y)
+        model = GBDTClassifier(num_iterations=10, num_leaves=7).fit(t)
+        out = model.transform(t)
+        assert set(np.unique(out["prediction"])) <= {3.0, 7.0}
+        assert (out["prediction"] == y).mean() > 0.9
+
+    def test_regressor_pipeline(self):
+        x, y = make_regression(n=1200)
+        t = table_of(x, y)
+        model = GBDTRegressor(num_iterations=30, num_leaves=15).fit(t)
+        out = model.transform(t)
+        rmse = np.sqrt(np.mean((out["prediction"] - y) ** 2))
+        assert rmse < 1.0
+
+    def test_save_load_stage(self, tmp_path):
+        x, y = make_classification(n=600)
+        t = table_of(x, y)
+        model = GBDTClassifier(num_iterations=5, num_leaves=7).fit(t)
+        p = str(tmp_path / "gbdt_model")
+        model.save(p)
+        loaded = GBDTClassificationModel.load(p)
+        assert model.transform(t).equals(loaded.transform(t))
+
+    def test_native_model_roundtrip(self, tmp_path):
+        x, y = make_regression(n=600)
+        t = table_of(x, y)
+        model = GBDTRegressor(num_iterations=5, num_leaves=7).fit(t)
+        p = str(tmp_path / "model.txt")
+        model.save_native_model(p)
+        loaded = GBDTRegressionModel.load_native_model(p)
+        np.testing.assert_allclose(
+            model.transform(t)["prediction"], loaded.transform(t)["prediction"], rtol=1e-6
+        )
+
+    def test_weight_col(self):
+        x, y = make_classification(n=800)
+        w = np.ones(len(y))
+        t = table_of(x, y, weight=w)
+        model = GBDTClassifier(num_iterations=5, num_leaves=7, weight_col="weight").fit(t)
+        out = model.transform(t)
+        assert (out["prediction"] == y).mean() > 0.85
+
+    def test_native_model_preserves_classes(self, tmp_path):
+        x, y = make_classification(n=600)
+        y = np.where(y == 1, 7.0, 3.0)
+        t = table_of(x, y)
+        model = GBDTClassifier(num_iterations=5, num_leaves=7).fit(t)
+        p = str(tmp_path / "clf.txt")
+        model.save_native_model(p)
+        loaded = GBDTClassificationModel.load_native_model(p)
+        assert set(np.unique(loaded.transform(t)["prediction"])) <= {3.0, 7.0}
+        np.testing.assert_array_equal(
+            model.transform(t)["prediction"], loaded.transform(t)["prediction"]
+        )
+
+    def test_model_string_warm_start(self):
+        x, y = make_classification(n=800)
+        t = table_of(x, y)
+        m1 = GBDTClassifier(num_iterations=5, num_leaves=7).fit(t)
+        est2 = GBDTClassifier(
+            num_iterations=10, num_leaves=7, model_string=m1.booster.to_text()
+        )
+        m2 = est2.fit(t)
+        assert m2.booster.num_trees == 10
