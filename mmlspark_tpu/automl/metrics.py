@@ -138,7 +138,11 @@ class ComputeModelStatistics(Transformer):
             return False
         if has_prob:
             return True
-        if table.meta(self.get("scored_labels_col")).get(SCORE_KIND) == "prediction":
+        labels_kind = table.meta(self.get("scored_labels_col")).get(SCORE_KIND)
+        if labels_kind == "predicted_label":
+            # classifier-tagged labels (probability column may have been dropped)
+            return True
+        if labels_kind == "prediction":
             # tagged prediction without probabilities: regressor output
             return False
         # all integral labels with few distinct values -> classification

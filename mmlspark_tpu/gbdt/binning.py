@@ -96,7 +96,17 @@ class BinMapper:
             col = x[:, j]
             if j in cat:
                 cmap = self.category_maps[j]
-                out[:, j] = [cmap.get(float(v), MISSING_BIN) if np.isfinite(v) else MISSING_BIN for v in col]
+                if not cmap:
+                    continue
+                keys = np.fromiter(cmap.keys(), np.float64, len(cmap))
+                bins_of = np.fromiter(cmap.values(), np.int32, len(cmap))
+                order = np.argsort(keys)
+                keys, bins_of = keys[order], bins_of[order]
+                safe = np.where(np.isfinite(col), col, np.inf)
+                idx = np.searchsorted(keys, safe)
+                idx_c = np.minimum(idx, len(keys) - 1)
+                hit = (idx < len(keys)) & (keys[idx_c] == safe)
+                out[:, j] = np.where(hit, bins_of[idx_c], MISSING_BIN)
                 continue
             nb = int(self.num_bins[j])
             if nb <= 1:

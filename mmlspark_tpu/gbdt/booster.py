@@ -186,11 +186,19 @@ class Booster:
         prev_trees: list[dict[str, np.ndarray]] = []
         start_iter = 0
         if warm is not None:
-            raw = warm.predict_raw(x)
-            raw_p = np.concatenate([raw, np.zeros((pad,) + raw.shape[1:])])
-            pred = jnp.asarray(raw_p, jnp.float32).reshape(pred.shape)
-            for t in range(warm.feature.shape[0]):
-                prev_trees.append(warm._tree_dict(t))
+            if opts.boosting_type == "rf":
+                # rf trees are independent of pred (bagged averages): keep
+                # pred at init, and UNDO the 1/T_prev scale baked into the
+                # saved trees so the final uniform 1/T_total rescale is right.
+                n_prev = max(warm.feature.shape[0] // k, 1)
+                for t in range(warm.feature.shape[0]):
+                    prev_trees.append(_scale_tree(warm._tree_dict(t), float(n_prev)))
+            else:
+                raw = warm.predict_raw(x)
+                raw_p = np.concatenate([raw, np.zeros((pad,) + raw.shape[1:])])
+                pred = jnp.asarray(raw_p, jnp.float32).reshape(pred.shape)
+                for t in range(warm.feature.shape[0]):
+                    prev_trees.append(warm._tree_dict(t))
             start_iter = len(prev_trees) // k
 
         @jax.jit
@@ -201,9 +209,20 @@ class Booster:
             g, h = obj_fn(y_dev, pred)
             return g, h
 
-        rng = np.random.default_rng(opts.bagging_seed)
-        frng = np.random.default_rng(opts.feature_fraction_seed)
-        drng = np.random.default_rng(opts.drop_seed)
+        # reference semantics: a nonzero top-level `seed` deterministically
+        # derives the per-purpose seeds (LightGBM Config: seed generates
+        # bagging/feature_fraction/drop seeds unless set individually)
+        bag_seed, feat_seed, drop_seed = (
+            opts.bagging_seed, opts.feature_fraction_seed, opts.drop_seed
+        )
+        if opts.seed:
+            dr = np.random.default_rng(opts.seed)
+            bag_seed, feat_seed, drop_seed = (
+                int(dr.integers(2**31)) for _ in range(3)
+            )
+        rng = np.random.default_rng(bag_seed)
+        frng = np.random.default_rng(feat_seed)
+        drng = np.random.default_rng(drop_seed)
 
         use_goss = opts.boosting_type == "goss"
         use_bagging = (
@@ -285,15 +304,39 @@ class Booster:
 
             @jax.jit
             def val_loss_of(raw):
-                if opts.objective == "binary":
+                # each objective is tracked on its OWN loss: raw is a
+                # log-space margin for poisson/gamma/tweedie (pred=exp(raw)),
+                # a quantile margin for quantile, etc. — MSE on raw would
+                # stop training at an arbitrary iteration for those.
+                obj = opts.objective
+                if obj == "binary":
                     p = jax.nn.sigmoid(raw)
                     eps = 1e-7
                     return -jnp.mean(
                         yv_dev * jnp.log(p + eps) + (1 - yv_dev) * jnp.log(1 - p + eps)
                     )
-                if opts.objective == "multiclass":
+                if obj == "multiclass":
                     logp = jax.nn.log_softmax(raw, axis=-1)
                     return -jnp.mean(logp[jnp.arange(nv), yv_idx])
+                if obj == "poisson":
+                    return jnp.mean(jnp.exp(raw) - yv_dev * raw)
+                if obj == "gamma":
+                    return jnp.mean(raw + yv_dev * jnp.exp(-raw))
+                if obj == "tweedie":
+                    rho = opts.tweedie_variance_power
+                    return jnp.mean(
+                        -yv_dev * jnp.exp((1 - rho) * raw) / (1 - rho)
+                        + jnp.exp((2 - rho) * raw) / (2 - rho)
+                    )
+                if obj == "quantile":
+                    d = yv_dev - raw
+                    return jnp.mean(jnp.maximum(opts.alpha * d, (opts.alpha - 1) * d))
+                if obj in ("l1", "mae", "regression_l1"):
+                    return jnp.mean(jnp.abs(raw - yv_dev))
+                if obj == "mape":
+                    return jnp.mean(
+                        jnp.abs(raw - yv_dev) / jnp.maximum(jnp.abs(yv_dev), 1.0)
+                    )
                 return jnp.mean((raw - yv_dev) ** 2)
 
         bag_mask = base_mask
@@ -332,7 +375,7 @@ class Booster:
                 g, h = grad_hess(pred_round, cls)
                 mask = bag_mask
                 if use_goss:
-                    mask = base_mask * goss_mask(g, opts.bagging_seed + it)
+                    mask = base_mask * goss_mask(g, bag_seed + it)
                 tree, row_val = grow(bins_dev, g, h, mask, feat_mask)
                 if es_active:
                     contrib = tree_val_contrib(tree)
@@ -430,7 +473,8 @@ class Booster:
                 left=z(np.int32, -1), right=z(np.int32, -1),
                 value=z(np.float32), gain=z(np.float32),
                 tree_class=np.zeros(0, np.int32), bin_mapper=mapper,
-                objective=opts.objective, num_class=opts.num_class,
+                objective=opts.objective,
+                num_class=opts.num_class if opts.objective == "multiclass" else 1,
                 init_score=init, feature_names=feature_names,
             )
         stack = lambda key: np.stack([np.asarray(t[key]) for t in trees])  # noqa: E731

@@ -119,11 +119,17 @@ def make_grow_fn(
         n = bins.shape[0]
 
         def hist_for(mask):
-            stats = jnp.stack([grad * mask, hess * mask, mask], axis=-1)
+            # channels: [grad, hess, weight, row count] — count is unweighted
+            # so min_data_in_leaf means ROWS (LightGBM semantics), not weight
+            # mass, even under sample weights / GOSS amplification.
+            stats = jnp.stack(
+                [grad * mask, hess * mask, mask, (mask > 0).astype(jnp.float32)],
+                axis=-1,
+            )
             h = _histogram(bins, stats, num_bins)
             if axis_name is not None:
                 h = jax.lax.psum(h, axis_name)
-            return h  # (F, B, 3)
+            return h  # (F, B, 4)
 
         # -- static bin-validity masks ---------------------------------
         bin_idx = jnp.arange(num_bins)                         # (B,)
@@ -135,11 +141,11 @@ def make_grow_fn(
         valid_bin = valid_bin & (feature_mask[:, None] > 0)
 
         def best_split_of(hist, node_g, node_h, node_c):
-            """hist: (F,B,3) for one node -> (gain, feature, bin)."""
-            cum = jnp.cumsum(hist, axis=1)                     # (F,B,3)
+            """hist: (F,B,4) for one node -> (gain, feature, bin)."""
+            cum = jnp.cumsum(hist, axis=1)                     # (F,B,4)
             # numeric: left = bins <= b (cumulative); categorical: left = bin == b
             left = jnp.where(is_cat_f[:, None, None], hist, cum)
-            gl, hl, cl = left[..., 0], left[..., 1], left[..., 2]
+            gl, hl, cl = left[..., 0], left[..., 1], left[..., 3]
             gr, hr, cr = node_g - gl, node_h - hl, node_c - cl
             ok = (
                 valid_bin
@@ -175,7 +181,7 @@ def make_grow_fn(
             # constants are replicated under shard_map; row state must carry
             # the varying-manual-axis type so lax.cond branches agree
             node_of_row = jax.lax.pcast(node_of_row, (axis_name,), to="varying")
-        hists = jnp.zeros((m, num_features, num_bins, 3), jnp.float32)
+        hists = jnp.zeros((m, num_features, num_bins, 4), jnp.float32)
         hists = hists.at[0].set(hist_for(sample_mask))
         depth = jnp.zeros((m,), jnp.int32)
         # cached per-leaf best splits (recomputed only for new children)
@@ -185,8 +191,8 @@ def make_grow_fn(
 
         def node_totals(h):
             # summing any single feature's bins over a node = node totals
-            t = h[:, 0, :, :].sum(axis=1)                      # (M, 3)
-            return t[:, 0], t[:, 1], t[:, 2]
+            t = h[:, 0, :, :].sum(axis=1)                      # (M, 4)
+            return t[:, 0], t[:, 1], t[:, 3]                   # grad, hess, count
 
         g0, f0, b0 = best_split_of(hists[0], *(x[0] for x in node_totals(hists)))
         best_gain = best_gain.at[0].set(g0)

@@ -223,8 +223,13 @@ class GBDTClassificationModel(_BoosterModelMixin, HasFeaturesCol, HasPredictionC
             prob2,
             meta={SCORE_KIND: "probability", "class_labels": cls_meta},
         )
+        # "predicted_label" (not "prediction") so metrics inference can tell
+        # classifier output from regressor output even if the probability
+        # column is later dropped from the table.
         return out.with_column(
-            self.get("prediction_col"), labels.astype(np.float64), meta={SCORE_KIND: "prediction"}
+            self.get("prediction_col"),
+            labels.astype(np.float64),
+            meta={SCORE_KIND: "predicted_label"},
         )
 
     def _save_state(self) -> dict[str, Any]:

@@ -323,3 +323,75 @@ class TestEstimators:
         )
         m2 = est2.fit(t)
         assert m2.booster.num_trees == 10
+
+
+class TestReviewRegressions:
+    """Regressions for review findings: weighted min_data_in_leaf, rf
+    warm-start rescale, seed steering, small-weight splits."""
+
+    def test_small_weights_still_split(self):
+        # min_data_in_leaf counts ROWS, not weight mass: tiny uniform
+        # weights must not suppress every split.
+        x, y = make_classification(n=1000)
+        w = np.full(len(y), 0.01)
+        t = table_of(x, y, weight=w)
+        model = GBDTClassifier(
+            num_iterations=5, num_leaves=7, min_data_in_leaf=20, weight_col="weight"
+        ).fit(t)
+        assert model.booster.feature_importances("split").sum() > 0
+        out = model.transform(t)
+        assert (out["prediction"] == y).mean() > 0.8
+
+    def test_rf_warm_start_keeps_scale(self):
+        x, y = make_regression(n=800)
+        opts = dict(objective="regression", boosting_type="rf",
+                    bagging_fraction=0.8, bagging_freq=1, num_leaves=15)
+        full = Booster.train(x, y, TrainOptions(num_iterations=10, **opts))
+        half = Booster.train(x, y, TrainOptions(num_iterations=5, **opts))
+        cont = Booster.train(
+            x, y, TrainOptions(num_iterations=10, init_model=half, **opts)
+        )
+        assert cont.num_trees == 10
+        # continued rf must average like a 10-tree forest, not collapse
+        # toward init_score (double-scaled trees would shrink predictions)
+        var_full = np.var(full.predict(x))
+        var_cont = np.var(cont.predict(x))
+        assert var_cont > 0.5 * var_full
+
+    def test_seed_steers_bagging(self):
+        x, y = make_regression(n=800)
+        base = dict(objective="regression", num_iterations=5, num_leaves=15,
+                    bagging_fraction=0.5, bagging_freq=1)
+        a = Booster.train(x, y, TrainOptions(seed=1, **base))
+        b = Booster.train(x, y, TrainOptions(seed=2, **base))
+        a2 = Booster.train(x, y, TrainOptions(seed=1, **base))
+        assert not np.array_equal(a.value, b.value)
+        np.testing.assert_array_equal(a.value, a2.value)
+
+    def test_classifier_stats_without_probability_col(self):
+        from mmlspark_tpu.automl.metrics import ComputeModelStatistics
+
+        x, y = make_classification(n=600)
+        t = table_of(x, y)
+        model = GBDTClassifier(num_iterations=5, num_leaves=7).fit(t)
+        out = model.transform(t)
+        slim = Table(
+            {"label": out["label"], "prediction": out["prediction"]},
+            meta={"prediction": out.meta("prediction")},
+        )
+        stats = ComputeModelStatistics(scored_labels_col="prediction").transform(slim)
+        assert "accuracy" in stats.columns
+
+    def test_poisson_early_stopping_uses_own_loss(self):
+        rng = np.random.default_rng(5)
+        x = rng.normal(size=(900, 6))
+        lam = np.exp(0.6 * x[:, 0] - 0.4 * x[:, 1])
+        y = rng.poisson(lam).astype(np.float64)
+        opts = TrainOptions(
+            objective="poisson", num_iterations=60, num_leaves=15,
+            early_stopping_round=5,
+        )
+        b = Booster.train(x[:700], y[:700], opts, valid=(x[700:], y[700:]))
+        # with labels in count space vs log-space margins, raw-MSE tracking
+        # stopped almost immediately; the poisson NLL must train further
+        assert b.best_iteration >= 3
