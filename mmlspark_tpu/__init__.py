@@ -8,6 +8,17 @@ JAX / XLA / Pallas / jax.sharding."""
 __version__ = "0.1.0"
 
 from . import core, parallel
+
+
+def __getattr__(name):
+    # heavy subsystems import lazily so `import mmlspark_tpu` stays fast
+    if name in ("nn", "image", "gbdt", "ops", "automl"):
+        import importlib
+
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 from .core import (
     Table,
     Pipeline,

@@ -1,3 +1,8 @@
+"""AutoML layer: evaluation metrics, auto-train wrappers, hyperparameter
+search, model selection, and interpretation (reference L5 —
+compute-model-statistics, train, tune-hyperparameters, find-best-model,
+image-featurizer's LIME)."""
+
 from .metrics import (
     MetricConstants,
     ComputeModelStatistics,
@@ -5,3 +10,46 @@ from .metrics import (
     roc_curve,
     auc,
 )
+from .train import (
+    TrainClassifier,
+    TrainedClassifierModel,
+    TrainRegressor,
+    TrainedRegressorModel,
+)
+from .tune import (
+    DiscreteHyperParam,
+    RangeHyperParam,
+    HyperparamBuilder,
+    GridSpace,
+    RandomSpace,
+    TuneHyperparameters,
+    TuneHyperparametersModel,
+    DefaultHyperparams,
+)
+from .find_best import FindBestModel, BestModel
+from .lime import superpixels, SuperpixelTransformer, ImageLIME
+
+__all__ = [
+    "MetricConstants",
+    "ComputeModelStatistics",
+    "ComputePerInstanceStatistics",
+    "roc_curve",
+    "auc",
+    "TrainClassifier",
+    "TrainedClassifierModel",
+    "TrainRegressor",
+    "TrainedRegressorModel",
+    "DiscreteHyperParam",
+    "RangeHyperParam",
+    "HyperparamBuilder",
+    "GridSpace",
+    "RandomSpace",
+    "TuneHyperparameters",
+    "TuneHyperparametersModel",
+    "DefaultHyperparams",
+    "FindBestModel",
+    "BestModel",
+    "superpixels",
+    "SuperpixelTransformer",
+    "ImageLIME",
+]

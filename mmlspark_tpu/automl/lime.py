@@ -1,0 +1,169 @@
+"""Model interpretation: superpixels + ImageLIME.
+
+Reference: src/image-featurizer/ — `Superpixel` (Superpixel.scala:154+,
+SLIC-style clustering), `SuperpixelTransformer` (SuperpixelTransformer.scala:
+33+), `ImageLIME` (ImageLIME.scala:27+: superpixel perturbation, censored
+copies scored through the model, then a per-image local `LinearRegression`
+fit :86-120).
+
+TPU redesign: SLIC is a jitted fixed-iteration k-means over (x, y, rgb);
+all perturbed copies are scored in BATCHES through the model's own compiled
+forward (the reference scores per-row); the local explanation is a
+closed-form ridge solve — one small matmul+inverse per image instead of an
+iterative LinearRegression fit (SURVEY.md §7 step 7).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.params import HasInputCol, HasOutputCol, Param
+from ..core.pipeline import Transformer
+from ..core.schema import Table
+from ..core.serialize import register_stage
+
+__all__ = ["superpixels", "SuperpixelTransformer", "ImageLIME"]
+
+
+@functools.lru_cache(maxsize=16)
+def _slic_fn(h: int, w: int, cell_size: int, iters: int, compactness: float):
+    gh = max(h // cell_size, 1)
+    gw = max(w // cell_size, 1)
+    k = gh * gw
+    ys, xs = jnp.meshgrid(jnp.arange(h), jnp.arange(w), indexing="ij")
+    cy = (jnp.arange(gh) + 0.5) * (h / gh)
+    cx = (jnp.arange(gw) + 0.5) * (w / gw)
+    c_yx0 = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"), axis=-1).reshape(k, 2)
+    spatial_scale = compactness / cell_size
+
+    @jax.jit
+    def run(img):
+        img = img.astype(jnp.float32)
+        if img.shape[-1] > 3:
+            img = img[..., :3]
+        feat = jnp.concatenate(
+            [
+                jnp.stack([ys, xs], axis=-1).reshape(-1, 2) * spatial_scale,
+                img.reshape(-1, img.shape[-1]),
+            ],
+            axis=1,
+        )  # (HW, 2+C)
+
+        # init centers: spatial grid + mean color
+        def center_feats(centers_yx):
+            iy = jnp.clip(centers_yx[:, 0].astype(jnp.int32), 0, h - 1)
+            ix = jnp.clip(centers_yx[:, 1].astype(jnp.int32), 0, w - 1)
+            col = img[iy, ix]
+            return jnp.concatenate([centers_yx * spatial_scale, col], axis=1)
+
+        centers = center_feats(c_yx0)
+
+        def body(_, centers):
+            d = jnp.sum((feat[:, None, :] - centers[None, :, :]) ** 2, axis=-1)
+            assign = jnp.argmin(d, axis=1)
+            oh = jax.nn.one_hot(assign, k, dtype=jnp.float32)  # (HW, K)
+            counts = oh.sum(axis=0)[:, None]
+            sums = oh.T @ feat
+            new_centers = sums / jnp.maximum(counts, 1.0)
+            return jnp.where(counts > 0, new_centers, centers)
+
+        centers = jax.lax.fori_loop(0, iters, body, centers)
+        d = jnp.sum((feat[:, None, :] - centers[None, :, :]) ** 2, axis=-1)
+        return jnp.argmin(d, axis=1).reshape(h, w).astype(jnp.int32)
+
+    return run, k
+
+
+def superpixels(img: np.ndarray, cell_size: int = 16, iters: int = 5,
+                compactness: float = 10.0) -> tuple[np.ndarray, int]:
+    """(H, W, C) image -> ((H, W) int32 labels, num_clusters)."""
+    img = np.asarray(img)
+    run, k = _slic_fn(img.shape[0], img.shape[1], cell_size, iters, compactness)
+    return np.asarray(run(jnp.asarray(img))), k
+
+
+@register_stage
+class SuperpixelTransformer(HasInputCol, HasOutputCol, Transformer):
+    """Reference: SuperpixelTransformer.scala:33+."""
+
+    input_col = Param("image", "image column", ptype=str)
+    output_col = Param("superpixels", "labels output column", ptype=str)
+    cell_size = Param(16, "target superpixel cell size (px)", ptype=int)
+    iters = Param(5, "SLIC iterations", ptype=int)
+    compactness = Param(10.0, "spatial vs color weight", ptype=float)
+
+    def _transform(self, table: Table) -> Table:
+        col = table[self.get("input_col")]
+        imgs = col if isinstance(col, list) else list(np.asarray(col))
+        labels = [
+            superpixels(im, self.get("cell_size"), self.get("iters"),
+                        self.get("compactness"))[0]
+            for im in imgs
+        ]
+        out = np.stack(labels) if len({l.shape for l in labels}) == 1 else labels
+        return table.with_column(self.get("output_col"), out)
+
+
+@register_stage
+class ImageLIME(HasInputCol, HasOutputCol, Transformer):
+    """Local linear explanation of an image model
+    (reference ImageLIME.scala:27-120)."""
+
+    model = Param(None, "fitted Transformer scoring the image column", required=True)
+    input_col = Param("image", "image column", ptype=str)
+    output_col = Param("weights", "per-superpixel importance column", ptype=str)
+    superpixel_col = Param("superpixels", "emitted superpixel labels column", ptype=str)
+    prediction_col = Param("probability", "model output column to explain", ptype=str)
+    target_class = Param(None, "class index to explain (default: argmax)", ptype=int)
+    num_samples = Param(300, "perturbed copies per image", ptype=int)
+    sampling_fraction = Param(0.7, "P(keep superpixel)", ptype=float)
+    regularization = Param(1e-3, "ridge lambda", ptype=float)
+    cell_size = Param(16, "superpixel cell size", ptype=int)
+    fill_value = Param(0.0, "censored-pixel fill value", ptype=float)
+    seed = Param(0, "mask sampling seed", ptype=int)
+
+    def _transform(self, table: Table) -> Table:
+        model: Transformer = self.get("model")
+        col = table[self.get("input_col")]
+        imgs = col if isinstance(col, list) else list(np.asarray(col))
+        s = int(self.get("num_samples"))
+        p_keep = float(self.get("sampling_fraction"))
+        lam = float(self.get("regularization"))
+        rng = np.random.default_rng(self.get("seed"))
+
+        all_weights, all_labels = [], []
+        for im in imgs:
+            im = np.asarray(im, np.float32)
+            labels, k = superpixels(im, self.get("cell_size"))
+            masks = (rng.random((s, k)) < p_keep).astype(np.float32)
+            masks[0] = 1.0  # include the unperturbed image
+            pixel_mask = masks[:, labels.reshape(-1)].reshape(s, *labels.shape)
+            perturbed = im[None] * pixel_mask[..., None] + self.get("fill_value") * (
+                1.0 - pixel_mask[..., None]
+            )
+            scored = model.transform(Table({self.get("input_col"): perturbed}))
+            y = np.asarray(scored[self.get("prediction_col")], np.float64)
+            if y.ndim == 2:
+                tc = self.get("target_class")
+                if tc is None:
+                    tc = int(np.argmax(y[0]))
+                y = y[:, tc]
+            # closed-form ridge: w = (X'X + λI)^-1 X'y  (X centered)
+            x = masks - masks.mean(axis=0, keepdims=True)
+            yc = y - y.mean()
+            xtx = x.T @ x + lam * np.eye(k)
+            w = np.linalg.solve(xtx, x.T @ yc)
+            all_weights.append(w)
+            all_labels.append(labels)
+        lab_col = (
+            np.stack(all_labels) if len({l.shape for l in all_labels}) == 1
+            else all_labels
+        )
+        return table.with_column(
+            self.get("output_col"), [w for w in all_weights]
+        ).with_column(self.get("superpixel_col"), lab_col)

@@ -1,0 +1,262 @@
+"""Hyperparameter search: grid / random CV with parallel trials.
+
+Reference: src/tune-hyperparameters/ — `TuneHyperparameters`
+(TuneHyperparameters.scala:33-194: kFold :114, fixed thread pool :79-92,
+futures per (fold × paramMap) :136-173, metric via ComputeModelStatistics
+:140-168), `HyperparamBuilder`/`DiscreteHyperParam`/`RangeHyperParam`
+(HyperparamBuilder.scala:11-107), `GridSpace`/`RandomSpace`
+(ParamSpace.scala:25-40), `DefaultHyperparams` (DefaultHyperparams.scala).
+
+TPU note: trials are task-parallel on host threads exactly like the
+reference (each trial is itself a compiled device program; XLA serializes
+device work, threads overlap host-side prep). Trials on disjoint submeshes
+are possible by passing estimators configured with different meshes.
+"""
+
+from __future__ import annotations
+
+import itertools
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Iterable, Sequence
+
+import numpy as np
+
+from ..core.params import HasLabelCol, Param
+from ..core.pipeline import Estimator, Model, Transformer
+from ..core.schema import Table
+from ..core.serialize import register_stage
+from .metrics import ComputeModelStatistics, MetricConstants
+
+__all__ = [
+    "DiscreteHyperParam",
+    "RangeHyperParam",
+    "HyperparamBuilder",
+    "GridSpace",
+    "RandomSpace",
+    "TuneHyperparameters",
+    "TuneHyperparametersModel",
+    "DefaultHyperparams",
+]
+
+
+class DiscreteHyperParam:
+    """Reference: HyperparamBuilder.scala:20-28."""
+
+    def __init__(self, values: Sequence[Any]):
+        self.values = list(values)
+
+    def grid_values(self):
+        return list(self.values)
+
+    def sample(self, rng: np.random.Generator):
+        return self.values[int(rng.integers(len(self.values)))]
+
+
+class RangeHyperParam:
+    """Reference: HyperparamBuilder.scala:30-66 (int/long/float/double)."""
+
+    def __init__(self, low, high, is_int: bool = False, n_grid: int = 5):
+        self.low, self.high, self.is_int, self.n_grid = low, high, is_int, n_grid
+
+    def grid_values(self):
+        vals = np.linspace(self.low, self.high, self.n_grid)
+        if self.is_int:
+            return sorted({int(round(v)) for v in vals})
+        return [float(v) for v in vals]
+
+    def sample(self, rng: np.random.Generator):
+        if self.is_int:
+            return int(rng.integers(self.low, self.high + 1))
+        return float(rng.uniform(self.low, self.high))
+
+
+class HyperparamBuilder:
+    """Collect (param name -> dist) pairs (HyperparamBuilder.scala:11-18)."""
+
+    def __init__(self):
+        self._params: dict[str, Any] = {}
+
+    def add_hyperparam(self, name: str, dist) -> "HyperparamBuilder":
+        self._params[name] = dist
+        return self
+
+    def build(self) -> dict[str, Any]:
+        return dict(self._params)
+
+
+class GridSpace:
+    """Cartesian product of grid values (ParamSpace.scala:25-31)."""
+
+    def __init__(self, space: dict[str, Any]):
+        self.space = space
+
+    def param_maps(self) -> Iterable[dict[str, Any]]:
+        names = list(self.space)
+        grids = [self.space[n].grid_values() for n in names]
+        for combo in itertools.product(*grids):
+            yield dict(zip(names, combo))
+
+
+class RandomSpace:
+    """Random draws from each dist (ParamSpace.scala:33-40)."""
+
+    def __init__(self, space: dict[str, Any], num_runs: int, seed: int = 0):
+        self.space, self.num_runs, self.seed = space, num_runs, seed
+
+    def param_maps(self) -> Iterable[dict[str, Any]]:
+        rng = np.random.default_rng(self.seed)
+        for _ in range(self.num_runs):
+            yield {n: d.sample(rng) for n, d in self.space.items()}
+
+
+def _kfold_indices(n: int, k: int, seed: int) -> list[tuple[np.ndarray, np.ndarray]]:
+    """MLUtils.kFold equivalent."""
+    rng = np.random.default_rng(seed)
+    order = rng.permutation(n)
+    folds = np.array_split(order, k)
+    out = []
+    for i in range(k):
+        valid = folds[i]
+        train = np.concatenate([folds[j] for j in range(k) if j != i])
+        out.append((train, valid))
+    return out
+
+
+_MAXIMIZE = {
+    MetricConstants.AUC, MetricConstants.ACCURACY, MetricConstants.PRECISION,
+    MetricConstants.RECALL, MetricConstants.R2,
+}
+
+
+@register_stage
+class TuneHyperparameters(HasLabelCol, Estimator):
+    """K-fold CV search over estimators × param maps, trials on a thread
+    pool (TuneHyperparameters.scala:33-194)."""
+
+    models = Param(None, "estimator or list of estimators", required=True)
+    evaluation_metric = Param("accuracy", "metric name to optimize", ptype=str)
+    num_folds = Param(3, "cross-validation folds", ptype=int)
+    parallelism = Param(4, "concurrent trials", ptype=int)
+    seed = Param(0, "fold shuffling seed", ptype=int)
+    param_space = Param(None, "GridSpace | RandomSpace | dict of dists", required=True)
+    num_runs = Param(10, "random-search runs (dict param_space only)", ptype=int)
+    refit = Param(True, "refit best params on the full table", ptype=bool)
+
+    def _space(self):
+        sp = self.get("param_space")
+        if isinstance(sp, (GridSpace, RandomSpace)):
+            return sp
+        return RandomSpace(dict(sp), self.get("num_runs"), self.get("seed"))
+
+    def _fit(self, table: Table) -> "TuneHyperparametersModel":
+        models = self.get("models")
+        if isinstance(models, Estimator):
+            models = [models]
+        metric = self.get("evaluation_metric")
+        maximize = metric in _MAXIMIZE
+        folds = _kfold_indices(len(table), self.get("num_folds"), self.get("seed"))
+        param_maps = list(self._space().param_maps())
+        trials = [
+            (mi, pm) for mi in range(len(models)) for pm in param_maps
+        ]
+
+        if metric == "all":
+            raise ValueError(
+                "evaluation_metric='all' cannot rank trials; pick one metric "
+                f"(e.g. {sorted(_MAXIMIZE)})"
+            )
+        stats = ComputeModelStatistics(
+            label_col=self.get("label_col"),
+            scored_labels_col="prediction",
+            evaluation_metric=metric,
+        )
+
+        def run_trial(args):
+            mi, pm = args
+            scores = []
+            for train_idx, valid_idx in folds:
+                train, valid = table.gather(train_idx), table.gather(valid_idx)
+                est = models[mi].copy(pm)
+                fitted = est.fit(train)
+                scored = fitted.transform(valid)
+                row = stats.transform(scored)
+                if metric not in row:
+                    raise KeyError(
+                        f"metric {metric!r} not produced; have {row.columns}"
+                    )
+                scores.append(float(np.asarray(row[metric])[0]))
+            return float(np.mean(scores))
+
+        with ThreadPoolExecutor(max_workers=self.get("parallelism")) as pool:
+            results = list(pool.map(run_trial, trials))
+
+        best_i = int(np.argmax(results) if maximize else np.argmin(results))
+        best_mi, best_pm = trials[best_i]
+        if self.get("refit"):
+            best_model = models[best_mi].copy(best_pm).fit(table)
+        else:
+            best_model = models[best_mi].copy(best_pm).fit(
+                table.gather(folds[0][0])
+            )
+        out = TuneHyperparametersModel()
+        out.best_model = best_model
+        out.best_metric = results[best_i]
+        out.best_params = dict(best_pm)
+        out.all_results = [
+            {"model": mi, "params": pm, "metric": r}
+            for (mi, pm), r in zip(trials, results)
+        ]
+        return out
+
+
+@register_stage
+class TuneHyperparametersModel(Model):
+    """Reference: TuneHyperparameters.scala:196+."""
+
+    best_model: Transformer | None = None
+    best_metric: float = float("nan")
+    best_params: dict[str, Any] = {}
+    all_results: list = []
+
+    def _transform(self, table: Table) -> Table:
+        return self.best_model.transform(table)
+
+    def _save_state(self) -> dict[str, Any]:
+        from ..core.serialize import stage_to_blob
+
+        return {
+            "best_model": stage_to_blob(self.best_model),
+            "best_metric": self.best_metric,
+            "best_params": {
+                k: v for k, v in self.best_params.items()
+                if isinstance(v, (int, float, str, bool, type(None)))
+            },
+        }
+
+    def _load_state(self, state: dict[str, Any]) -> None:
+        from ..core.serialize import stage_from_blob
+
+        self.best_model = stage_from_blob(state["best_model"])
+        self.best_metric = state.get("best_metric", float("nan"))
+        self.best_params = state.get("best_params", {})
+
+
+class DefaultHyperparams:
+    """Per-learner default search spaces (DefaultHyperparams.scala)."""
+
+    @staticmethod
+    def gbdt_classifier() -> dict[str, Any]:
+        return {
+            "num_leaves": DiscreteHyperParam([15, 31, 63]),
+            "learning_rate": RangeHyperParam(0.02, 0.3),
+            "num_iterations": DiscreteHyperParam([50, 100, 200]),
+            "min_data_in_leaf": DiscreteHyperParam([5, 20, 50]),
+        }
+
+    @staticmethod
+    def dnn() -> dict[str, Any]:
+        return {
+            "learning_rate": RangeHyperParam(1e-4, 1e-2),
+            "batch_size": DiscreteHyperParam([64, 128, 256]),
+            "epochs": DiscreteHyperParam([5, 10, 20]),
+        }

@@ -18,7 +18,7 @@ from typing import Any, Callable
 
 import numpy as np
 
-__all__ = ["register_stage", "registry", "save_stage", "load_stage", "stage_class"]
+__all__ = ["register_stage", "registry", "save_stage", "load_stage", "stage_class", "stage_to_blob", "stage_from_blob"]
 
 _REGISTRY: dict[str, type] = {}          # qualified "module.ClassName" -> class
 _BARE: dict[str, type | None] = {}       # bare ClassName -> class, None if ambiguous
@@ -175,3 +175,38 @@ def load_stage(path: str) -> Any:
     state = {k: _decode(v, path, arrays) for k, v in doc["state"].items()}
     stage._load_state(state)
     return stage
+
+
+def stage_to_blob(stage: Any) -> str:
+    """Serialize a stage (directory format) into one base64 string — used by
+    composite models (TrainedClassifierModel, TuneHyperparametersModel, …)
+    to embed sub-stages in their own state, the role of the reference's
+    ConstructorWritable nesting (core/serialize/ConstructorWriter.scala)."""
+    import base64
+    import io
+    import tempfile
+    import zipfile
+
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "stage")
+        save_stage(stage, p)
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w") as zf:
+            for root, _, files in os.walk(p):
+                for fname in files:
+                    full = os.path.join(root, fname)
+                    zf.write(full, os.path.relpath(full, p))
+        return base64.b64encode(buf.getvalue()).decode()
+
+
+def stage_from_blob(blob: str) -> Any:
+    import base64
+    import io
+    import tempfile
+    import zipfile
+
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "stage")
+        with zipfile.ZipFile(io.BytesIO(base64.b64decode(blob))) as zf:
+            zf.extractall(p)
+        return load_stage(p)

@@ -233,7 +233,9 @@ class Booster:
 
         @jax.jit
         def goss_mask(g, seed):
-            ga = jnp.abs(g)
+            # padded rows carry nonzero gradients (y=0, pred=init) and must
+            # not set the top-k bar (mirrors the fused path's masking)
+            ga = jnp.abs(g) * (base_mask > 0)
             n_top = max(int(opts.top_rate * n), 1)
             thresh = jax.lax.top_k(ga, n_top)[0][-1]
             is_top = ga >= thresh
@@ -323,7 +325,13 @@ class Booster:
                 if obj == "gamma":
                     return jnp.mean(raw + yv_dev * jnp.exp(-raw))
                 if obj == "tweedie":
+                    # rho→1 / rho→2 limits are the poisson / gamma NLLs;
+                    # the generic form divides by (1-rho)(2-rho)
                     rho = opts.tweedie_variance_power
+                    if abs(rho - 1.0) < 1e-9:
+                        return jnp.mean(jnp.exp(raw) - yv_dev * raw)
+                    if abs(rho - 2.0) < 1e-9:
+                        return jnp.mean(raw + yv_dev * jnp.exp(-raw))
                     return jnp.mean(
                         -yv_dev * jnp.exp((1 - rho) * raw) / (1 - rho)
                         + jnp.exp((2 - rho) * raw) / (2 - rho)
@@ -338,6 +346,55 @@ class Booster:
                         jnp.abs(raw - yv_dev) / jnp.maximum(jnp.abs(yv_dev), 1.0)
                     )
                 return jnp.mean((raw - yv_dev) ** 2)
+
+        # ---- fused path: one XLA program for the whole boosting loop ----
+        # (gbdt/goss/rf without early stopping; dart and early stopping need
+        # host-side per-round bookkeeping and use the loop below)
+        if opts.boosting_type in ("gbdt", "goss", "rf") and not es_active:
+            from .fused import FusedTrainSpec, make_fused_train_fn
+
+            num_rounds = opts.num_iterations - start_iter
+            if num_rounds > 0:
+                spec = FusedTrainSpec(
+                    num_rounds=num_rounds,
+                    num_class=k,
+                    boosting_type=opts.boosting_type,
+                    bagging_fraction=opts.bagging_fraction,
+                    bagging_freq=opts.bagging_freq,
+                    feature_fraction=opts.feature_fraction,
+                    top_rate=opts.top_rate,
+                    other_rate=opts.other_rate,
+                )
+                fused = make_fused_train_fn(
+                    f, num_bins, cfg, mapper.num_bins, cat_mask, obj_fn, spec,
+                    mesh=mesh,
+                    cache_key=(opts.objective, opts.alpha,
+                               opts.tweedie_variance_power, opts.fair_c),
+                )
+                y_f = jnp.asarray(y_pad, jnp.float32)
+                seed = opts.seed if opts.seed else opts.bagging_seed
+                if log:
+                    log(f"fused boosting: {num_rounds} rounds x {k} class(es) "
+                        "in one XLA program (first run compiles)")
+                t_stack, _pred = fused(bins_dev, y_f, base_mask, pred, seed)
+                if log:
+                    log(f"fused boosting: done ({num_rounds * k} trees)")
+                t_host = {kf: np.asarray(v) for kf, v in t_stack._asdict().items()}
+                names = ("feature", "threshold_bin", "is_categorical",
+                         "left", "right", "value", "gain")
+                for r in range(num_rounds):
+                    for cls in range(k):
+                        idx = (r, cls) if k > 1 else (r,)
+                        trees.append({name: t_host[name][idx] for name in names})
+                        tree_classes.append(cls)
+            if opts.boosting_type == "rf" and trees:
+                scale = 1.0 / max(len(trees) // k, 1)
+                trees = [_scale_tree(t, scale) for t in trees]
+            out = Booster._from_tree_dicts(
+                trees, tree_classes, mapper, opts, init, feature_names or []
+            )
+            out.best_iteration = -1
+            return out
 
         bag_mask = base_mask
         for it in range(start_iter, opts.num_iterations):

@@ -81,16 +81,55 @@ def _leaf_objective(g, h, l1, l2):
     return (t * t) / (h + l2 + 1e-12)
 
 
+_HIST_CHUNK = 1024
+
+
 def _histogram(bins, stats, num_bins):
-    """bins: (n, F) int32; stats: (n, 3) [g, h, w] already masked.
-    Returns (F, B, 3). Scans over features to keep memory O(n)."""
+    """bins: (n, F) int32; stats: (n, C) [g, h, w, cnt] already masked.
+    Returns (F, B, C).
 
-    def one_feature(_, bin_col):
-        hist = jax.ops.segment_sum(stats, bin_col, num_segments=num_bins)
-        return None, hist
+    TPUs have no fast random scatter, so the bin accumulation is a one-hot
+    MATMUL on the MXU — (F·B, chunk) @ (chunk, C) — instead of segment_sum's
+    scatter-add (SURVEY.md §7 "hard parts": sort-based or one-hot-matmul
+    binning). Rows are processed in chunks so the one-hot transient
+    (chunk × F × B) stays VMEM-sized rather than streaming an n×F×B tensor
+    through HBM; the (F, B, C) accumulator is carried across chunks.
+    """
+    n, f = bins.shape
+    c = stats.shape[1]
+    chunk = min(_HIST_CHUNK, n)
+    pad = (-n) % chunk
+    if pad:
+        # padded rows carry all-zero stats: they land in bin 0 with weight 0
+        bins = jnp.concatenate([bins, jnp.zeros((pad, f), bins.dtype)])
+        stats = jnp.concatenate([stats, jnp.zeros((pad, c), stats.dtype)])
+    nc = (n + pad) // chunk
 
-    _, hists = jax.lax.scan(one_feature, None, bins.T)
-    return hists  # (F, B, 3)
+    def body(acc, xs):
+        b_chunk, s_chunk = xs                                   # (ch,F), (ch,C)
+        oh = jax.nn.one_hot(b_chunk, num_bins, dtype=s_chunk.dtype)  # (ch,F,B)
+        # (C, ch) @ (ch, F·B): the wide F·B dim sits on the MXU lane axis
+        # (output N), so lanes are fully used; C=4 only wastes sublanes.
+        # Precision.HIGHEST: default TPU matmul rounds f32 inputs to bf16 —
+        # grad/hess sums must be exact-ish or near-tied split gains flip
+        # versus the host path (parity gates compare against fixed CSVs)
+        h = jax.lax.dot_general(
+            s_chunk, oh.reshape(chunk, f * num_bins), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )  # (C, F·B)
+        return acc + h, None
+
+    # + 0*stats[0,0]: under shard_map the per-shard inputs carry a
+    # "varying over the data axis" type; the scan carry must match, and
+    # depending on stats gives acc0 that type without naming the axis here
+    acc0 = jnp.zeros((c, f * num_bins), jnp.float32) + 0.0 * stats[0, 0]
+    acc, _ = jax.lax.scan(
+        body,
+        acc0,
+        (bins.reshape(nc, chunk, f), stats.reshape(nc, chunk, c)),
+    )
+    return acc.reshape(c, f, num_bins).transpose(1, 2, 0)  # (F, B, C)
 
 
 def make_grow_fn(
@@ -100,6 +139,7 @@ def make_grow_fn(
     feature_num_bins: np.ndarray,
     categorical_mask: np.ndarray,
     mesh: Mesh | None = None,
+    raw: bool = False,
 ):
     """Build the jitted single-tree growth function.
 
@@ -108,6 +148,10 @@ def make_grow_fn(
 
     When `mesh` has a data axis > 1 the function is shard_mapped: row inputs
     sharded on DATA_AXIS, histogram psummed, tree state replicated.
+
+    With raw=True, returns the unjitted core closure (taking an explicit
+    axis_name kwarg) so callers — the fused boosting loop — can compose it
+    inside their own scan/shard_map.
     """
     nl = cfg.num_leaves
     m = 2 * nl - 1
@@ -119,17 +163,17 @@ def make_grow_fn(
         n = bins.shape[0]
 
         def hist_for(mask):
-            # channels: [grad, hess, weight, row count] — count is unweighted
-            # so min_data_in_leaf means ROWS (LightGBM semantics), not weight
+            # channels: [grad, hess, row count] — count is unweighted so
+            # min_data_in_leaf means ROWS (LightGBM semantics), not weight
             # mass, even under sample weights / GOSS amplification.
             stats = jnp.stack(
-                [grad * mask, hess * mask, mask, (mask > 0).astype(jnp.float32)],
+                [grad * mask, hess * mask, (mask > 0).astype(jnp.float32)],
                 axis=-1,
             )
             h = _histogram(bins, stats, num_bins)
             if axis_name is not None:
                 h = jax.lax.psum(h, axis_name)
-            return h  # (F, B, 4)
+            return h  # (F, B, 3)
 
         # -- static bin-validity masks ---------------------------------
         bin_idx = jnp.arange(num_bins)                         # (B,)
@@ -141,11 +185,11 @@ def make_grow_fn(
         valid_bin = valid_bin & (feature_mask[:, None] > 0)
 
         def best_split_of(hist, node_g, node_h, node_c):
-            """hist: (F,B,4) for one node -> (gain, feature, bin)."""
-            cum = jnp.cumsum(hist, axis=1)                     # (F,B,4)
+            """hist: (F,B,3) for one node -> (gain, feature, bin)."""
+            cum = jnp.cumsum(hist, axis=1)                     # (F,B,3)
             # numeric: left = bins <= b (cumulative); categorical: left = bin == b
             left = jnp.where(is_cat_f[:, None, None], hist, cum)
-            gl, hl, cl = left[..., 0], left[..., 1], left[..., 3]
+            gl, hl, cl = left[..., 0], left[..., 1], left[..., 2]
             gr, hr, cr = node_g - gl, node_h - hl, node_c - cl
             ok = (
                 valid_bin
@@ -181,7 +225,7 @@ def make_grow_fn(
             # constants are replicated under shard_map; row state must carry
             # the varying-manual-axis type so lax.cond branches agree
             node_of_row = jax.lax.pcast(node_of_row, (axis_name,), to="varying")
-        hists = jnp.zeros((m, num_features, num_bins, 4), jnp.float32)
+        hists = jnp.zeros((m, num_features, num_bins, 3), jnp.float32)
         hists = hists.at[0].set(hist_for(sample_mask))
         depth = jnp.zeros((m,), jnp.int32)
         # cached per-leaf best splits (recomputed only for new children)
@@ -191,8 +235,8 @@ def make_grow_fn(
 
         def node_totals(h):
             # summing any single feature's bins over a node = node totals
-            t = h[:, 0, :, :].sum(axis=1)                      # (M, 4)
-            return t[:, 0], t[:, 1], t[:, 3]                   # grad, hess, count
+            t = h[:, 0, :, :].sum(axis=1)                      # (M, 3)
+            return t[:, 0], t[:, 1], t[:, 2]                   # grad, hess, count
 
         g0, f0, b0 = best_split_of(hists[0], *(x[0] for x in node_totals(hists)))
         best_gain = best_gain.at[0].set(g0)
@@ -273,6 +317,8 @@ def make_grow_fn(
         per_row_value = tree.value[node_of_row]
         return tree, per_row_value
 
+    if raw:
+        return grow
     if mesh is not None and mesh.shape.get(DATA_AXIS, 1) > 1:
         row = P(DATA_AXIS)
         grow_sharded = functools.partial(grow, axis_name=DATA_AXIS)

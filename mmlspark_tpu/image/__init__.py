@@ -1,0 +1,40 @@
+"""Image pipeline subsystem.
+
+Reference modules replaced: src/image-transformer/ (OpenCV Mat stage
+pipeline, ImageTransformer.scala:22-379), src/io/image/ + src/io/binary/
+(file readers), and the UnrollImage / ImageSetAugmenter stages.
+
+TPU-first: decode stays host-side (PIL, like the reference decodes on the
+JVM), every pixel op is a jitted jax.image / conv program over NHWC batches.
+"""
+
+from .ops import (
+    resize_image,
+    crop_image,
+    flip_image,
+    to_grayscale,
+    box_blur,
+    threshold_image,
+    gaussian_blur,
+)
+from .transformer import ImageTransformer, ResizeImageTransformer
+from .unroll import UnrollImage, UnrollBinaryImage
+from .augmenter import ImageSetAugmenter
+from .io import read_images, read_binary_files
+
+__all__ = [
+    "resize_image",
+    "crop_image",
+    "flip_image",
+    "to_grayscale",
+    "box_blur",
+    "threshold_image",
+    "gaussian_blur",
+    "ImageTransformer",
+    "ResizeImageTransformer",
+    "UnrollImage",
+    "UnrollBinaryImage",
+    "ImageSetAugmenter",
+    "read_images",
+    "read_binary_files",
+]

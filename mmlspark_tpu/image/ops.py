@@ -1,0 +1,110 @@
+"""Pixel ops: pure jnp functions over (H, W, C) float32 images.
+
+Reference: the OpenCV-backed stage classes in src/image-transformer/src/main/
+scala/ImageTransformer.scala:35-206 (ResizeImage :57, CropImage :77,
+ColorFormat :95, Flip :126, Blur :144, Threshold :163, GaussianKernel :186).
+Each maps to a vectorizable jnp op; batch stages vmap these over NHWC and
+XLA fuses the whole op chain into one program — versus one JNI Mat call per
+op per row in the reference.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "resize_image",
+    "crop_image",
+    "flip_image",
+    "to_grayscale",
+    "box_blur",
+    "threshold_image",
+    "gaussian_blur",
+]
+
+
+def resize_image(img, height: int, width: int, method: str = "linear"):
+    """ResizeImage (ImageTransformer.scala:57-75)."""
+    shape = (height, width, img.shape[-1])
+    return jax.image.resize(img, shape, method=method)
+
+
+def crop_image(img, x: int, y: int, height: int, width: int):
+    """CropImage (ImageTransformer.scala:77-93): (x, y) top-left corner."""
+    return jax.lax.dynamic_slice(
+        img, (y, x, 0), (height, width, img.shape[-1])
+    )
+
+
+def flip_image(img, flip_code: int = 1):
+    """Flip (ImageTransformer.scala:126-142), OpenCV flipCode semantics:
+    0 = around x-axis (vertical flip), >0 = around y-axis (horizontal),
+    <0 = both."""
+    if flip_code == 0:
+        return img[::-1, :, :]
+    if flip_code > 0:
+        return img[:, ::-1, :]
+    return img[::-1, ::-1, :]
+
+
+def to_grayscale(img, keep_channels: bool = False):
+    """ColorFormat(COLOR_BGR2GRAY) (ImageTransformer.scala:95-124). Uses the
+    standard luminance weights; input channel order is RGB (see io.py)."""
+    w = jnp.asarray([0.299, 0.587, 0.114], img.dtype)
+    gray = jnp.tensordot(img[..., :3], w, axes=([-1], [0]))[..., None]
+    if keep_channels:
+        return jnp.broadcast_to(gray, img.shape)
+    return gray
+
+
+def _depthwise_conv2d(img, kernel):
+    """img (H, W, C), kernel (kh, kw) applied per channel, SAME edges."""
+    c = img.shape[-1]
+    k = jnp.broadcast_to(kernel[:, :, None, None], (*kernel.shape, 1, c))
+    x = img[None]  # NHWC
+    out = jax.lax.conv_general_dilated(
+        x, k, window_strides=(1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=c,
+    )
+    return out[0]
+
+
+def box_blur(img, height: int = 3, width: int = 3):
+    """Blur (ImageTransformer.scala:144-161): normalized box filter."""
+    kernel = jnp.full((height, width), 1.0 / (height * width), img.dtype)
+    return _depthwise_conv2d(img, kernel)
+
+
+def threshold_image(img, threshold: float, max_val: float = 255.0,
+                    threshold_type: str = "binary"):
+    """Threshold (ImageTransformer.scala:163-184), OpenCV types."""
+    if threshold_type == "binary":
+        return jnp.where(img > threshold, max_val, 0.0).astype(img.dtype)
+    if threshold_type == "binary_inv":
+        return jnp.where(img > threshold, 0.0, max_val).astype(img.dtype)
+    if threshold_type == "trunc":
+        return jnp.minimum(img, threshold)
+    if threshold_type == "tozero":
+        return jnp.where(img > threshold, img, 0.0)
+    if threshold_type == "tozero_inv":
+        return jnp.where(img > threshold, 0.0, img)
+    raise ValueError(f"unknown threshold_type {threshold_type!r}")
+
+
+@functools.lru_cache(maxsize=64)
+def _gaussian_kernel_np(size: int, sigma: float) -> np.ndarray:
+    ax = np.arange(size) - (size - 1) / 2.0
+    g = np.exp(-(ax**2) / (2.0 * sigma**2))
+    k = np.outer(g, g)
+    return (k / k.sum()).astype(np.float32)
+
+
+def gaussian_blur(img, aperture_size: int = 3, sigma: float = 1.0):
+    """GaussianKernel (ImageTransformer.scala:186-206)."""
+    kernel = jnp.asarray(_gaussian_kernel_np(aperture_size, float(sigma)))
+    return _depthwise_conv2d(img, kernel.astype(img.dtype))

@@ -1,0 +1,66 @@
+"""HTTP on Tables + Serving.
+
+Reference modules replaced: src/io/http/ — the client stack (HTTPSchema,
+HTTPTransformer, SimpleHTTPTransformer, parsers, retrying clients,
+batchers), Spark Serving (HTTPSource/DistributedHTTPSource/HTTPSourceV2
+continuous serving), PartitionConsolidator, PowerBIWriter, and the
+Cognitive-Services-style typed REST stages.
+"""
+
+from .schema import (
+    HTTPRequestData,
+    HTTPResponseData,
+    parse_request,
+    make_reply,
+)
+from .clients import http_send, HTTPClient
+from .transformer import (
+    HTTPTransformer,
+    SimpleHTTPTransformer,
+    JSONInputParser,
+    JSONOutputParser,
+    StringOutputParser,
+    CustomInputParser,
+    CustomOutputParser,
+)
+from .serving import ServingServer, serve_model
+from .consolidator import PartitionConsolidator
+from .powerbi import PowerBIWriter
+from .cognitive import (
+    CognitiveServiceBase,
+    TextSentiment,
+    LanguageDetector,
+    EntityDetector,
+    KeyPhraseExtractor,
+    OCR,
+    AnalyzeImage,
+    DetectFace,
+)
+
+__all__ = [
+    "HTTPRequestData",
+    "HTTPResponseData",
+    "parse_request",
+    "make_reply",
+    "http_send",
+    "HTTPClient",
+    "HTTPTransformer",
+    "SimpleHTTPTransformer",
+    "JSONInputParser",
+    "JSONOutputParser",
+    "StringOutputParser",
+    "CustomInputParser",
+    "CustomOutputParser",
+    "ServingServer",
+    "serve_model",
+    "PartitionConsolidator",
+    "PowerBIWriter",
+    "CognitiveServiceBase",
+    "TextSentiment",
+    "LanguageDetector",
+    "EntityDetector",
+    "KeyPhraseExtractor",
+    "OCR",
+    "AnalyzeImage",
+    "DetectFace",
+]

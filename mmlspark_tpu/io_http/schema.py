@@ -1,0 +1,106 @@
+"""HTTP request/response records on Tables.
+
+Reference: `HTTPSchema` (src/io/http/src/main/scala/HTTPSchema.scala:35-188)
+defines full request/response StructTypes via SparkBindings; `parse_request`
+/`make_reply` from ServingImplicits.scala:58-88. Here requests/responses are
+plain dataclasses stored in object columns — the Table equivalent of the
+reference's struct columns.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+from ..core.schema import Table
+
+__all__ = ["HTTPRequestData", "HTTPResponseData", "parse_request", "make_reply"]
+
+
+@dataclass
+class HTTPRequestData:
+    """Reference: HTTPSchema request StructType (HTTPSchema.scala:121-160)."""
+
+    method: str = "POST"
+    url: str = ""
+    headers: dict[str, str] = field(default_factory=dict)
+    entity: bytes | None = None
+
+    def json(self) -> Any:
+        return json.loads(self.entity.decode()) if self.entity else None
+
+    @staticmethod
+    def from_json(url: str, payload: Any, method: str = "POST",
+                  headers: dict[str, str] | None = None) -> "HTTPRequestData":
+        h = {"Content-Type": "application/json", **(headers or {})}
+        return HTTPRequestData(
+            method=method, url=url, headers=h,
+            entity=json.dumps(payload).encode(),
+        )
+
+
+@dataclass
+class HTTPResponseData:
+    """Reference: HTTPSchema response StructType (HTTPSchema.scala:60-119)."""
+
+    status_code: int = 0
+    reason: str = ""
+    headers: dict[str, str] = field(default_factory=dict)
+    entity: bytes | None = None
+
+    @property
+    def ok(self) -> bool:
+        return 200 <= self.status_code < 300
+
+    def json(self) -> Any:
+        return json.loads(self.entity.decode()) if self.entity else None
+
+    def text(self) -> str:
+        return self.entity.decode() if self.entity else ""
+
+
+def parse_request(table: Table, request_col: str = "request",
+                  output_col: str | None = None, flatten_json: bool = True) -> Table:
+    """Serving-side: request column -> parsed body column
+    (ServingImplicits.parseRequest, ServingImplicits.scala:58-70)."""
+    reqs = table[request_col]
+    bodies = [r.json() if isinstance(r, HTTPRequestData) else r for r in reqs]
+    if flatten_json and bodies and all(isinstance(b, dict) for b in bodies):
+        keys: list[str] = []
+        for b in bodies:
+            for k in b:
+                if k not in keys:
+                    keys.append(k)
+        out = table
+        for k in keys:
+            vals = [b.get(k) for b in bodies]
+            if all(isinstance(v, (int, float, bool, type(None))) for v in vals):
+                out = out.with_column(k, np.asarray(
+                    [np.nan if v is None else v for v in vals], np.float64))
+            elif all(isinstance(v, list) for v in vals):
+                try:
+                    out = out.with_column(k, np.asarray(vals, np.float64))
+                except (ValueError, TypeError):
+                    out = out.with_column(k, vals)
+            else:
+                out = out.with_column(k, vals)
+        return out
+    col = output_col or "body"
+    return table.with_column(col, bodies)
+
+
+def make_reply(table: Table, value_col: str, reply_col: str = "reply") -> Table:
+    """Serving-side: column -> JSON reply column
+    (ServingImplicits.makeReply, ServingImplicits.scala:73-88)."""
+    vals = table[value_col]
+    replies = []
+    for v in (vals.tolist() if isinstance(vals, np.ndarray) else vals):
+        replies.append(HTTPResponseData(
+            status_code=200, reason="OK",
+            headers={"Content-Type": "application/json"},
+            entity=json.dumps({value_col: v}).encode(),
+        ))
+    return table.with_column(reply_col, replies)

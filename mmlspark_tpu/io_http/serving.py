@@ -1,0 +1,198 @@
+"""Serving: deploy a pipeline as a web service.
+
+Reference: Spark Serving (SURVEY.md §3.4) — batch mode `HTTPSource`/`HTTPSink`
+(HTTPSource.scala:46-225), distributed mode's per-JVM `JVMSharedServer` with
+request queues drained per micro-batch (DistributedHTTPSource.scala:89-343),
+and continuous mode's per-partition servers replying through an in-process
+routing table keyed by request id (HTTPSourceV2.scala:336-474, ~1 ms).
+
+TPU redesign: one process = one host = one `ServingServer`. Requests land in
+an in-memory queue; a batcher thread drains up to `max_batch_size` requests
+or `max_latency_ms`, runs the scoring callable ONCE on the whole batch (the
+jitted model step is persistent — compiled on the first batch, padded to a
+fixed shape after that), and completes each request's event — the
+continuous-mode direct-reply path without a streaming engine in the middle.
+Multi-host serving = one ServingServer per host behind any TCP balancer
+(the reference's per-executor servers + load balancer, SURVEY.md §3.4).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable
+
+import numpy as np
+
+from ..core.schema import Table
+from .schema import HTTPRequestData, HTTPResponseData, make_reply, parse_request
+
+__all__ = ["ServingServer", "serve_model"]
+
+
+@dataclass
+class _Exchange:
+    request: HTTPRequestData
+    event: threading.Event = field(default_factory=threading.Event)
+    response: HTTPResponseData | None = None
+
+
+class ServingServer:
+    """HTTP frontend + batched scoring loop.
+
+    `handler(Table) -> Table` receives a table with a "request" column of
+    HTTPRequestData and must return a table with a "reply" column of
+    HTTPResponseData (use parse_request/make_reply, the reference's
+    ServingImplicits pattern)."""
+
+    def __init__(
+        self,
+        handler: Callable[[Table], Table],
+        host: str = "127.0.0.1",
+        port: int = 0,
+        max_batch_size: int = 64,
+        max_latency_ms: float = 5.0,
+        reply_timeout_s: float = 30.0,
+        api_path: str = "/",
+    ):
+        self.handler = handler
+        self.host, self.port = host, port
+        self.max_batch_size = max_batch_size
+        self.max_latency_ms = max_latency_ms
+        self.reply_timeout_s = reply_timeout_s
+        self.api_path = api_path
+        self._queue: queue.Queue[_Exchange] = queue.Queue()
+        self._server: ThreadingHTTPServer | None = None
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+        # serving counters (reference requestsSeen/Accepted/Answered,
+        # DistributedHTTPSource.scala:98-107)
+        self.requests_seen = 0
+        self.requests_answered = 0
+
+    # ------------------------------------------------------------------ #
+
+    def start(self) -> "ServingServer":
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802 — http.server API
+                outer.requests_seen += 1
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length) if length else b""
+                ex = _Exchange(HTTPRequestData(
+                    method="POST", url=self.path,
+                    headers=dict(self.headers), entity=body,
+                ))
+                outer._queue.put(ex)
+                if not ex.event.wait(outer.reply_timeout_s):
+                    self.send_response(504)
+                    self.end_headers()
+                    return
+                resp = ex.response or HTTPResponseData(500, "no response")
+                self.send_response(resp.status_code or 500)
+                for k, v in resp.headers.items():
+                    self.send_header(k, v)
+                self.end_headers()
+                if resp.entity:
+                    self.wfile.write(resp.entity)
+                outer.requests_answered += 1
+
+            def do_GET(self):  # noqa: N802 — health/info endpoint
+                info = json.dumps({
+                    "name": "mmlspark_tpu.serving",
+                    "host": outer.host, "port": outer.port,
+                    "seen": outer.requests_seen,
+                    "answered": outer.requests_answered,
+                }).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(info)
+
+            def log_message(self, *a):  # silence per-request stderr noise
+                pass
+
+        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._server.server_address[1]
+        st = threading.Thread(target=self._server.serve_forever, daemon=True)
+        bt = threading.Thread(target=self._batch_loop, daemon=True)
+        st.start()
+        bt.start()
+        self._threads = [st, bt]
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._server:
+            self._server.shutdown()
+            self._server.server_close()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}{self.api_path}"
+
+    # ------------------------------------------------------------------ #
+
+    def _batch_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                first = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            batch = [first]
+            deadline = time.monotonic() + self.max_latency_ms / 1e3
+            while len(batch) < self.max_batch_size:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(self._queue.get(timeout=remaining))
+                except queue.Empty:
+                    break
+            try:
+                table = Table({"request": [ex.request for ex in batch]})
+                out = self.handler(table)
+                replies = out["reply"]
+            except Exception as e:  # noqa: BLE001 — per-batch failure -> 500s
+                err = HTTPResponseData(
+                    500, "handler error",
+                    headers={"Content-Type": "application/json"},
+                    entity=json.dumps({"error": str(e)}).encode(),
+                )
+                replies = [err] * len(batch)
+            for ex, resp in zip(batch, replies):
+                ex.response = resp
+                ex.event.set()
+
+
+def serve_model(
+    model,
+    input_cols: list[str],
+    output_col: str = "prediction",
+    host: str = "127.0.0.1",
+    port: int = 0,
+    **server_kw,
+) -> ServingServer:
+    """Deploy a fitted Transformer: JSON body {col: value, ...} in,
+    {output_col: value} out (the `SparkServing - Deploying a Classifier`
+    notebook flow)."""
+
+    def handler(table: Table) -> Table:
+        t = parse_request(table)
+        missing = [c for c in input_cols if c not in t]
+        if missing:
+            raise ValueError(f"request missing fields {missing}")
+        if "features" not in t and all(
+            isinstance(t[c], np.ndarray) for c in input_cols
+        ):
+            feats = np.stack([np.asarray(t[c], np.float64) for c in input_cols], 1)
+            t = t.with_column("features", feats)
+        scored = model.transform(t)
+        return make_reply(scored, output_col)
+
+    return ServingServer(handler, host=host, port=port, **server_kw).start()

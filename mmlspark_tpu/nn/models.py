@@ -1,0 +1,308 @@
+"""Model architectures + the ModelBundle container.
+
+Reference: the CNTK side ships opaque serialized `Function` graphs
+(src/cntk-model/src/main/scala/SerializableFunction.scala:85+) whose layers
+are addressed by name for transfer learning (`ImageFeaturizer.scala:92-135`
+cutOutputLayers/layerNames). TPU-first equivalent: flax modules with
+deterministic layer naming; intermediates are captured by flax's
+`capture_intermediates` and addressed with the same dotted-path idea.
+
+All models run NHWC with channel dims that map well to the MXU's 128-lane
+tiling; compute in bfloat16 with float32 params/accumulations is handled by
+the `dtype` argument (the standard flax mixed-precision recipe).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "MLP",
+    "SimpleCNN",
+    "ResNet",
+    "resnet20_cifar",
+    "resnet50",
+    "ARCHITECTURES",
+    "make_model",
+    "ModelBundle",
+]
+
+
+class MLP(nn.Module):
+    """Plain fully-connected classifier/regressor."""
+
+    features: Sequence[int] = (128, 64)
+    num_outputs: int = 2
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for i, f in enumerate(self.features):
+            x = nn.Dense(f, dtype=self.dtype, name=f"dense_{i}")(x)
+            x = nn.relu(x)
+        return nn.Dense(self.num_outputs, dtype=self.dtype, name="head")(x)
+
+
+class SimpleCNN(nn.Module):
+    """Small conv net (the role of the reference's ConvNet notebook model,
+    `DeepLearning - CIFAR10 Convolutional Network.ipynb`)."""
+
+    num_outputs: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        for i, f in enumerate((64, 128, 256)):
+            x = nn.Conv(f, (3, 3), dtype=self.dtype, name=f"conv_{i}")(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(256, dtype=self.dtype, name="dense_0")(x)
+        x = nn.relu(x)
+        return nn.Dense(self.num_outputs, dtype=self.dtype, name="head")(x)
+
+
+class ResNetBlock(nn.Module):
+    filters: int
+    strides: tuple[int, int] = (1, 1)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), self.strides, use_bias=False,
+                    dtype=self.dtype, name="conv1")(x)
+        y = nn.BatchNorm(use_running_average=not train, dtype=self.dtype,
+                         name="bn1")(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), use_bias=False, dtype=self.dtype,
+                    name="conv2")(y)
+        y = nn.BatchNorm(use_running_average=not train, dtype=self.dtype,
+                         scale_init=nn.initializers.zeros_init(), name="bn2")(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1), self.strides,
+                               use_bias=False, dtype=self.dtype,
+                               name="proj_conv")(residual)
+            residual = nn.BatchNorm(use_running_average=not train,
+                                    dtype=self.dtype, name="proj_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: tuple[int, int] = (1, 1)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        residual = x
+        y = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype,
+                    name="conv1")(x)
+        y = nn.BatchNorm(use_running_average=not train, dtype=self.dtype,
+                         name="bn1")(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), self.strides, use_bias=False,
+                    dtype=self.dtype, name="conv2")(y)
+        y = nn.BatchNorm(use_running_average=not train, dtype=self.dtype,
+                         name="bn2")(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters * 4, (1, 1), use_bias=False, dtype=self.dtype,
+                    name="conv3")(y)
+        y = nn.BatchNorm(use_running_average=not train, dtype=self.dtype,
+                         scale_init=nn.initializers.zeros_init(), name="bn3")(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters * 4, (1, 1), self.strides,
+                               use_bias=False, dtype=self.dtype,
+                               name="proj_conv")(residual)
+            residual = nn.BatchNorm(use_running_average=not train,
+                                    dtype=self.dtype, name="proj_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """ResNet family. `stage_sizes`/`bottleneck` select the variant:
+    resnet20 CIFAR (3,3,3 basic), resnet50 (3,4,6,3 bottleneck), etc."""
+
+    stage_sizes: Sequence[int] = (3, 3, 3)
+    num_outputs: int = 10
+    num_filters: int = 16
+    bottleneck: bool = False
+    stem_strides: int = 1          # 1 for CIFAR-size inputs, 2 for ImageNet
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        block = BottleneckBlock if self.bottleneck else ResNetBlock
+        if self.stem_strides == 1:
+            x = nn.Conv(self.num_filters, (3, 3), use_bias=False,
+                        dtype=self.dtype, name="stem_conv")(x)
+        else:
+            x = nn.Conv(self.num_filters, (7, 7), (2, 2), use_bias=False,
+                        dtype=self.dtype, name="stem_conv")(x)
+        x = nn.BatchNorm(use_running_average=not train, dtype=self.dtype,
+                         name="stem_bn")(x)
+        x = nn.relu(x)
+        if self.stem_strides != 1:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, size in enumerate(self.stage_sizes):
+            for j in range(size):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = block(self.num_filters * 2**i, strides=strides,
+                          dtype=self.dtype, name=f"stage{i}_block{j}")(x, train)
+        x = jnp.mean(x, axis=(1, 2), keepdims=False)
+        self.sow("intermediates", "pooled_features", x)
+        return nn.Dense(self.num_outputs, dtype=jnp.float32, name="head")(x)
+
+
+def resnet20_cifar(num_outputs: int = 10, dtype=jnp.float32) -> ResNet:
+    return ResNet(stage_sizes=(3, 3, 3), num_filters=16,
+                  num_outputs=num_outputs, dtype=dtype)
+
+
+def resnet50(num_outputs: int = 1000, dtype=jnp.float32) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), num_filters=64, bottleneck=True,
+                  stem_strides=2, num_outputs=num_outputs, dtype=dtype)
+
+
+# Architecture registry: name -> factory(**config). The zoo's ModelSchema
+# references architectures by name (the reference's ModelSchema carries a
+# remote URI instead, downloader/Schema.scala:30+).
+ARCHITECTURES: dict[str, Callable[..., nn.Module]] = {
+    "mlp": lambda **kw: MLP(**kw),
+    "simple_cnn": lambda **kw: SimpleCNN(**kw),
+    "resnet20_cifar": lambda **kw: resnet20_cifar(**kw),
+    "resnet50": lambda **kw: resnet50(**kw),
+    "resnet": lambda **kw: ResNet(**kw),
+}
+
+
+def make_model(architecture: str, **config) -> nn.Module:
+    if architecture not in ARCHITECTURES:
+        raise ValueError(
+            f"unknown architecture {architecture!r}; have {sorted(ARCHITECTURES)}"
+        )
+    return ARCHITECTURES[architecture](**config)
+
+
+@dataclass
+class ModelBundle:
+    """A saved/loadable model: architecture name + config + variables.
+
+    Role of the reference's serialized CNTK Function + ModelSchema metadata
+    (SerializableFunction.scala:85+, downloader/Schema.scala:30+)."""
+
+    architecture: str
+    config: dict[str, Any]
+    variables: dict[str, Any]          # {"params": ..., "batch_stats": ...}
+    input_shape: tuple[int, ...] = ()  # per-example shape, e.g. (32, 32, 3)
+    class_labels: list | None = None
+    preprocess: dict[str, Any] = field(default_factory=dict)  # mean/std etc.
+
+    _module: nn.Module | None = None
+
+    @property
+    def module(self) -> nn.Module:
+        if self._module is None:
+            cfg = dict(self.config)
+            if cfg.get("dtype") == "bfloat16":
+                cfg["dtype"] = jnp.bfloat16
+            elif cfg.get("dtype") == "float32":
+                cfg["dtype"] = jnp.float32
+            self._module = make_model(self.architecture, **cfg)
+        return self._module
+
+    @staticmethod
+    def init(architecture: str, input_shape: tuple[int, ...], seed: int = 0,
+             class_labels=None, preprocess=None, **config) -> "ModelBundle":
+        bundle = ModelBundle(
+            architecture=architecture,
+            config=config,
+            variables={},
+            input_shape=tuple(input_shape),
+            class_labels=class_labels,
+            preprocess=dict(preprocess or {}),
+        )
+        x = jnp.zeros((1, *input_shape), jnp.float32)
+        bundle.variables = bundle.module.init(jax.random.PRNGKey(seed), x)
+        return bundle
+
+    def save(self, path: str) -> None:
+        import json
+        from flax import serialization
+
+        cfg = {
+            k: ("bfloat16" if v is jnp.bfloat16 else "float32" if v is jnp.float32 else v)
+            for k, v in self.config.items()
+        }
+        header = json.dumps({
+            "architecture": self.architecture,
+            "config": cfg,
+            "input_shape": list(self.input_shape),
+            "class_labels": self.class_labels,
+            "preprocess": self.preprocess,
+        }).encode()
+        blob = serialization.to_bytes(self.variables)
+        with open(path, "wb") as fh:
+            fh.write(len(header).to_bytes(8, "little"))
+            fh.write(header)
+            fh.write(blob)
+
+    @staticmethod
+    def load(path: str) -> "ModelBundle":
+        import json
+        from flax import serialization
+
+        with open(path, "rb") as fh:
+            hlen = int.from_bytes(fh.read(8), "little")
+            header = json.loads(fh.read(hlen).decode())
+            blob = fh.read()
+        bundle = ModelBundle(
+            architecture=header["architecture"],
+            config=header["config"],
+            variables={},
+            input_shape=tuple(header["input_shape"]),
+            class_labels=header.get("class_labels"),
+            preprocess=header.get("preprocess", {}),
+        )
+        x = jnp.zeros((1, *bundle.input_shape), jnp.float32)
+        template = bundle.module.init(jax.random.PRNGKey(0), x)
+        bundle.variables = serialization.from_bytes(template, blob)
+        return bundle
+
+    def layer_names(self) -> list[str]:
+        """Dotted paths of all submodules (the reference's layerNames,
+        ImageFeaturizer.scala:92-135)."""
+        x = jnp.zeros((1, *self.input_shape), jnp.float32)
+        _, state = self.module.apply(
+            self.variables, x, train=False,
+            capture_intermediates=True, mutable=["intermediates"],
+        )
+        names: list[str] = []
+
+        def walk(tree, prefix):
+            for k, v in tree.items():
+                p = f"{prefix}.{k}" if prefix else k
+                if isinstance(v, dict):
+                    walk(v, p)
+                else:
+                    # "__call__" leaves name the module; sown values (e.g.
+                    # pooled_features) name themselves
+                    names.append(prefix if k == "__call__" else p)
+
+        walk(state["intermediates"], "")
+        # dedupe, keep order; drop the root module's own output ("") — that
+        # is just the logits, addressable as "logits"
+        seen: dict[str, None] = {}
+        for nme in names:
+            if nme:
+                seen.setdefault(nme, None)
+        return list(seen)

@@ -1,0 +1,175 @@
+"""Model zoo: schemas, repository, integrity-checked fetch.
+
+Reference: `ModelDownloader` (src/downloader/src/main/scala/
+ModelDownloader.scala:209+) — remote Azure-blob repo → local/HDFS repo, with
+`ModelSchema` metadata (uri, hash, size, layerNames, inputNode;
+Schema.scala:30+) and `FaultToleranceUtils.retryWithTimeout`
+(ModelDownloader.scala:37-46). TPU equivalent: a filesystem repository of
+ModelBundle files with sha256 integrity checks; remote sources are any
+fsspec-style path (local path or file:// URI; http gated on environment).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import numpy as np
+
+from .models import ModelBundle
+
+__all__ = ["ModelSchema", "ModelDownloader", "retry_with_timeout"]
+
+
+def retry_with_timeout(fn: Callable, timeout_s: float = 60.0, retries: int = 3):
+    """Reference: FaultToleranceUtils.retryWithTimeout
+    (ModelDownloader.scala:37-46)."""
+    last: Exception | None = None
+    for attempt in range(retries):
+        start = time.monotonic()
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — retry semantics
+            last = e
+            if time.monotonic() - start > timeout_s:
+                raise
+            time.sleep(min(2**attempt, 10))
+    raise last  # type: ignore[misc]
+
+
+@dataclass
+class ModelSchema:
+    """Metadata for one zoo model (reference Schema.scala:30+)."""
+
+    name: str
+    uri: str                         # source path / file:// URI
+    sha256: str | None = None
+    architecture: str | None = None
+    input_shape: tuple[int, ...] = ()
+    num_outputs: int | None = None
+    class_labels: list | None = None
+    extra: dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "name": self.name, "uri": self.uri, "sha256": self.sha256,
+            "architecture": self.architecture,
+            "input_shape": list(self.input_shape),
+            "num_outputs": self.num_outputs,
+            "class_labels": self.class_labels, "extra": self.extra,
+        }
+
+    @staticmethod
+    def from_dict(d: dict[str, Any]) -> "ModelSchema":
+        return ModelSchema(
+            name=d["name"], uri=d["uri"], sha256=d.get("sha256"),
+            architecture=d.get("architecture"),
+            input_shape=tuple(d.get("input_shape", ())),
+            num_outputs=d.get("num_outputs"),
+            class_labels=d.get("class_labels"), extra=d.get("extra", {}),
+        )
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+class ModelDownloader:
+    """Local repository of ModelBundles with integrity checking.
+
+    `local_repo/index.json` lists ModelSchemas; bundle files live next to it.
+    `download_model` copies from `schema.uri` (resolving file:// / local
+    paths), verifies sha256, and registers the model in the index."""
+
+    def __init__(self, local_repo: str):
+        self.local_repo = local_repo
+        os.makedirs(local_repo, exist_ok=True)
+        self._index_path = os.path.join(local_repo, "index.json")
+
+    # -- index ---------------------------------------------------------- #
+
+    def models(self) -> list[ModelSchema]:
+        if not os.path.exists(self._index_path):
+            return []
+        with open(self._index_path) as fh:
+            return [ModelSchema.from_dict(d) for d in json.load(fh)]
+
+    def get_model(self, name: str) -> ModelSchema:
+        for s in self.models():
+            if s.name == name:
+                return s
+        raise KeyError(f"model {name!r} not in repo {self.local_repo}")
+
+    def _write_index(self, schemas: list[ModelSchema]) -> None:
+        tmp = self._index_path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump([s.to_dict() for s in schemas], fh, indent=2)
+        os.replace(tmp, self._index_path)
+
+    # -- fetch ---------------------------------------------------------- #
+
+    def local_path(self, name: str) -> str:
+        return os.path.join(self.local_repo, f"{name}.model")
+
+    def download_model(self, schema: ModelSchema, force: bool = False) -> str:
+        """Fetch + verify + register; returns the local bundle path."""
+        dest = self.local_path(schema.name)
+        if os.path.exists(dest) and not force:
+            return dest
+        src = schema.uri
+        if src.startswith("file://"):
+            src = src[len("file://"):]
+        if src.startswith(("http://", "https://")):
+            raise RuntimeError(
+                "remote HTTP model sources are unavailable in this "
+                "environment; stage the file locally and use a file:// uri"
+            )
+
+        def copy():
+            shutil.copyfile(src, dest + ".tmp")
+            os.replace(dest + ".tmp", dest)
+            return dest
+
+        retry_with_timeout(copy)
+        if schema.sha256:
+            got = _sha256(dest)
+            if got != schema.sha256:
+                os.unlink(dest)
+                raise IOError(
+                    f"hash mismatch for {schema.name}: got {got[:12]}…, "
+                    f"want {schema.sha256[:12]}…"
+                )
+        schemas = [s for s in self.models() if s.name != schema.name]
+        schemas.append(schema)
+        self._write_index(schemas)
+        return dest
+
+    def load_bundle(self, name: str) -> ModelBundle:
+        return ModelBundle.load(self.local_path(name))
+
+    # -- publish (the reference's uploader role) ------------------------- #
+
+    def publish(self, bundle: ModelBundle, name: str,
+                class_labels: list | None = None) -> ModelSchema:
+        path = self.local_path(name)
+        bundle.save(path)
+        schema = ModelSchema(
+            name=name, uri="file://" + path, sha256=_sha256(path),
+            architecture=bundle.architecture,
+            input_shape=bundle.input_shape,
+            num_outputs=bundle.config.get("num_outputs"),
+            class_labels=class_labels or bundle.class_labels,
+        )
+        schemas = [s for s in self.models() if s.name != name]
+        schemas.append(schema)
+        self._write_index(schemas)
+        return schema

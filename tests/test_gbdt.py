@@ -395,3 +395,26 @@ class TestReviewRegressions:
         # with labels in count space vs log-space margins, raw-MSE tracking
         # stopped almost immediately; the poisson NLL must train further
         assert b.best_iteration >= 3
+
+    def test_feature_fraction_on_mesh(self, mesh8):
+        # regression: per-shard feature masks broke the replicated tree state
+        x, y = make_classification(n=640)
+        b = Booster.train(
+            x, y,
+            TrainOptions(objective="binary", num_iterations=3, num_leaves=7,
+                         feature_fraction=0.5, seed=3),
+            mesh=mesh8,
+        )
+        assert b.num_trees == 3
+
+    def test_tweedie_boundary_early_stop(self):
+        rng = np.random.default_rng(6)
+        x = rng.normal(size=(600, 5))
+        y = np.exp(0.5 * x[:, 0]) + rng.random(600)
+        b = Booster.train(
+            x[:500], y[:500],
+            TrainOptions(objective="tweedie", tweedie_variance_power=1.0,
+                         num_iterations=30, num_leaves=7, early_stopping_round=5),
+            valid=(x[500:], y[500:]),
+        )
+        assert b.num_trees > 0

@@ -1,0 +1,155 @@
+"""Image subsystem tests (reference: ImageTransformerSuite,
+UnrollImageSuite, BinaryFileReaderSuite, ImageSetAugmenterSuite)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.schema import Table
+from mmlspark_tpu.image import (
+    ImageSetAugmenter,
+    ImageTransformer,
+    ResizeImageTransformer,
+    UnrollImage,
+    UnrollBinaryImage,
+    read_binary_files,
+    read_images,
+)
+from mmlspark_tpu.image.io import decode_image, encode_image
+
+
+def image_batch(n=4, h=16, w=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, size=(n, h, w, 3)).astype(np.uint8)
+
+
+class TestImageTransformer:
+    def test_resize(self):
+        x = image_batch()
+        t = ImageTransformer().resize(8, 8)
+        out = t.transform(Table({"image": x}))
+        assert np.asarray(out["image_out"]).shape == (4, 8, 8, 3)
+
+    def test_chain_resize_gray_blur(self):
+        x = image_batch()
+        t = ImageTransformer().resize(8, 8).gray().blur(3, 3)
+        out = t.transform(Table({"image": x}))
+        arr = np.asarray(out["image_out"])
+        assert arr.shape == (4, 8, 8, 1)
+
+    def test_crop(self):
+        x = image_batch(h=16, w=16)
+        t = ImageTransformer().crop(x=2, y=4, height=8, width=6)
+        out = t.transform(Table({"image": x}))
+        arr = np.asarray(out["image_out"])
+        assert arr.shape == (4, 8, 6, 3)
+        np.testing.assert_allclose(arr[0], x[0, 4:12, 2:8, :].astype(np.float32))
+
+    def test_flip_matches_numpy(self):
+        x = image_batch()
+        out = ImageTransformer().flip(1).transform(Table({"image": x}))
+        np.testing.assert_allclose(
+            np.asarray(out["image_out"]), x[:, :, ::-1, :].astype(np.float32)
+        )
+
+    def test_threshold(self):
+        x = image_batch()
+        out = ImageTransformer().threshold(127.0, 255.0).transform(Table({"image": x}))
+        arr = np.asarray(out["image_out"])
+        assert set(np.unique(arr)) <= {0.0, 255.0}
+
+    def test_ragged_list_input(self):
+        imgs = [image_batch(1, 12, 12)[0], image_batch(1, 20, 8, seed=1)[0]]
+        t = ImageTransformer().resize(8, 8)
+        out = t.transform(Table({"image": imgs, "idx": np.arange(2)}))
+        assert np.asarray(out["image_out"]).shape == (2, 8, 8, 3)
+
+    def test_gaussian_preserves_mean(self):
+        x = np.full((2, 8, 8, 3), 100.0, np.float32)
+        out = ImageTransformer().gaussian_kernel(3, 1.0).transform(Table({"image": x}))
+        arr = np.asarray(out["image_out"])
+        np.testing.assert_allclose(arr[:, 2:-2, 2:-2], 100.0, rtol=1e-4)
+
+    def test_resize_transformer_stage(self):
+        x = image_batch()
+        out = ResizeImageTransformer(height=4, width=4).transform(Table({"image": x}))
+        assert np.asarray(out["image_out"]).shape == (4, 4, 4, 3)
+
+    def test_save_load(self, tmp_path):
+        from mmlspark_tpu.core.pipeline import PipelineStage
+
+        t = ImageTransformer().resize(8, 8).flip(1)
+        p = str(tmp_path / "it")
+        t.save(p)
+        t2 = PipelineStage.load(p)
+        x = image_batch()
+        np.testing.assert_allclose(
+            np.asarray(t.transform(Table({"image": x}))["image_out"]),
+            np.asarray(t2.transform(Table({"image": x}))["image_out"]),
+        )
+
+
+class TestUnroll:
+    def test_unroll_chw_order(self):
+        x = image_batch(n=2, h=3, w=4)
+        out = UnrollImage().transform(Table({"image": x}))
+        arr = np.asarray(out["features"])
+        assert arr.shape == (2, 3 * 4 * 3)
+        # CHW: first H*W entries are channel 0
+        np.testing.assert_allclose(arr[0, : 3 * 4], x[0, :, :, 0].reshape(-1))
+
+    def test_unroll_binary(self):
+        x = image_batch(n=2, h=6, w=6)
+        blobs = [encode_image(x[i]) for i in range(2)]
+        out = UnrollBinaryImage().transform(Table({"bytes": blobs}))
+        assert np.asarray(out["features"]).shape == (2, 6 * 6 * 3)
+
+
+class TestAugmenter:
+    def test_flip_doubles_rows(self):
+        x = image_batch(n=3)
+        tbl = Table({"image": x, "label": np.arange(3.0)})
+        out = ImageSetAugmenter().transform(tbl)
+        assert len(out) == 6
+        np.testing.assert_array_equal(
+            np.asarray(out["label"]), [0.0, 1.0, 2.0, 0.0, 1.0, 2.0]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out["image"])[3:], x[:, :, ::-1, :]
+        )
+
+
+class TestIO:
+    def test_roundtrip_encode_decode(self):
+        x = image_batch(n=1)[0]
+        assert np.array_equal(decode_image(encode_image(x)), x)
+
+    def test_read_images_dir(self, tmp_path):
+        for i in range(3):
+            (tmp_path / f"img{i}.png").write_bytes(encode_image(image_batch(1, seed=i)[0]))
+        (tmp_path / "not_an_image.txt").write_text("hi")
+        tbl = read_images(str(tmp_path))
+        assert len(tbl) == 3
+        assert all(im.shape == (16, 16, 3) for im in tbl["image"])
+
+    def test_read_images_resize_stacks(self, tmp_path):
+        (tmp_path / "a.png").write_bytes(encode_image(image_batch(1, 10, 12)[0]))
+        (tmp_path / "b.png").write_bytes(encode_image(image_batch(1, 20, 8)[0]))
+        tbl = read_images(str(tmp_path), resize=(16, 16))
+        assert np.asarray(tbl["image"]).shape == (2, 16, 16, 3)
+
+    def test_read_images_drops_invalid(self, tmp_path):
+        (tmp_path / "a.png").write_bytes(encode_image(image_batch(1)[0]))
+        (tmp_path / "b.png").write_bytes(b"corrupt")
+        tbl = read_images(str(tmp_path))
+        assert len(tbl) == 1
+
+    def test_read_binary_files(self, tmp_path):
+        sub = tmp_path / "sub"
+        sub.mkdir()
+        (tmp_path / "x.bin").write_bytes(b"abc")
+        (sub / "y.bin").write_bytes(b"defgh")
+        flat = read_binary_files(str(tmp_path), glob="*.bin")
+        assert len(flat) == 1
+        rec = read_binary_files(str(tmp_path), glob="*.bin", recursive=True)
+        assert len(rec) == 2
+        assert sorted(rec["length"].tolist()) == [3, 5]

@@ -1,0 +1,299 @@
+"""HTTP + serving tests.
+
+Reference suites mirrored: HTTPTransformerSuite, SimpleHTTPTransformerSuite,
+ParserSuite, DistributedHTTPSuite/ContinuousHTTPSuite (real local servers
+driven by client POSTs), PartitionConsolidatorSuite, PowerBIWriter tests,
+cognitive service suites (against a local fake service here — the reference
+hits live Azure, gated on keys).
+"""
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.schema import Table
+from mmlspark_tpu.io_http import (
+    AnalyzeImage,
+    CustomOutputParser,
+    DetectFace,
+    HTTPRequestData,
+    HTTPResponseData,
+    HTTPTransformer,
+    JSONInputParser,
+    JSONOutputParser,
+    LanguageDetector,
+    PartitionConsolidator,
+    PowerBIWriter,
+    ServingServer,
+    SimpleHTTPTransformer,
+    TextSentiment,
+    http_send,
+    make_reply,
+    parse_request,
+    serve_model,
+)
+
+
+@pytest.fixture()
+def echo_server():
+    """Local JSON echo service; /flaky returns 429 twice then succeeds."""
+    calls = {"flaky": 0, "posts": []}
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(n)
+            calls["posts"].append(body)
+            if self.path == "/flaky":
+                calls["flaky"] += 1
+                if calls["flaky"] <= 2:
+                    self.send_response(429)
+                    self.send_header("Retry-After", "0.01")
+                    self.end_headers()
+                    return
+            payload = json.loads(body or b"{}")
+            out = json.dumps({"echo": payload}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            self.wfile.write(out)
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}", calls
+    srv.shutdown()
+    srv.server_close()
+
+
+class TestClients:
+    def test_send_and_retry_429(self, echo_server):
+        url, calls = echo_server
+        req = HTTPRequestData.from_json(url + "/flaky", {"a": 1})
+        resp = http_send(req, retries=5)
+        assert resp.ok and resp.json()["echo"] == {"a": 1}
+        assert calls["flaky"] == 3  # two 429s then success
+
+    def test_connection_error_returns_status_zero(self):
+        req = HTTPRequestData.from_json("http://127.0.0.1:1/none", {})
+        resp = http_send(req, retries=2, backoff_ms=(1,))
+        assert resp.status_code == 0 and not resp.ok
+
+
+class TestTransformers:
+    def test_http_transformer_roundtrip(self, echo_server):
+        url, _ = echo_server
+        t = Table({"payload": [{"v": 1}, {"v": 2}]})
+        pipe_in = JSONInputParser(input_col="payload", url=url)
+        http = HTTPTransformer(concurrency=2)
+        out_p = JSONOutputParser(field_path="echo.v", output_col="v")
+        out = out_p.transform(http.transform(pipe_in.transform(t)))
+        assert list(out["v"]) == [1, 2]
+
+    def test_simple_http_transformer(self, echo_server):
+        url, _ = echo_server
+        t = Table({"input": [{"q": "hi"}, {"q": "yo"}]})
+        s = SimpleHTTPTransformer(url=url, flatten_output_field="echo.q",
+                                  output_col="answer", concurrency=2)
+        out = s.transform(t)
+        assert out["answer"] == ["hi", "yo"]
+
+    def test_simple_http_error_col(self):
+        t = Table({"input": [{"a": 1}]})
+        s = SimpleHTTPTransformer(url="http://127.0.0.1:1/x", error_col="err",
+                                  output_col="out")
+        out = s.transform(t)
+        assert out["out"] == [None]
+        assert out["err"][0]["status_code"] == 0
+
+    def test_custom_output_parser(self, echo_server):
+        url, _ = echo_server
+        t = Table({"payload": [{"n": 5}]})
+        chained = HTTPTransformer().transform(
+            JSONInputParser(input_col="payload", url=url).transform(t)
+        )
+        p = CustomOutputParser()
+        p.udf = lambda r: r.status_code
+        assert p.transform(chained)["output"] == [200]
+
+
+class TestServing:
+    def test_serving_roundtrip_and_latency(self):
+        def handler(table: Table) -> Table:
+            t = parse_request(table)
+            x = np.asarray(t["x"], np.float64)
+            return make_reply(t.with_column("y", x * 2), "y")
+
+        srv = ServingServer(handler, max_latency_ms=2.0).start()
+        try:
+            # warm the path once, then measure
+            def post(v):
+                req = urllib.request.Request(
+                    srv.url, data=json.dumps({"x": v}).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=5) as r:
+                    return json.loads(r.read())
+
+            assert post(3.0)["y"] == 6.0
+            t0 = time.perf_counter()
+            for i in range(20):
+                assert post(float(i))["y"] == 2.0 * i
+            avg_ms = (time.perf_counter() - t0) / 20 * 1e3
+            assert avg_ms < 250, f"serving too slow: {avg_ms:.1f} ms"
+            assert srv.requests_answered >= 21
+        finally:
+            srv.stop()
+
+    def test_serving_batches_concurrent_requests(self):
+        seen_batches = []
+
+        def handler(table: Table) -> Table:
+            seen_batches.append(len(table))
+            t = parse_request(table)
+            return make_reply(t, "x")
+
+        srv = ServingServer(handler, max_latency_ms=50.0, max_batch_size=16).start()
+        try:
+            results = []
+
+            def post(v):
+                req = urllib.request.Request(
+                    srv.url, data=json.dumps({"x": v}).encode())
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    results.append(json.loads(r.read())["x"])
+
+            threads = [threading.Thread(target=post, args=(float(i),)) for i in range(8)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            assert sorted(results) == [float(i) for i in range(8)]
+            assert max(seen_batches) > 1  # batching actually happened
+        finally:
+            srv.stop()
+
+    def test_serve_model_end_to_end(self):
+        from mmlspark_tpu.gbdt import GBDTClassifier
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(300, 2))
+        y = (x[:, 0] > 0).astype(np.float64)
+        model = GBDTClassifier(num_iterations=5, num_leaves=7).fit(
+            Table({"features": x, "label": y})
+        )
+        srv = serve_model(model, input_cols=["f0", "f1"])
+        try:
+            req = urllib.request.Request(
+                srv.url, data=json.dumps({"f0": 2.0, "f1": 0.0}).encode())
+            with urllib.request.urlopen(req, timeout=30) as r:
+                out = json.loads(r.read())
+            assert out["prediction"] == 1.0
+        finally:
+            srv.stop()
+
+    def test_info_endpoint(self):
+        srv = ServingServer(lambda t: make_reply(parse_request(t), "x")).start()
+        try:
+            with urllib.request.urlopen(srv.url, timeout=5) as r:
+                info = json.loads(r.read())
+            assert info["name"] == "mmlspark_tpu.serving"
+        finally:
+            srv.stop()
+
+
+class TestConsolidator:
+    def test_rate_limit_and_order(self):
+        c = PartitionConsolidator(num_lanes=4, requests_per_second=200.0)
+        c.fn = lambda v: v * 10
+        t0 = time.monotonic()
+        out = c.transform(Table({"input": np.arange(20.0)}))
+        elapsed = time.monotonic() - t0
+        assert list(out["output"]) == [v * 10 for v in np.arange(20.0)]
+        assert elapsed >= 19 / 200.0  # rate limiter actually throttled
+
+
+class TestPowerBI:
+    def test_write_batches(self, echo_server):
+        url, calls = echo_server
+        t = Table({"a": np.arange(5.0), "b": list("vwxyz")})
+        n = PowerBIWriter.write(t, url, batch_size=2)
+        assert n == 3
+        sent = [json.loads(p) for p in calls["posts"][-3:]]
+        assert sum(len(b) for b in sent) == 5
+
+
+class TestCognitive:
+    def _fake(self, payload):
+        return HTTPResponseData(
+            200, "OK", {"Content-Type": "application/json"},
+            json.dumps(payload).encode(),
+        )
+
+    def test_text_sentiment_scalar_and_column(self):
+        stage = TextSentiment(url="http://fake/text/analytics", output_col="sentiment")
+        stage.set_col(text="text_col")
+        sent_bodies = []
+
+        def handler(req):
+            body = req.json()
+            sent_bodies.append(body)
+            doc = body["documents"][0]
+            return self._fake({"documents": [{"id": doc["id"], "score": 0.9}]})
+
+        stage.handler = handler
+        t = Table({"text_col": ["good day", "bad day"]})
+        out = stage.transform(t)
+        assert [d["score"] for d in out["sentiment"]] == [0.9, 0.9]
+        assert sent_bodies[0]["documents"][0]["text"] == "good day"
+
+    def test_language_detector_error_col(self):
+        stage = LanguageDetector(url="http://fake/lang", error_col="err")
+        stage.set(text="hello")
+        stage.handler = lambda req: HTTPResponseData(401, "denied")
+        out = stage.transform(Table({"dummy": [1.0]}))
+        assert out["response"] == [None]
+        assert out["err"][0]["status_code"] == 401
+
+    def test_analyze_image_body(self):
+        stage = AnalyzeImage(url="http://fake/vision",
+                             visual_features=["Tags", "Categories"])
+        stage.set_col(image_url="url_col")
+        bodies = []
+        stage.handler = lambda req: (bodies.append(req.json()),
+                                     self._fake({"tags": []}))[1]
+        stage.transform(Table({"url_col": ["http://img/1.png"]}))
+        assert bodies[0]["url"] == "http://img/1.png"
+        assert bodies[0]["visualFeatures"] == ["Tags", "Categories"]
+
+    def test_detect_face_bytes(self):
+        stage = DetectFace(url="http://fake/face", return_face_landmarks=True)
+        stage.set_col(image_bytes="img")
+        bodies = []
+        stage.handler = lambda req: (bodies.append(req.json()),
+                                     self._fake([{"faceId": "x"}]))[1]
+        stage.transform(Table({"img": [b"\x89PNG..."]}))
+        assert bodies[0]["returnFaceLandmarks"] is True
+        assert "data" in bodies[0]
+
+
+class TestSchema:
+    def test_parse_request_flattens_numeric_and_vector(self):
+        reqs = [HTTPRequestData.from_json("http://x", {"a": 1.5, "v": [1, 2]}),
+                HTTPRequestData.from_json("http://x", {"a": 2.5, "v": [3, 4]})]
+        t = parse_request(Table({"request": reqs}))
+        np.testing.assert_allclose(t["a"], [1.5, 2.5])
+        np.testing.assert_allclose(t["v"], [[1, 2], [3, 4]])
+
+    def test_make_reply_json(self):
+        t = Table({"y": np.asarray([1.0, 2.0])})
+        out = make_reply(t, "y")
+        assert out["reply"][0].json() == {"y": 1.0}

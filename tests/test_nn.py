@@ -1,0 +1,278 @@
+"""Deep-model subsystem tests.
+
+Mirrors the reference suites for cntk-model (CNTKModelSuite: transform
+shapes, batching, save/load), cntk-train (CNTKLearner fit), image-featurizer
+(ImageFeaturizerSuite layer cutting) and downloader (DownloaderSuite
+schema/hash) — run on the 8-virtual-device CPU mesh from conftest.
+"""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.schema import Table
+from mmlspark_tpu.nn import (
+    ARCHITECTURES,
+    DeepModelTransformer,
+    DNNLearner,
+    ImageFeaturizer,
+    ModelBundle,
+    ModelDownloader,
+    ModelSchema,
+    retry_with_timeout,
+)
+
+
+def image_table(n=24, hw=8, c=3, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, hw, hw, c)).astype(np.float32)
+    y = rng.integers(0, classes, size=n).astype(np.float64)
+    # make the label recoverable: class shifts the mean of channel 0
+    x[..., 0] += y[:, None, None] * 1.5
+    return Table({"features": x, "label": y})
+
+
+def vector_table(n=512, f=16, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, f)).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float64)
+    return Table({"features": x, "label": y})
+
+
+class TestModelBundle:
+    def test_init_and_forward_shapes(self):
+        b = ModelBundle.init("mlp", (16,), num_outputs=3)
+        t = DeepModelTransformer(input_col="features").set_model(b)
+        tbl = Table({"features": np.zeros((10, 16), np.float32)})
+        out = t.transform(tbl)
+        assert np.asarray(out["output"]).shape == (10, 3)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        b = ModelBundle.init("simple_cnn", (8, 8, 3), num_outputs=5)
+        p = str(tmp_path / "m.model")
+        b.save(p)
+        b2 = ModelBundle.load(p)
+        x = np.random.default_rng(0).normal(size=(4, 8, 8, 3)).astype(np.float32)
+        t1 = DeepModelTransformer(input_col="f").set_model(b)
+        t2 = DeepModelTransformer(input_col="f").set_model(b2)
+        tbl = Table({"f": x})
+        np.testing.assert_allclose(
+            np.asarray(t1.transform(tbl)["output"]),
+            np.asarray(t2.transform(tbl)["output"]),
+            rtol=1e-5,
+        )
+
+    def test_layer_names(self):
+        b = ModelBundle.init("resnet20_cifar", (16, 16, 3), num_outputs=10)
+        names = b.layer_names()
+        assert any("stage" in n for n in names)
+        assert "pooled_features" in names
+
+
+class TestDeepModelTransformer:
+    def test_batching_matches_single_pass(self):
+        # n not a multiple of mini_batch_size: padding must not leak
+        b = ModelBundle.init("mlp", (12,), num_outputs=2)
+        x = np.random.default_rng(1).normal(size=(37, 12)).astype(np.float32)
+        tbl = Table({"features": x})
+        small = DeepModelTransformer(input_col="features", mini_batch_size=8).set_model(b)
+        big = DeepModelTransformer(input_col="features", mini_batch_size=64).set_model(b)
+        np.testing.assert_allclose(
+            np.asarray(small.transform(tbl)["output"]),
+            np.asarray(big.transform(tbl)["output"]),
+            rtol=1e-5,
+        )
+
+    def test_probability_fetch(self):
+        b = ModelBundle.init("mlp", (6,), num_outputs=4)
+        t = DeepModelTransformer(
+            input_col="features", fetch_dict={"prob": "probability"}
+        ).set_model(b)
+        out = t.transform(Table({"features": np.zeros((5, 6), np.float32)}))
+        p = np.asarray(out["prob"])
+        np.testing.assert_allclose(p.sum(axis=1), 1.0, rtol=1e-5)
+
+    def test_mesh_inference_matches(self, mesh8):
+        from mmlspark_tpu.parallel.mesh import set_default_mesh
+
+        b = ModelBundle.init("mlp", (10,), num_outputs=3)
+        x = np.random.default_rng(2).normal(size=(64, 10)).astype(np.float32)
+        tbl = Table({"features": x})
+        plain = DeepModelTransformer(input_col="features").set_model(b).transform(tbl)
+        set_default_mesh(mesh8)
+        try:
+            meshy = (
+                DeepModelTransformer(input_col="features", use_mesh=True)
+                .set_model(b)
+                .transform(tbl)
+            )
+        finally:
+            set_default_mesh(None)
+        np.testing.assert_allclose(
+            np.asarray(plain["output"]), np.asarray(meshy["output"]), rtol=1e-4
+        )
+
+    def test_save_load_stage(self, tmp_path):
+        from mmlspark_tpu.core.pipeline import PipelineStage
+
+        b = ModelBundle.init("mlp", (8,), num_outputs=2)
+        t = DeepModelTransformer(input_col="features").set_model(b)
+        p = str(tmp_path / "stage")
+        t.save(p)
+        t2 = PipelineStage.load(p)
+        x = np.random.default_rng(3).normal(size=(6, 8)).astype(np.float32)
+        tbl = Table({"features": x})
+        np.testing.assert_allclose(
+            np.asarray(t.transform(tbl)["output"]),
+            np.asarray(t2.transform(tbl)["output"]),
+            rtol=1e-5,
+        )
+
+
+class TestDNNLearner:
+    def test_fit_mlp_learns(self):
+        tbl = vector_table(n=512)
+        model = DNNLearner(
+            architecture="mlp",
+            model_config={"features": (32,)},
+            epochs=20,
+            batch_size=64,
+            learning_rate=0.01,
+            use_mesh=False,
+            bfloat16=False,
+        ).fit(tbl)
+        out = model.transform(tbl)
+        acc = (out["prediction"] == tbl["label"]).mean()
+        assert acc > 0.9
+
+    def test_fit_on_mesh(self, mesh8):
+        from mmlspark_tpu.parallel.mesh import set_default_mesh
+
+        tbl = vector_table(n=512)
+        set_default_mesh(mesh8)
+        try:
+            model = DNNLearner(
+                architecture="mlp",
+                model_config={"features": (32,)},
+                epochs=10,
+                batch_size=64,
+                learning_rate=0.01,
+                use_mesh=True,
+                bfloat16=False,
+            ).fit(tbl)
+            out = model.transform(tbl)
+        finally:
+            set_default_mesh(None)
+        assert (out["prediction"] == tbl["label"]).mean() > 0.85
+
+    def test_checkpoint_resume(self, tmp_path):
+        tbl = vector_table(n=256)
+        ck = str(tmp_path / "ckpts")
+        est = DNNLearner(
+            architecture="mlp", model_config={"features": (16,)},
+            epochs=3, batch_size=64, use_mesh=False, bfloat16=False,
+            checkpoint_dir=ck, seed=7,
+        )
+        est.fit(tbl)
+        # resume: more epochs on the same dir starts from epoch 3
+        est2 = DNNLearner(
+            architecture="mlp", model_config={"features": (16,)},
+            epochs=5, batch_size=64, use_mesh=False, bfloat16=False,
+            checkpoint_dir=ck, seed=7,
+        )
+        model = est2.fit(tbl)
+        out = model.transform(tbl)
+        assert "prediction" in out.columns
+
+    def test_bn_model_trains(self):
+        tbl = image_table(n=64, hw=8, classes=4)
+        model = DNNLearner(
+            architecture="resnet",
+            model_config={"stage_sizes": (1,), "num_filters": 8, "num_outputs": 4},
+            epochs=15, batch_size=32, learning_rate=0.01,
+            use_mesh=False, bfloat16=False,
+        ).fit(tbl)
+        out = model.transform(tbl)
+        assert (out["prediction"] == tbl["label"]).mean() > 0.5
+
+    def test_transfer_freeze(self):
+        tbl = vector_table(n=128)
+        est = DNNLearner(
+            architecture="mlp", model_config={"features": (16,)},
+            epochs=2, batch_size=32, use_mesh=False, bfloat16=False,
+            trainable_prefixes=["head"],
+        )
+        init = ModelBundle.init("mlp", (16,), num_outputs=2, features=(16,))
+        before_dense = np.array(init.variables["params"]["dense_0"]["kernel"])
+        before_head = np.array(init.variables["params"]["head"]["kernel"])
+        est.init_bundle = init
+        model = est.fit(tbl)
+        after_dense = np.asarray(model.bundle.variables["params"]["dense_0"]["kernel"])
+        after_head = np.asarray(model.bundle.variables["params"]["head"]["kernel"])
+        np.testing.assert_array_equal(before_dense, after_dense)
+        assert not np.array_equal(before_head, after_head)
+
+
+class TestImageFeaturizer:
+    def test_cut_layers_features(self):
+        b = ModelBundle.init("resnet20_cifar", (16, 16, 3), num_outputs=10)
+        t = ImageFeaturizer(input_col="image").set_model(b)
+        x = np.random.default_rng(0).normal(size=(6, 16, 16, 3)).astype(np.float32)
+        out = t.transform(Table({"image": x}))
+        feats = np.asarray(out["features_out"])
+        assert feats.shape == (6, 64)  # pooled 16*2^2 channels
+
+    def test_cut_zero_gives_logits(self):
+        b = ModelBundle.init("resnet20_cifar", (16, 16, 3), num_outputs=10)
+        t = ImageFeaturizer(input_col="image", cut_output_layers=0).set_model(b)
+        x = np.zeros((4, 16, 16, 3), np.float32)
+        out = t.transform(Table({"image": x}))
+        assert np.asarray(out["features_out"]).shape == (4, 10)
+
+    def test_resize_path(self):
+        b = ModelBundle.init("resnet20_cifar", (16, 16, 3), num_outputs=10)
+        t = ImageFeaturizer(input_col="image").set_model(b)
+        x = np.zeros((2, 24, 24, 3), np.float32)  # wrong size -> resized
+        out = t.transform(Table({"image": x}))
+        assert np.asarray(out["features_out"]).shape[0] == 2
+
+
+class TestZoo:
+    def test_publish_download_load(self, tmp_path):
+        repo = ModelDownloader(str(tmp_path / "repo"))
+        b = ModelBundle.init("mlp", (4,), num_outputs=2)
+        schema = repo.publish(b, "tiny-mlp")
+        assert schema.sha256
+        assert repo.get_model("tiny-mlp").architecture == "mlp"
+        b2 = repo.load_bundle("tiny-mlp")
+        assert b2.architecture == "mlp"
+
+    def test_hash_mismatch_rejected(self, tmp_path):
+        src = str(tmp_path / "src.model")
+        ModelBundle.init("mlp", (4,), num_outputs=2).save(src)
+        repo = ModelDownloader(str(tmp_path / "repo"))
+        schema = ModelSchema(name="bad", uri=src, sha256="0" * 64)
+        with pytest.raises(IOError):
+            repo.download_model(schema)
+
+    def test_retry_with_timeout(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise ValueError("boom")
+            return "ok"
+
+        assert retry_with_timeout(flaky, retries=5) == "ok"
+        assert len(calls) == 3
+
+    def test_small_table_still_trains(self):
+        # regression: batch_size > n used to produce zero training steps
+        tbl = vector_table(n=50)
+        model = DNNLearner(
+            architecture="mlp", model_config={"features": (16,)},
+            epochs=30, batch_size=128, learning_rate=0.02,
+            use_mesh=False, bfloat16=False,
+        ).fit(tbl)
+        out = model.transform(tbl)
+        assert (out["prediction"] == tbl["label"]).mean() > 0.8
