@@ -158,6 +158,12 @@ class ServingServer:
                 table = Table({"request": [ex.request for ex in batch]})
                 out = self.handler(table)
                 replies = out["reply"]
+                if len(replies) != len(batch):
+                    raise ValueError(
+                        f"handler returned {len(replies)} replies for a "
+                        f"batch of {len(batch)} requests — handlers must "
+                        "preserve row count and order"
+                    )
             except Exception as e:  # noqa: BLE001 — per-batch failure -> 500s
                 err = HTTPResponseData(
                     500, "handler error",

@@ -45,12 +45,13 @@ class DropColumns(Transformer):
     """Reference: pipeline-stages/DropColumns.scala:19."""
 
     cols = Param(None, "columns to drop", required=True, ptype=(list, tuple))
+    ignore_missing = Param(False, "skip absent columns silently", ptype=bool)
 
     def _transform(self, table: Table) -> Table:
         missing = [c for c in self.get("cols") if c not in table]
-        if missing:
+        if missing and not self.get("ignore_missing"):
             raise KeyError(f"DropColumns: columns not found: {missing}")
-        return table.drop(*self.get("cols"))
+        return table.drop(*[c for c in self.get("cols") if c in table])
 
 
 @register_stage

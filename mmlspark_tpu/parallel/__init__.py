@@ -1,6 +1,12 @@
+"""Distribution layer: mesh bootstrap, unified collectives, ring/Ulysses
+sequence parallelism, and tensor-parallel building blocks (the single comm
+backend replacing the reference's LightGBM sockets + MPI + Spark trio,
+SURVEY.md §5.8)."""
+
 from .mesh import (
     DATA_AXIS,
     MODEL_AXIS,
+    SEQ_AXIS,
     initialize_runtime,
     get_mesh,
     set_default_mesh,
@@ -10,3 +16,35 @@ from .mesh import (
     shard_rows,
     local_device_count,
 )
+from . import collectives
+from .ring_attention import (
+    dense_attention,
+    ring_attention,
+    ulysses_attention,
+    make_ring_attention,
+    make_ulysses_attention,
+)
+from .tensor_parallel import column_parallel, row_parallel, make_tp_mlp
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "SEQ_AXIS",
+    "initialize_runtime",
+    "get_mesh",
+    "set_default_mesh",
+    "make_mesh",
+    "data_sharding",
+    "replicated_sharding",
+    "shard_rows",
+    "local_device_count",
+    "collectives",
+    "dense_attention",
+    "ring_attention",
+    "ulysses_attention",
+    "make_ring_attention",
+    "make_ulysses_attention",
+    "column_parallel",
+    "row_parallel",
+    "make_tp_mlp",
+]

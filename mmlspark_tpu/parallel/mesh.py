@@ -43,6 +43,7 @@ __all__ = [
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+SEQ_AXIS = "seq"     # sequence/context parallelism (ring / Ulysses attention)
 
 _lock = threading.Lock()
 _default_mesh: Mesh | None = None
@@ -75,17 +76,24 @@ def initialize_runtime(
 def make_mesh(
     n_data: int | None = None,
     n_model: int = 1,
+    n_seq: int = 1,
     devices: Sequence[jax.Device] | None = None,
 ) -> Mesh:
-    """Build a (data, model) mesh over the given (default: all) devices."""
+    """Build a (data[, seq], model) mesh over the given (default: all)
+    devices. The seq axis appears only when n_seq > 1 so code written
+    against the 2-axis layout keeps working."""
     devs = list(devices) if devices is not None else list(jax.devices())
     if n_data is None:
-        n_data = len(devs) // n_model
-    if n_data * n_model > len(devs):
+        n_data = len(devs) // (n_model * n_seq)
+    need = n_data * n_model * n_seq
+    if need > len(devs):
         raise ValueError(
-            f"mesh {n_data}x{n_model} needs {n_data * n_model} devices, have {len(devs)}"
+            f"mesh {n_data}x{n_seq}x{n_model} needs {need} devices, have {len(devs)}"
         )
-    grid = np.asarray(devs[: n_data * n_model]).reshape(n_data, n_model)
+    if n_seq > 1:
+        grid = np.asarray(devs[:need]).reshape(n_data, n_seq, n_model)
+        return Mesh(grid, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
+    grid = np.asarray(devs[:need]).reshape(n_data, n_model)
     return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
 
 

@@ -1,0 +1,162 @@
+"""Ring attention + Ulysses sequence parallelism for long contexts.
+
+The reference has NO sequence models and no sequence parallelism
+(SURVEY.md §5.7 — its only long-input handling is PageSplitter chunking);
+these are first-class here so the framework handles modern long-context
+workloads the reference's architecture never could.
+
+Design (the "How to Scale Your Model" recipe):
+  - **Ring attention**: the sequence is sharded over a mesh axis; each
+    device keeps its Q shard resident and the K/V shards ROTATE one
+    neighbor-hop per step via `lax.ppermute` (ICI torus neighbor exchange),
+    overlapping compute with transfer. Softmax is accumulated online
+    (flash-attention style running max/denominator), so the full (T, T)
+    score matrix never materializes — memory is O(T_local²) per step.
+  - **Ulysses**: `all_to_all` reshards (seq-sharded → head-sharded), runs
+    exact attention on full sequences for the local heads, and reshards
+    back. Cheaper for moderate T with many heads; ring wins at very long T.
+
+Both are numerically equivalent to full softmax attention (tested against
+the dense reference implementation).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = [
+    "dense_attention",
+    "ring_attention",
+    "ulysses_attention",
+    "make_ring_attention",
+    "make_ulysses_attention",
+]
+
+
+def dense_attention(q, k, v, causal: bool = False,
+                    q_offset: int = 0, k_offset: int = 0):
+    """Reference implementation: full softmax attention.
+    q: (B, Tq, H, D); k, v: (B, Tk, H, D) -> (B, Tq, H, D)."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        qpos = jnp.arange(q.shape[1]) + q_offset
+        kpos = jnp.arange(k.shape[1]) + k_offset
+        mask = qpos[:, None] >= kpos[None, :]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    # fully-masked rows (causal with all keys in the future) -> zeros
+    p = jnp.where(jnp.isfinite(s).any(-1, keepdims=True), p, 0.0)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _ring_attention_sharded(q, k, v, axis_name: str, causal: bool):
+    """Per-shard body. q/k/v: (B, T_local, H, D), sharded on T."""
+    b, t_local, h, d = q.shape
+    scale = d ** -0.5
+    n_dev = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    q_off = my * t_local
+
+    # online-softmax state; derived from q (+0*…) so the scan carry gets
+    # the same varying-over-seq-axis type as the rotating kv blocks
+    zvar = 0.0 * q.astype(jnp.float32)
+    o = zvar                                               # (B, T, H, D)
+    l = zvar[..., 0].transpose(0, 2, 1)                    # (B, H, Tq)
+    m = l - jnp.inf                                        # running max
+
+    def step(carry, s):
+        o, l, m, k_blk, v_blk = carry
+        src = (my - s) % n_dev          # origin device of the current block
+        k_off = src * t_local
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k_blk,
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = jnp.arange(t_local) + q_off
+            kpos = jnp.arange(t_local) + k_off
+            mask = qpos[:, None] >= kpos[None, :]
+            scores = jnp.where(mask[None, None], scores, -jnp.inf)
+        blk_max = scores.max(axis=-1)                       # (B, H, Tq)
+        m_new = jnp.maximum(m, blk_max)
+        # guard: fully-masked block keeps m_new=-inf; exp(-inf - -inf) trap
+        safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+        p = jnp.exp(jnp.where(jnp.isfinite(scores),
+                              scores - safe_m[..., None], -jnp.inf))
+        p = jnp.where(jnp.isfinite(scores), p, 0.0)
+        l_new = l * corr + p.sum(axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_blk,
+                        preferred_element_type=jnp.float32)
+        o_new = o * corr.transpose(0, 2, 1)[..., None] + pv
+        # rotate kv one hop for the next step (overlaps with next compute)
+        perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+        k_next = lax.ppermute(k_blk, axis_name, perm)
+        v_next = lax.ppermute(v_blk, axis_name, perm)
+        return (o_new, l_new, m_new, k_next, v_next), None
+
+    (o, l, m, _, _), _ = lax.scan(
+        step, (o, l, m, k.astype(jnp.float32), v.astype(jnp.float32)),
+        jnp.arange(n_dev),
+    )
+    denom = jnp.where(l > 0, l, 1.0).transpose(0, 2, 1)[..., None]
+    return (o / denom).astype(q.dtype)
+
+
+def make_ring_attention(mesh: Mesh, seq_axis: str, causal: bool = False):
+    """Jitted ring attention over `seq_axis` of `mesh`.
+    Inputs (B, T, H, D) with T sharded over seq_axis."""
+    fn = shard_map(
+        functools.partial(_ring_attention_sharded, axis_name=seq_axis,
+                          causal=causal),
+        mesh=mesh,
+        in_specs=(P(None, seq_axis), P(None, seq_axis), P(None, seq_axis)),
+        out_specs=P(None, seq_axis),
+    )
+    return jax.jit(fn)
+
+
+def ring_attention(q, k, v, mesh: Mesh, seq_axis: str, causal: bool = False):
+    return make_ring_attention(mesh, seq_axis, causal)(q, k, v)
+
+
+def _ulysses_sharded(q, k, v, axis_name: str, causal: bool):
+    """Per-shard body: (B, T_local, H, D) seq-sharded -> exact attention via
+    two all_to_alls (seq shards <-> head shards)."""
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+
+    def to_heads(x):
+        # (B, T_local, H, D) -> (B, T_global, H/n, D)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    out = dense_attention(qh, kh, vh, causal=causal)
+    return to_seq(out)
+
+
+def make_ulysses_attention(mesh: Mesh, seq_axis: str, causal: bool = False):
+    """Jitted Ulysses (all-to-all) attention over `seq_axis`. Requires the
+    head count to be divisible by the axis size."""
+    fn = shard_map(
+        functools.partial(_ulysses_sharded, axis_name=seq_axis, causal=causal),
+        mesh=mesh,
+        in_specs=(P(None, seq_axis), P(None, seq_axis), P(None, seq_axis)),
+        out_specs=P(None, seq_axis),
+    )
+    return jax.jit(fn)
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, seq_axis: str, causal: bool = False):
+    return make_ulysses_attention(mesh, seq_axis, causal)(q, k, v)

@@ -1,0 +1,71 @@
+"""Tensor-parallel building blocks (Megatron-style column/row sharding).
+
+The reference replicates every model whole (CNTKModel.scala:83 clones per
+partition; SURVEY.md §2.2 marks TP/PP as absent). Here tensor parallelism is
+a first-class mesh axis: a column-parallel matmul (no comm on entry, output
+sharded on features) followed by a row-parallel matmul (features-sharded in,
+ONE psum out) gives the classic MLP block with a single all-reduce — laid
+out so the collective rides ICI over the "model" axis.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = [
+    "column_parallel",
+    "row_parallel",
+    "make_tp_mlp",
+]
+
+
+def column_parallel(x, w_local, b_local=None):
+    """x replicated (on the model axis), w sharded on OUTPUT features.
+    Returns output sharded on features; no collective."""
+    y = jnp.einsum("...i,io->...o", x, w_local,
+                   preferred_element_type=jnp.float32)
+    if b_local is not None:
+        y = y + b_local
+    return y
+
+
+def row_parallel(x_local, w_local, axis_name: str, b=None):
+    """x sharded on INPUT features, w sharded on input features.
+    ONE psum over the model axis reassembles the output."""
+    y = jnp.einsum("...i,io->...o", x_local, w_local,
+                   preferred_element_type=jnp.float32)
+    y = lax.psum(y, axis_name)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def make_tp_mlp(mesh: Mesh, model_axis: str,
+                activation: Callable = jax.nn.gelu):
+    """Jitted 2-layer tensor-parallel MLP:
+    fn(x (B, F), w1 (F, H), b1 (H,), w2 (H, F), b2 (F,)) -> (B, F), with H
+    sharded over the model axis (ONE psum total, Megatron layout)."""
+
+    def body(x, w1, b1, w2, b2):
+        h = activation(column_parallel(x, w1, b1))
+        return row_parallel(h, w2, model_axis, b2)
+
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(),                       # x replicated on the model axis
+            P(None, model_axis),       # w1: output-feature sharded
+            P(model_axis),             # b1
+            P(model_axis, None),       # w2: input-feature sharded
+            P(),                       # b2 replicated
+        ),
+        out_specs=P(),
+    )
+    return jax.jit(fn)

@@ -1,0 +1,29 @@
+"""Recommendation subsystem: SAR + ranking evaluation/tuning.
+
+Reference module replaced: src/recommendation/ — `SAR`/`SARModel`
+(SAR.scala:36-205, SARModel.scala:21-167), `RecommendationIndexer`
+(RecommendationIndexer.scala:16-130), `RankingAdapter`
+(RankingAdapter.scala:66-151), `RankingEvaluator`/`AdvancedRankingMetrics`
+(RankingEvaluator.scala:14-151), `RankingTrainValidationSplit`
+(RankingTrainValidationSplit.scala:22-337).
+"""
+
+from .indexer import RecommendationIndexer, RecommendationIndexerModel
+from .sar import SAR, SARModel
+from .ranking import (
+    RankingAdapter,
+    RankingEvaluator,
+    RankingTrainValidationSplit,
+    ranking_metrics,
+)
+
+__all__ = [
+    "RecommendationIndexer",
+    "RecommendationIndexerModel",
+    "SAR",
+    "SARModel",
+    "RankingAdapter",
+    "RankingEvaluator",
+    "RankingTrainValidationSplit",
+    "ranking_metrics",
+]

@@ -1,0 +1,175 @@
+"""Recommendation tests (reference: SARSpec, RankingAdapterSpec,
+RankingTrainValidationSplitSpec in src/recommendation/src/test)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.pipeline import PipelineStage
+from mmlspark_tpu.core.schema import Table
+from mmlspark_tpu.recommendation import (
+    RankingAdapter,
+    RankingEvaluator,
+    RankingTrainValidationSplit,
+    RecommendationIndexer,
+    SAR,
+    SARModel,
+    ranking_metrics,
+)
+
+
+def interactions(n_users=20, n_items=15, seed=0):
+    """Block-structured taste: users u like items in their block."""
+    rng = np.random.default_rng(seed)
+    rows = []
+    for u in range(n_users):
+        block = u % 3
+        liked = [i for i in range(n_items) if i % 3 == block]
+        for i in rng.choice(liked, size=4, replace=True):
+            rows.append((u, int(i), 1.0))
+        # noise
+        rows.append((u, int(rng.integers(n_items)), 1.0))
+    arr = np.asarray(rows, np.float64)
+    return Table({"user": arr[:, 0], "item": arr[:, 1], "rating": arr[:, 2]})
+
+
+class TestIndexer:
+    def test_roundtrip(self):
+        t = Table({"customer": ["bob", "amy", "bob"], "product": ["x", "y", "x"]})
+        model = RecommendationIndexer(
+            user_input_col="customer", user_output_col="user",
+            item_input_col="product", item_output_col="item",
+        ).fit(t)
+        out = model.transform(t)
+        assert list(out["user"]) == [1.0, 0.0, 1.0]  # sorted levels: amy, bob
+        assert model.recover_user(1) == "bob"
+        assert model.inverse_transform_items([[0, 1]]) == [["x", "y"]]
+
+
+class TestSAR:
+    def test_affinity_shapes_and_block_structure(self):
+        t = interactions()
+        model = SAR(support_threshold=1).fit(t)
+        assert model.user_affinity.shape == (20, 15)
+        assert model.item_similarity.shape == (15, 15)
+        # same-block items must be more similar than cross-block on average
+        sim = model.item_similarity
+        same = np.mean([sim[i, j] for i in range(15) for j in range(15)
+                        if i != j and i % 3 == j % 3])
+        cross = np.mean([sim[i, j] for i in range(15) for j in range(15)
+                         if i % 3 != j % 3])
+        assert same > cross
+
+    def test_recommendations_prefer_block(self):
+        t = interactions()
+        model = SAR(support_threshold=1).fit(t)
+        # remove_seen=False: users saw mostly in-block items, so keeping
+        # them makes block preference directly observable
+        recs = model.recommend_for_all_users(k=3, remove_seen=False)
+        hits = 0
+        for u, row in zip(recs["user"], recs["recommendations"]):
+            hits += sum(1 for i in row if int(i) % 3 == int(u) % 3)
+        assert hits / (20 * 3) > 0.6
+
+    def test_remove_seen(self):
+        t = interactions()
+        model = SAR(support_threshold=1).fit(t)
+        recs = model.recommend_for_all_users(k=5, remove_seen=True)
+        u = np.asarray(t["user"], int)
+        it = np.asarray(t["item"], int)
+        seen = {(a, b) for a, b in zip(u, it)}
+        for uu, row in zip(recs["user"], recs["recommendations"]):
+            for i in row:
+                if int(i) >= 0:  # -1 marks "fewer than k unseen items"
+                    assert (int(uu), int(i)) not in seen
+
+    def test_remove_seen_marks_exhausted_slots(self):
+        # user 0 saw 4 of 5 items: only 1 unseen -> 2 slots must be -1
+        rows = [(0, i) for i in range(4)] + [(1, 0)]
+        arr = np.asarray(rows, np.float64)
+        t = Table({"user": arr[:, 0], "item": arr[:, 1]})
+        model = SAR(support_threshold=1).fit(t)
+        recs = model.recommend_for_all_users(k=3, remove_seen=True)
+        row0 = list(map(int, np.asarray(recs["recommendations"])[0]))
+        assert row0.count(-1) == 2
+        assert 4 in row0  # the single unseen item
+
+    def test_time_decay_prefers_recent(self):
+        # user 0: old interactions with item 1, recent with item 2
+        rows = [(0, 1, 0.0), (0, 1, 0.0), (0, 2, 100_000_000.0),
+                (1, 1, 0.0), (1, 2, 100_000_000.0)]
+        arr = np.asarray(rows, np.float64)
+        t = Table({"user": arr[:, 0], "item": arr[:, 1], "time": arr[:, 2]})
+        model = SAR(time_col="time", time_decay_coeff=30, support_threshold=1).fit(t)
+        aff = model.user_affinity
+        assert aff[0, 2] > aff[0, 1]
+
+    def test_transform_scores_pairs(self):
+        t = interactions()
+        model = SAR(support_threshold=1).fit(t)
+        out = model.transform(t)
+        assert len(out["prediction"]) == len(t)
+        assert np.asarray(out["prediction"]).max() > 0
+
+    def test_save_load(self, tmp_path):
+        t = interactions()
+        model = SAR(support_threshold=1).fit(t)
+        p = str(tmp_path / "sar")
+        model.save(p)
+        loaded = PipelineStage.load(p)
+        np.testing.assert_allclose(
+            np.asarray(model.transform(t)["prediction"]),
+            np.asarray(loaded.transform(t)["prediction"]),
+            rtol=1e-5,
+        )
+
+    def test_similarity_functions(self):
+        t = interactions()
+        for fn in ("jaccard", "lift", "cooccurrence"):
+            m = SAR(similarity_function=fn, support_threshold=1).fit(t)
+            assert np.isfinite(m.item_similarity).all()
+
+
+class TestRankingMetrics:
+    def test_perfect_and_empty(self):
+        m = ranking_metrics([[1, 2, 3]], [[1, 2, 3]], k=3, n_items=10)
+        assert m["ndcgAt"] == pytest.approx(1.0)
+        assert m["precisionAtk"] == pytest.approx(1.0)
+        assert m["map"] == pytest.approx(1.0)
+        assert m["mrr"] == pytest.approx(1.0)
+        m2 = ranking_metrics([[4, 5, 6]], [[1, 2, 3]], k=3)
+        assert m2["ndcgAt"] == 0.0 and m2["mrr"] == 0.0
+
+    def test_partial_order_matters(self):
+        hit_first = ranking_metrics([[1, 9, 8]], [[1]], k=3)
+        hit_last = ranking_metrics([[9, 8, 1]], [[1]], k=3)
+        assert hit_first["ndcgAt"] > hit_last["ndcgAt"]
+        assert hit_first["mrr"] > hit_last["mrr"]
+
+
+class TestRankingPipeline:
+    def test_adapter_and_evaluator(self):
+        t = interactions()
+        adapter = RankingAdapter(recommender=SAR(support_threshold=1), k=5)
+        model = adapter.fit(t)
+        scored = model.transform(t)
+        ev = RankingEvaluator(k=5, metric_name="ndcgAt")
+        val = ev.evaluate(scored)
+        assert 0.0 <= val <= 1.0
+        row = ev.transform(scored)
+        assert "ndcgAt" in row.columns
+
+    def test_train_validation_split(self):
+        t = interactions(n_users=30)
+        tvs = RankingTrainValidationSplit(
+            recommender=SAR(support_threshold=1),
+            param_maps=[{"similarity_function": "jaccard"},
+                        {"similarity_function": "lift"}],
+            k=5,
+        )
+        train, test = tvs.split(t)
+        # per-user stratified: every user in test also has train rows
+        assert set(np.asarray(test["user"], int)) <= set(np.asarray(train["user"], int))
+        model = tvs.fit(t)
+        assert len(model.validation_metrics) == 2
+        out = model.transform(t)
+        assert "prediction" in out.columns

@@ -1,0 +1,126 @@
+"""Text featurization tests (reference: TextFeaturizerSpec,
+PageSplitterSpec, MultiNGramSpec)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.pipeline import PipelineStage
+from mmlspark_tpu.core.schema import Table
+from mmlspark_tpu.text import (
+    CountVectorizer,
+    HashingTF,
+    IDF,
+    MultiNGram,
+    NGram,
+    PageSplitter,
+    StopWordsRemover,
+    TextFeaturizer,
+    Tokenizer,
+)
+
+
+def docs():
+    return Table({"text": [
+        "The quick brown fox jumps over the lazy dog",
+        "the quick brown cat sleeps",
+        "dogs and cats are animals",
+    ], "label": np.asarray([0.0, 1.0, 1.0])})
+
+
+class TestBuildingBlocks:
+    def test_tokenizer(self):
+        out = Tokenizer().transform(docs())
+        assert out["tokens"][0][:3] == ["the", "quick", "brown"]
+
+    def test_stopwords(self):
+        t = Tokenizer().transform(docs())
+        out = StopWordsRemover(input_col="tokens").transform(t)
+        assert "the" not in out["filtered"][0]
+        assert "quick" in out["filtered"][0]
+
+    def test_ngram(self):
+        t = Tokenizer().transform(docs())
+        out = NGram(input_col="tokens", n=2).transform(t)
+        assert out["ngrams"][1][0] == "the quick"
+
+    def test_hashing_tf_counts(self):
+        t = Table({"tokens": [["a", "b", "a"], ["c"]]})
+        out = HashingTF(num_features=32).transform(t)
+        tf = np.asarray(out["tf"])
+        assert tf.shape == (2, 32)
+        assert tf[0].sum() == 3.0 and tf[0].max() == 2.0
+
+    def test_count_vectorizer_vocab(self):
+        t = Table({"tokens": [["a", "b"], ["a", "c"], ["a"]]})
+        model = CountVectorizer(min_df=2).fit(t)
+        assert model.vocabulary == ["a"]
+        out = model.transform(t)
+        assert np.asarray(out["tf"]).shape == (3, 1)
+
+    def test_idf_downweights_common(self):
+        t = Table({"tf": np.asarray([[1.0, 1.0], [1.0, 0.0], [1.0, 0.0]])})
+        model = IDF().fit(t)
+        out = model.transform(t)
+        v = np.asarray(out["tfidf"])
+        assert v[0, 1] > v[0, 0]  # rarer term weighted higher
+
+
+class TestTextFeaturizer:
+    def test_end_to_end_features(self):
+        model = TextFeaturizer(num_features=256).fit(docs())
+        out = model.transform(docs())
+        feats = np.asarray(out["features"])
+        assert feats.shape == (3, 256)
+        assert (feats > 0).any()
+        assert "__tokens" not in out.columns
+
+    def test_classification_downstream(self):
+        from mmlspark_tpu.gbdt import GBDTClassifier
+
+        big = Table({
+            "text": [f"repeat{'ed' * (i % 2)} token{i % 2}" for i in range(100)],
+            "label": np.asarray([float(i % 2) for i in range(100)]),
+        })
+        model = TextFeaturizer(num_features=64).fit(big)
+        featurized = model.transform(big)
+        clf = GBDTClassifier(num_iterations=5, num_leaves=4).fit(featurized)
+        out = clf.transform(featurized)
+        assert (out["prediction"] == big["label"]).mean() > 0.9
+
+    def test_save_load(self, tmp_path):
+        model = TextFeaturizer(num_features=128).fit(docs())
+        p = str(tmp_path / "tf")
+        model.save(p)
+        loaded = PipelineStage.load(p)
+        np.testing.assert_allclose(
+            np.asarray(model.transform(docs())["features"]),
+            np.asarray(loaded.transform(docs())["features"]),
+        )
+
+
+class TestPageSplitter:
+    def test_split_lengths(self):
+        text = " ".join(["word"] * 500)  # 2499 chars
+        t = Table({"text": [text]})
+        out = PageSplitter(max_page_length=300, min_page_length=100).transform(t)
+        pages = out["pages"][0]
+        assert all(len(p) <= 300 for p in pages)
+        assert "".join(p.replace(" ", "") for p in pages) == text.replace(" ", "")
+
+    def test_short_text_one_page(self):
+        out = PageSplitter().transform(Table({"text": ["short"]}))
+        assert out["pages"][0] == ["short"]
+
+    def test_explode(self):
+        text = " ".join(["w"] * 200)
+        out = PageSplitter(max_page_length=100, min_page_length=10,
+                           explode=True).transform(Table({"text": [text], "id": [1.0]}))
+        assert len(out) > 1
+        assert all(v == 1.0 for v in out["id"])
+
+
+class TestMultiNGram:
+    def test_combines_lengths(self):
+        t = Table({"tokens": [["a", "b", "c"]]})
+        out = MultiNGram(lengths=[1, 2]).transform(t)
+        assert out["ngrams"][0] == ["a", "b", "c", "a b", "b c"]
