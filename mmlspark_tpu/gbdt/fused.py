@@ -125,9 +125,21 @@ def make_fused_train_fn(
 
         def goss_mask_of(g, kg):
             ga = jnp.abs(g) * present   # padded rows must not set the bar
-            n_top = max(int(spec.top_rate * n), 1)
-            thresh = jax.lax.top_k(ga, n_top)[0][-1]
-            is_top = ga >= thresh
+            # the top-rate bar comes from the UNPADDED row count, so padding
+            # cannot inflate n_top; under shard_map this is the local shard's
+            # real-row count — a documented per-shard approximation of the
+            # host path's global top-k (each shard keeps its own top fraction)
+            n_eff = present.sum()
+            # truncate like the host path's int(), but absorb float32
+            # representation error first (0.7*10 = 6.9999999 must yield 7);
+            # 0.25 covers float32 spacing for any realistic shard size while
+            # keeping truncation semantics for genuinely fractional products
+            n_top = jnp.maximum(
+                jnp.floor(spec.top_rate * n_eff + 0.25), 1.0
+            ).astype(jnp.int32)
+            ga_desc = -jnp.sort(-ga)
+            thresh = ga_desc[jnp.minimum(n_top - 1, n - 1)]
+            is_top = (ga >= thresh) & (present > 0)
             keep_small = jax.random.uniform(kg, ga.shape) < spec.other_rate / max(
                 1.0 - spec.top_rate, 1e-6
             )

@@ -70,9 +70,11 @@ class ServingServer:
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
         # serving counters (reference requestsSeen/Accepted/Answered,
-        # DistributedHTTPSource.scala:98-107)
+        # DistributedHTTPSource.scala:98-107); incremented from concurrent
+        # ThreadingHTTPServer handler threads, so guarded by a lock
         self.requests_seen = 0
         self.requests_answered = 0
+        self._counter_lock = threading.Lock()
 
     # ------------------------------------------------------------------ #
 
@@ -81,7 +83,8 @@ class ServingServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_POST(self):  # noqa: N802 — http.server API
-                outer.requests_seen += 1
+                with outer._counter_lock:
+                    outer.requests_seen += 1
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length) if length else b""
                 ex = _Exchange(HTTPRequestData(
@@ -100,7 +103,8 @@ class ServingServer:
                 self.end_headers()
                 if resp.entity:
                     self.wfile.write(resp.entity)
-                outer.requests_answered += 1
+                with outer._counter_lock:
+                    outer.requests_answered += 1
 
             def do_GET(self):  # noqa: N802 — health/info endpoint
                 info = json.dumps({

@@ -28,16 +28,38 @@ __all__ = ["ModelSchema", "ModelDownloader", "retry_with_timeout"]
 
 def retry_with_timeout(fn: Callable, timeout_s: float = 60.0, retries: int = 3):
     """Reference: FaultToleranceUtils.retryWithTimeout
-    (ModelDownloader.scala:37-46)."""
+    (ModelDownloader.scala:37-46). Each attempt runs in a worker thread and
+    is bounded by ``timeout_s`` even if ``fn`` hangs (the reference bounds
+    the Await on the future the same way)."""
+    import queue as _queue
+    import threading
+
     last: Exception | None = None
     for attempt in range(retries):
-        start = time.monotonic()
+        # one daemon thread per attempt: a hung fn neither blocks the caller
+        # past timeout_s nor prevents interpreter exit (ThreadPoolExecutor
+        # workers are non-daemon and joined at shutdown, so they can't be
+        # used here); a timed-out attempt is retried like any other failure
+        result_q: _queue.Queue = _queue.Queue(maxsize=1)
+
+        def run(q=result_q):
+            try:
+                q.put((True, fn()))
+            except Exception as e:  # noqa: BLE001 — retry semantics
+                q.put((False, e))
+
+        threading.Thread(target=run, daemon=True).start()
         try:
-            return fn()
-        except Exception as e:  # noqa: BLE001 — retry semantics
-            last = e
-            if time.monotonic() - start > timeout_s:
-                raise
+            ok, value = result_q.get(timeout=timeout_s)
+        except _queue.Empty:
+            last = TimeoutError(
+                f"operation exceeded {timeout_s}s (attempt {attempt + 1})"
+            )
+        else:
+            if ok:
+                return value
+            last = value
+        if attempt < retries - 1:
             time.sleep(min(2**attempt, 10))
     raise last  # type: ignore[misc]
 
@@ -135,8 +157,21 @@ class ModelDownloader:
             )
 
         def copy():
-            shutil.copyfile(src, dest + ".tmp")
-            os.replace(dest + ".tmp", dest)
+            # unique tmp per attempt: a timed-out attempt's abandoned worker
+            # may still be writing its own tmp, and must not race a retry
+            import tempfile
+
+            fd, tmp = tempfile.mkstemp(
+                prefix=f".{schema.name}.", suffix=".tmp",
+                dir=self.local_repo,
+            )
+            os.close(fd)
+            try:
+                shutil.copyfile(src, tmp)
+                os.replace(tmp, dest)
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
             return dest
 
         retry_with_timeout(copy)

@@ -50,6 +50,16 @@ class RecommendationIndexerModel(Model):
     user_levels: list = []
     item_levels: list = []
 
+    @property
+    def n_users(self) -> int:
+        """Full user vocabulary size (for SAR.set_indexer_model)."""
+        return len(self.user_levels)
+
+    @property
+    def n_items(self) -> int:
+        """Full item vocabulary size (for SAR.set_indexer_model)."""
+        return len(self.item_levels)
+
     def _transform(self, table: Table) -> Table:
         u_map = {v: i for i, v in enumerate(self.user_levels)}
         i_map = {v: i for i, v in enumerate(self.item_levels)}

@@ -63,12 +63,36 @@ class SAR(Estimator):
     start_time = Param(None, "reference time (default: max activity time)", ptype=str)
     activity_time_format = Param("%Y-%m-%d %H:%M:%S", "strptime format", ptype=str)
     start_time_format = Param("%Y-%m-%d %H:%M:%S", "strptime format", ptype=str)
+    num_users = Param(None, "explicit user vocabulary size (default: max id + 1)",
+                      ptype=int)
+    num_items = Param(None, "explicit item vocabulary size (default: max id + 1)",
+                      ptype=int)
+
+    def set_indexer_model(self, indexer_model) -> "SAR":
+        """Wire vocabulary sizes from a fitted RecommendationIndexerModel so
+        items/users with no interactions still exist in the model (reference
+        SARModel operates on the indexer's full id space,
+        RecommendationIndexer.scala:16-130)."""
+        self.set(num_users=indexer_model.n_users, num_items=indexer_model.n_items)
+        return self
 
     def _fit(self, table: Table) -> "SARModel":
         u = np.asarray(table[self.get("user_col")], np.int64)
         it = np.asarray(table[self.get("item_col")], np.int64)
-        n_users = int(u.max()) + 1
-        n_items = int(it.max()) + 1
+        if len(u) == 0 and not (self.get("num_users") and self.get("num_items")):
+            raise ValueError(
+                "cannot fit SAR on an empty table without explicit "
+                "num_users/num_items"
+            )
+        max_u = int(u.max()) if len(u) else -1
+        max_i = int(it.max()) if len(it) else -1
+        n_users = self.get("num_users") or max_u + 1
+        n_items = self.get("num_items") or max_i + 1
+        if max_u >= n_users or max_i >= n_items:
+            raise ValueError(
+                f"interaction ids exceed declared vocab: max user {max_u} "
+                f"(num_users={n_users}), max item {max_i} (num_items={n_items})"
+            )
 
         # -- affinity weights (SAR.scala:82-117) ------------------------- #
         if self.get("rating_col") and self.get("rating_col") in table:

@@ -84,7 +84,7 @@ class TestSAR:
 
     def test_remove_seen_marks_exhausted_slots(self):
         # user 0 saw 4 of 5 items: only 1 unseen -> 2 slots must be -1
-        rows = [(0, i) for i in range(4)] + [(1, 0)]
+        rows = [(0, i) for i in range(4)] + [(1, 4)]
         arr = np.asarray(rows, np.float64)
         t = Table({"user": arr[:, 0], "item": arr[:, 1]})
         model = SAR(support_threshold=1).fit(t)
@@ -92,6 +92,35 @@ class TestSAR:
         row0 = list(map(int, np.asarray(recs["recommendations"])[0]))
         assert row0.count(-1) == 2
         assert 4 in row0  # the single unseen item
+
+    def test_explicit_vocab_recommends_unseen_by_all_item(self):
+        # item 4 appears in NO interaction, but exists in the declared vocab:
+        # with remove_seen it must still be recommendable (slot filled, not -1)
+        rows = [(0, i) for i in range(4)] + [(1, 0)]
+        arr = np.asarray(rows, np.float64)
+        t = Table({"user": arr[:, 0], "item": arr[:, 1]})
+        model = SAR(support_threshold=1, num_items=5, num_users=2).fit(t)
+        assert model.item_similarity.shape == (5, 5)
+        recs = model.recommend_for_all_users(k=3, remove_seen=True)
+        row0 = list(map(int, np.asarray(recs["recommendations"])[0]))
+        assert 4 in row0  # zero-scored but unseen: a valid recommendation
+
+    def test_indexer_vocab_wiring(self):
+        # raw-id table through the indexer; SAR picks up the full vocab
+        t = Table({"customer": ["bob", "amy", "bob"],
+                   "product": ["x", "y", "x"]})
+        idx = RecommendationIndexer(
+            user_input_col="customer", user_output_col="user",
+            item_input_col="product", item_output_col="item",
+        ).fit(t)
+        indexed = idx.transform(t)
+        model = SAR(support_threshold=1).set_indexer_model(idx).fit(indexed)
+        assert model.user_affinity.shape == (idx.n_users, idx.n_items)
+
+    def test_vocab_too_small_raises(self):
+        t = Table({"user": np.asarray([0.0, 1.0]), "item": np.asarray([0.0, 7.0])})
+        with pytest.raises(ValueError, match="exceed declared vocab"):
+            SAR(num_items=3).fit(t)
 
     def test_time_decay_prefers_recent(self):
         # user 0: old interactions with item 1, recent with item 2
