@@ -130,12 +130,12 @@ def make_fused_train_fn(
             # real-row count — a documented per-shard approximation of the
             # host path's global top-k (each shard keeps its own top fraction)
             n_eff = present.sum()
-            # truncate like the host path's int(), but absorb float32
-            # representation error first (0.7*10 = 6.9999999 must yield 7);
-            # 0.25 covers float32 spacing for any realistic shard size while
-            # keeping truncation semantics for genuinely fractional products
+            # truncate like the host path's int(): a RELATIVE epsilon absorbs
+            # float32 rounding of the product (true 7.0 stored as 6.9999995
+            # must floor to 7) without crossing genuine fractional boundaries
+            # (2.8 + eps still floors to 2) the way an additive fudge would
             n_top = jnp.maximum(
-                jnp.floor(spec.top_rate * n_eff + 0.25), 1.0
+                jnp.floor(spec.top_rate * n_eff * (1.0 + 1e-6) + 1e-6), 1.0
             ).astype(jnp.int32)
             ga_desc = -jnp.sort(-ga)
             thresh = ga_desc[jnp.minimum(n_top - 1, n - 1)]

@@ -157,8 +157,10 @@ class ModelDownloader:
             )
 
         def copy():
-            # unique tmp per attempt: a timed-out attempt's abandoned worker
-            # may still be writing its own tmp, and must not race a retry
+            # unique tmp per attempt, and the WORKER never touches dest: a
+            # timed-out attempt's abandoned thread can only ever finish
+            # writing its own orphan tmp (harmless, swept below) — it cannot
+            # install an unverified file at dest behind a later sha check
             import tempfile
 
             fd, tmp = tempfile.mkstemp(
@@ -168,21 +170,25 @@ class ModelDownloader:
             os.close(fd)
             try:
                 shutil.copyfile(src, tmp)
-                os.replace(tmp, dest)
-            finally:
+            except BaseException:
                 if os.path.exists(tmp):
                     os.unlink(tmp)
-            return dest
+                raise
+            return tmp
 
-        retry_with_timeout(copy)
-        if schema.sha256:
-            got = _sha256(dest)
-            if got != schema.sha256:
-                os.unlink(dest)
-                raise IOError(
-                    f"hash mismatch for {schema.name}: got {got[:12]}…, "
-                    f"want {schema.sha256[:12]}…"
-                )
+        tmp = retry_with_timeout(copy)
+        try:
+            if schema.sha256:
+                got = _sha256(tmp)
+                if got != schema.sha256:
+                    raise IOError(
+                        f"hash mismatch for {schema.name}: got {got[:12]}…, "
+                        f"want {schema.sha256[:12]}…"
+                    )
+            os.replace(tmp, dest)  # verify-then-install, main thread only
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
         schemas = [s for s in self.models() if s.name != schema.name]
         schemas.append(schema)
         self._write_index(schemas)

@@ -99,7 +99,7 @@ class SAR(Estimator):
             w = np.asarray(table[self.get("rating_col")], np.float64)
         else:
             w = np.ones(len(u), np.float64)
-        if self.get("time_col") and self.get("time_col") in table:
+        if self.get("time_col") and self.get("time_col") in table and len(u):
             t_min = _to_minutes(table[self.get("time_col")],
                                 self.get("activity_time_format"))
             if self.get("start_time"):
