@@ -104,14 +104,18 @@ def bench_gbdt() -> dict:
         learning_rate=0.1,
     )
 
+    from mmlspark_tpu.utils.profiling import device_trace
+
     # warm-up with IDENTICAL options: the fused boosting loop is one XLA
     # program whose shape includes num_iterations, so only an identical run
     # hits the compile cache (first TPU compile ~20-40s)
     Booster.train(x, y, opts)
 
-    t0 = time.perf_counter()
-    booster = Booster.train(x, y, opts)
-    elapsed = time.perf_counter() - t0
+    # set MMLSPARK_TPU_TRACE_DIR to capture an xprof trace of the timed fit
+    with device_trace(None):
+        t0 = time.perf_counter()
+        booster = Booster.train(x, y, opts)
+        elapsed = time.perf_counter() - t0
 
     # sanity: the model must actually learn (guards against benchmarking a no-op)
     pred = booster.predict(x)
@@ -138,15 +142,24 @@ def bench_model_runner() -> dict:
     images = rng.uniform(0.0, 1.0, size=(N_IMAGES, 32, 32, 3)).astype(np.float32)
     table = Table({"image": images})
 
+    from mmlspark_tpu.utils.profiling import device_trace
+
     runner.transform(table)          # warm-up / compile
-    t0 = time.perf_counter()
-    out = runner.transform(table)
+    with device_trace(None):
+        t0 = time.perf_counter()
+        out = runner.transform(table)
     # the runner hands back host arrays, so materializing the output column
     # includes any residual device->host sync
     probs = np.asarray(out["output"])
     elapsed = time.perf_counter() - t0
     assert probs.shape[0] == N_IMAGES and np.isfinite(probs).all()
     return {"images_per_sec": N_IMAGES / elapsed, "transform_seconds": elapsed}
+
+
+def _resolve_kernel_name() -> str:
+    from mmlspark_tpu.core.kernels import resolve
+
+    return resolve("gbdt_histogram").__name__
 
 
 def main() -> None:
@@ -162,7 +175,18 @@ def main() -> None:
     print(f"bench: running on {platform} ({len(jax.devices())} device(s))",
           file=sys.stderr)
 
-    gbdt = bench_gbdt()
+    try:
+        gbdt = bench_gbdt()
+    except Exception as e:  # noqa: BLE001 — kernel-mode insurance
+        # the Pallas histogram kernel is selected automatically on TPU; if
+        # it fails to compile/run on this chip, fall back to the XLA kernel
+        # rather than losing the benchmark
+        print(f"bench: gbdt failed under auto kernel mode ({e!r}); "
+              "retrying with kernel mode 'xla'", file=sys.stderr)
+        from mmlspark_tpu.core.kernels import set_kernel_mode
+
+        set_kernel_mode("xla")
+        gbdt = bench_gbdt()
     runner = bench_model_runner()
 
     print(json.dumps({
@@ -172,6 +196,7 @@ def main() -> None:
         "vs_baseline": round(gbdt["rows_per_sec"] / BASELINE_ROWS_PER_SEC, 3),
         "extra": {
             "platform": platform,
+            "gbdt_histogram_kernel": _resolve_kernel_name(),
             "gbdt_fit_seconds": round(gbdt["fit_seconds"], 3),
             "gbdt_train_acc": round(gbdt["acc"], 4),
             "gbdt_baseline_rows_per_sec": BASELINE_ROWS_PER_SEC,

@@ -24,8 +24,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .binning import BinMapper
-from .engine import GrowConfig, TreeArrays, make_grow_fn, pad_rows
-from .objectives import get_objective, init_raw_score
+from .engine import GrowConfig, TreeArrays, make_grow_fn, pad_rows, tree_apply
+from .objectives import get_objective, get_validation_loss, init_raw_score
 from ..parallel.mesh import DATA_AXIS
 
 __all__ = ["Booster", "TrainOptions"]
@@ -278,79 +278,23 @@ class Booster:
                 val_raw = jnp.zeros((nv, k), jnp.float32)
             else:
                 val_raw = jnp.full((nv,), init, jnp.float32)
-            if k > 1:
-                yv_idx = jnp.asarray(yv.astype(int))
-            else:
-                yv_dev = jnp.asarray(yv, jnp.float32)
-            max_steps = opts.num_leaves
-
-            @jax.jit
-            def tree_val_contrib(tree: TreeArrays):
-                node = jnp.zeros((nv,), jnp.int32)
-
-                def body(_, node):
-                    f = jnp.maximum(tree.feature[node], 0)
-                    col = xv_bins[jnp.arange(nv), f]
-                    go_left = jnp.where(
-                        tree.is_categorical[node],
-                        col == tree.threshold_bin[node],
-                        col <= tree.threshold_bin[node],
-                    )
-                    leaf = tree.feature[node] < 0
-                    return jnp.where(
-                        leaf, node, jnp.where(go_left, tree.left[node], tree.right[node])
-                    )
-
-                node = jax.lax.fori_loop(0, max_steps, body, node)
-                return tree.value[node]
-
-            @jax.jit
-            def val_loss_of(raw):
-                # each objective is tracked on its OWN loss: raw is a
-                # log-space margin for poisson/gamma/tweedie (pred=exp(raw)),
-                # a quantile margin for quantile, etc. — MSE on raw would
-                # stop training at an arbitrary iteration for those.
-                obj = opts.objective
-                if obj == "binary":
-                    p = jax.nn.sigmoid(raw)
-                    eps = 1e-7
-                    return -jnp.mean(
-                        yv_dev * jnp.log(p + eps) + (1 - yv_dev) * jnp.log(1 - p + eps)
-                    )
-                if obj == "multiclass":
-                    logp = jax.nn.log_softmax(raw, axis=-1)
-                    return -jnp.mean(logp[jnp.arange(nv), yv_idx])
-                if obj == "poisson":
-                    return jnp.mean(jnp.exp(raw) - yv_dev * raw)
-                if obj == "gamma":
-                    return jnp.mean(raw + yv_dev * jnp.exp(-raw))
-                if obj == "tweedie":
-                    # rho→1 / rho→2 limits are the poisson / gamma NLLs;
-                    # the generic form divides by (1-rho)(2-rho)
-                    rho = opts.tweedie_variance_power
-                    if abs(rho - 1.0) < 1e-9:
-                        return jnp.mean(jnp.exp(raw) - yv_dev * raw)
-                    if abs(rho - 2.0) < 1e-9:
-                        return jnp.mean(raw + yv_dev * jnp.exp(-raw))
-                    return jnp.mean(
-                        -yv_dev * jnp.exp((1 - rho) * raw) / (1 - rho)
-                        + jnp.exp((2 - rho) * raw) / (2 - rho)
-                    )
-                if obj == "quantile":
-                    d = yv_dev - raw
-                    return jnp.mean(jnp.maximum(opts.alpha * d, (opts.alpha - 1) * d))
-                if obj in ("l1", "mae", "regression_l1"):
-                    return jnp.mean(jnp.abs(raw - yv_dev))
-                if obj == "mape":
-                    return jnp.mean(
-                        jnp.abs(raw - yv_dev) / jnp.maximum(jnp.abs(yv_dev), 1.0)
-                    )
-                return jnp.mean((raw - yv_dev) ** 2)
+            y_val_dev = (
+                jnp.asarray(yv.astype(int)) if k > 1 else jnp.asarray(yv, jnp.float32)
+            )
+            val_loss_fn = get_validation_loss(
+                opts.objective, alpha=opts.alpha,
+                tweedie_variance_power=opts.tweedie_variance_power,
+            )
+            val_loss_of = jax.jit(lambda raw: val_loss_fn(raw, y_val_dev))
+            tree_val_contrib = jax.jit(
+                lambda tree: tree_apply(tree, xv_bins, opts.num_leaves)
+            )
 
         # ---- fused path: one XLA program for the whole boosting loop ----
-        # (gbdt/goss/rf without early stopping; dart and early stopping need
-        # host-side per-round bookkeeping and use the loop below)
-        if opts.boosting_type in ("gbdt", "goss", "rf") and not es_active:
+        # gbdt/goss/rf, INCLUDING early stopping (tracked in the scan carry,
+        # post-stop rounds take a lax.cond no-op branch); dart needs host-side
+        # per-round drop bookkeeping and uses the loop below
+        if opts.boosting_type in ("gbdt", "goss", "rf"):
             from .fused import FusedTrainSpec, make_fused_train_fn
 
             num_rounds = opts.num_iterations - start_iter
@@ -364,25 +308,41 @@ class Booster:
                     feature_fraction=opts.feature_fraction,
                     top_rate=opts.top_rate,
                     other_rate=opts.other_rate,
+                    early_stopping_round=(
+                        opts.early_stopping_round if es_active else 0
+                    ),
                 )
                 fused = make_fused_train_fn(
                     f, num_bins, cfg, mapper.num_bins, cat_mask, obj_fn, spec,
                     mesh=mesh,
                     cache_key=(opts.objective, opts.alpha,
                                opts.tweedie_variance_power, opts.fair_c),
+                    val_loss_fn=val_loss_fn if es_active else None,
                 )
                 y_f = jnp.asarray(y_pad, jnp.float32)
                 seed = opts.seed if opts.seed else opts.bagging_seed
                 if log:
                     log(f"fused boosting: {num_rounds} rounds x {k} class(es) "
                         "in one XLA program (first run compiles)")
-                t_stack, _pred = fused(bins_dev, y_f, base_mask, pred, seed)
+                args = (bins_dev, y_f, base_mask, pred, seed)
+                if es_active:
+                    args = args + (xv_bins, y_val_dev, val_raw)
+                t_stack, _pred, (r_best_dev, stopped_dev) = fused(*args)
+                kept_rounds = num_rounds
+                if es_active:
+                    r_best = int(r_best_dev)
+                    if bool(stopped_dev) and r_best >= 0:
+                        kept_rounds = r_best + 1
+                        if log:
+                            log(f"early stop after round {r_best + start_iter} "
+                                f"(kept {kept_rounds}/{num_rounds} rounds)")
+                    best_iter = start_iter + r_best if r_best >= 0 else -1
                 if log:
-                    log(f"fused boosting: done ({num_rounds * k} trees)")
+                    log(f"fused boosting: done ({kept_rounds * k} trees)")
                 t_host = {kf: np.asarray(v) for kf, v in t_stack._asdict().items()}
                 names = ("feature", "threshold_bin", "is_categorical",
                          "left", "right", "value", "gain")
-                for r in range(num_rounds):
+                for r in range(kept_rounds):
                     for cls in range(k):
                         idx = (r, cls) if k > 1 else (r,)
                         trees.append({name: t_host[name][idx] for name in names})
@@ -393,7 +353,7 @@ class Booster:
             out = Booster._from_tree_dicts(
                 trees, tree_classes, mapper, opts, init, feature_names or []
             )
-            out.best_iteration = -1
+            out.best_iteration = best_iter
             return out
 
         bag_mask = base_mask
@@ -537,23 +497,26 @@ class Booster:
         stack = lambda key: np.stack([np.asarray(t[key]) for t in trees])  # noqa: E731
         feature = stack("feature").astype(np.int32)
         thr_bin = stack("threshold_bin").astype(np.int32)
-        # raw-space thresholds for numeric splits (categorical: the raw
-        # category value of the one-vs-rest bin, NaN if the "other" bin)
-        thr_val = np.zeros(feature.shape, np.float64)
         is_cat = stack("is_categorical").astype(bool)
-        inv_cat = {
-            j: {b: v for v, b in m.items()} for j, m in mapper.category_maps.items()
-        }
-        for t in range(feature.shape[0]):
-            for node in range(feature.shape[1]):
-                fidx = feature[t, node]
-                if fidx < 0:
-                    continue
-                b = int(thr_bin[t, node])
-                if is_cat[t, node]:
-                    thr_val[t, node] = inv_cat.get(int(fidx), {}).get(b, np.nan)
-                else:
-                    thr_val[t, node] = mapper.bin_to_value(int(fidx), b)
+        # raw-space thresholds for numeric splits (categorical: the raw
+        # category value of the one-vs-rest bin, NaN if the "other" bin) —
+        # one vectorized (feature, bin) table lookup over all (tree, node)
+        # pairs; a Python loop here is O(T*M) per fit and dominated training
+        ub = np.asarray(mapper.upper_bounds, np.float64)        # (F, B)
+        n_b = ub.shape[1]
+        cat_lut = np.full(ub.shape, np.nan)
+        for j, cmap in mapper.category_maps.items():
+            for v, b in cmap.items():
+                if 0 <= b < n_b:
+                    cat_lut[int(j), int(b)] = v
+        split = feature >= 0
+        fidx = np.where(split, feature, 0)
+        bidx = np.minimum(thr_bin, n_b - 1)
+        thr_val = np.where(
+            split,
+            np.where(is_cat, cat_lut[fidx, bidx], ub[fidx, bidx]),
+            0.0,
+        )
         return Booster(
             feature=feature,
             threshold_bin=thr_bin,

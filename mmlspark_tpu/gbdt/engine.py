@@ -71,6 +71,33 @@ def pad_rows(n: int, shards: int) -> int:
     return ((n + shards - 1) // shards) * shards
 
 
+def tree_apply(tree: "TreeArrays", bins, max_steps: int):
+    """Vectorized gather-walk of one tree over binned rows -> (n,) values.
+
+    Traceable (no jit of its own) so callers compose it inside their own
+    scan/jit — the fused loop uses it for early-stopping validation scores,
+    the booster host loop for incremental validation updates.
+    """
+    n = bins.shape[0]
+    node = jnp.zeros((n,), jnp.int32)
+
+    def body(_, node):
+        f = jnp.maximum(tree.feature[node], 0)
+        col = bins[jnp.arange(n), f]
+        go_left = jnp.where(
+            tree.is_categorical[node],
+            col == tree.threshold_bin[node],
+            col <= tree.threshold_bin[node],
+        )
+        leaf = tree.feature[node] < 0
+        return jnp.where(
+            leaf, node, jnp.where(go_left, tree.left[node], tree.right[node])
+        )
+
+    node = jax.lax.fori_loop(0, max_steps, body, node)
+    return tree.value[node]
+
+
 def _l1_threshold(g, l1):
     return jnp.sign(g) * jnp.maximum(jnp.abs(g) - l1, 0.0)
 
@@ -81,55 +108,10 @@ def _leaf_objective(g, h, l1, l2):
     return (t * t) / (h + l2 + 1e-12)
 
 
-_HIST_CHUNK = 1024
-
-
-def _histogram(bins, stats, num_bins):
-    """bins: (n, F) int32; stats: (n, C) [g, h, w, cnt] already masked.
-    Returns (F, B, C).
-
-    TPUs have no fast random scatter, so the bin accumulation is a one-hot
-    MATMUL on the MXU — (F·B, chunk) @ (chunk, C) — instead of segment_sum's
-    scatter-add (SURVEY.md §7 "hard parts": sort-based or one-hot-matmul
-    binning). Rows are processed in chunks so the one-hot transient
-    (chunk × F × B) stays VMEM-sized rather than streaming an n×F×B tensor
-    through HBM; the (F, B, C) accumulator is carried across chunks.
-    """
-    n, f = bins.shape
-    c = stats.shape[1]
-    chunk = min(_HIST_CHUNK, n)
-    pad = (-n) % chunk
-    if pad:
-        # padded rows carry all-zero stats: they land in bin 0 with weight 0
-        bins = jnp.concatenate([bins, jnp.zeros((pad, f), bins.dtype)])
-        stats = jnp.concatenate([stats, jnp.zeros((pad, c), stats.dtype)])
-    nc = (n + pad) // chunk
-
-    def body(acc, xs):
-        b_chunk, s_chunk = xs                                   # (ch,F), (ch,C)
-        oh = jax.nn.one_hot(b_chunk, num_bins, dtype=s_chunk.dtype)  # (ch,F,B)
-        # (C, ch) @ (ch, F·B): the wide F·B dim sits on the MXU lane axis
-        # (output N), so lanes are fully used; C=4 only wastes sublanes.
-        # Precision.HIGHEST: default TPU matmul rounds f32 inputs to bf16 —
-        # grad/hess sums must be exact-ish or near-tied split gains flip
-        # versus the host path (parity gates compare against fixed CSVs)
-        h = jax.lax.dot_general(
-            s_chunk, oh.reshape(chunk, f * num_bins), (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )  # (C, F·B)
-        return acc + h, None
-
-    # + 0*stats[0,0]: under shard_map the per-shard inputs carry a
-    # "varying over the data axis" type; the scan carry must match, and
-    # depending on stats gives acc0 that type without naming the axis here
-    acc0 = jnp.zeros((c, f * num_bins), jnp.float32) + 0.0 * stats[0, 0]
-    acc, _ = jax.lax.scan(
-        body,
-        acc0,
-        (bins.reshape(nc, chunk, f), stats.reshape(nc, chunk, c)),
-    )
-    return acc.reshape(c, f, num_bins).transpose(1, 2, 0)  # (F, B, C)
+# Histogram build lives in hist_kernel.py behind the kernel registry
+# (core/kernels.py, the NativeLoader analogue): Pallas TPU kernel on tpu,
+# one-hot-matmul XLA composition elsewhere.
+from .hist_kernel import histogram as _histogram  # noqa: E402
 
 
 def make_grow_fn(

@@ -1,18 +1,22 @@
 """Fused boosting: the ENTIRE multi-round training loop as one XLA program.
 
 Reference contrast: the reference dispatches one JNI call per boosting round
-(`LGBM_BoosterUpdateOneIter` in the hot loop, TrainUtils.scala:90-97), which
+(`LGBM_BoosterUpdateOneIter` in the hot loop, TrainUtils.scala:74-121), which
 is cheap on a local JVM but on TPU every per-round dispatch is a host↔device
 round trip — the dominant cost when driving a remote chip. Here the whole
 loop (objective grad/hess → bagging/GOSS masks → leaf-wise tree growth →
-prediction update) is a single `lax.scan` over rounds inside one `jit`
-(optionally one `shard_map` over the data mesh axis with a `psum` histogram
-all-reduce per split — the ICI stand-in for LightGBM's socket reduce-scatter).
-One dispatch per fit; trees come back in one transfer at the end.
+prediction update → early-stopping validation) is a single `lax.scan` over
+rounds inside one `jit` (optionally one `shard_map` over the data mesh axis
+with a `psum` histogram all-reduce per split — the ICI stand-in for
+LightGBM's socket reduce-scatter). One dispatch per fit; trees come back in
+one transfer at the end.
 
-Covers gbdt / goss / rf. dart (per-tree drop bookkeeping spanning rounds)
-and early stopping (data-dependent loop exit) stay on the host-loop path in
-booster.py.
+Covers gbdt / goss / rf, WITH early stopping for gbdt/goss: validation raw
+scores are maintained incrementally on device, the per-objective loss is
+tracked in the scan carry, and once `since_best >= early_stopping_round`
+every remaining round takes the `lax.cond` no-op branch (near-zero work) —
+the host truncates the returned tree stack to the best round. dart (per-tree
+drop bookkeeping spanning rounds) stays on the host-loop path in booster.py.
 
 Randomness is `jax.random` threaded through the scan (fold_in per round and
 per mesh shard), so the fused path is deterministic for a fixed seed but not
@@ -31,7 +35,7 @@ from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..parallel.mesh import DATA_AXIS
-from .engine import GrowConfig, TreeArrays, make_grow_fn
+from .engine import GrowConfig, TreeArrays, make_grow_fn, tree_apply
 
 __all__ = ["FusedTrainSpec", "make_fused_train_fn"]
 
@@ -48,10 +52,25 @@ class FusedTrainSpec(NamedTuple):
     feature_fraction: float = 1.0
     top_rate: float = 0.2              # goss
     other_rate: float = 0.1            # goss
+    early_stopping_round: int = 0      # 0: off (gbdt/goss only)
 
 
 _FUSED_CACHE: dict = {}
 _FUSED_CACHE_MAX = 8
+
+
+def _zero_tree(num_leaves: int) -> TreeArrays:
+    m = 2 * num_leaves - 1
+    return TreeArrays(
+        feature=jnp.full((m,), -1, jnp.int32),
+        threshold_bin=jnp.zeros((m,), jnp.int32),
+        is_categorical=jnp.zeros((m,), bool),
+        left=jnp.full((m,), -1, jnp.int32),
+        right=jnp.full((m,), -1, jnp.int32),
+        value=jnp.zeros((m,), jnp.float32),
+        is_leaf=jnp.zeros((m,), bool).at[0].set(True),
+        gain=jnp.zeros((m,), jnp.float32),
+    )
 
 
 def make_fused_train_fn(
@@ -64,24 +83,42 @@ def make_fused_train_fn(
     spec: FusedTrainSpec,
     mesh: Mesh | None = None,
     cache_key: tuple | None = None,
+    val_loss_fn: Callable | None = None,
 ):
-    """Build fn(bins, y, base_w, pred0, seed) -> (TreeArrays stacked over
-    (rounds*K, M), final_pred).
+    """Build the fused training function.
+
+    Without early stopping:
+      fn(bins, y, base_w, pred0, seed)
+        -> (TreeArrays stacked over rounds [x K], final_pred, es_info)
+    With spec.early_stopping_round > 0 (requires val_loss_fn):
+      fn(bins, y, base_w, pred0, seed, val_bins, y_val, val_raw0)
+        -> same, where es_info = (best_iter i32, stopped bool); best_iter is
+           the 0-based round index within THIS fused run (host adds any
+           warm-start offset), -1 only if the loss never improved on round 0
+           (impossible: best_loss starts at +inf).
 
     bins: (n, F) int32; y: (n,) or (n, K) float32; base_w: (n,) float32
-    (0 on padded rows); pred0: same shape as y; seed: int32 scalar.
+    (0 on padded rows); pred0: same shape as y; seed: int32 scalar;
+    val_bins: (nv, F) int32 replicated; y_val: (nv,) f32 or (nv,) i32
+    class indexes for multiclass; val_raw0: (nv,) / (nv, K) f32.
 
-    `cache_key` (hashable summary of obj_fn's construction) memoizes the
-    returned jitted function so repeated fits with the same config reuse
-    the SAME jit object — otherwise every fit would build a fresh closure
-    with an empty compile cache and pay full XLA compilation again.
+    `cache_key` (hashable summary of obj_fn/val_loss_fn construction)
+    memoizes the returned jitted function so repeated fits with the same
+    config reuse the SAME jit object — otherwise every fit would build a
+    fresh closure with an empty compile cache and pay full XLA compilation
+    again.
     """
+    es = spec.early_stopping_round > 0
+    if es and val_loss_fn is None:
+        raise ValueError("early stopping requires val_loss_fn")
     if cache_key is not None:
+        from ..core.kernels import kernel_mode
+
         full_key = (
             num_features, num_bins, cfg,
             bytes(np.asarray(feature_num_bins)),
             bytes(np.asarray(categorical_mask, np.uint8)),
-            spec, mesh, cache_key,
+            spec, mesh, cache_key, kernel_mode(),
         )
         hit = _FUSED_CACHE.get(full_key)
         if hit is not None:
@@ -104,7 +141,8 @@ def make_fused_train_fn(
         bag_frac = 0.632 if rf_mode else 1.0  # rf defaults to bootstrap-ish
     bag_freq = max(spec.bagging_freq, 1)
 
-    def loop(bins, y, base_w, pred0, seed, axis_name=None):
+    def loop(bins, y, base_w, pred0, seed,
+             val_bins=None, y_val=None, val_raw0=None, axis_name=None):
         n = bins.shape[0]  # local rows (per shard under shard_map)
         # key_repl stays replicated: the FEATURE mask must be identical on
         # every shard (it feeds the replicated tree state — a shard-varying
@@ -146,8 +184,8 @@ def make_fused_train_fn(
             amp = (1.0 - spec.top_rate) / max(spec.other_rate, 1e-6)
             return jnp.where(is_top, 1.0, jnp.where(keep_small, amp, 0.0))
 
-        def body(carry, it):
-            pred, bag = carry
+        def grow_round(pred, bag, val_raw, it):
+            """One full boosting round (K trees); returns updated state."""
             kr = jax.random.fold_in(key, it)
             if use_bagging:
                 kb = jax.random.fold_in(kr, 1)
@@ -185,28 +223,85 @@ def make_fused_train_fn(
                 new_pred = pred + jnp.stack(rowvals, axis=-1)
             else:
                 new_pred = pred + rowvals[0]
+
+            if es:
+                # validation scores update incrementally (replicated inputs)
+                for cls in range(k):
+                    contrib = tree_apply(trees_k[cls], val_bins, cfg.num_leaves)
+                    if k > 1:
+                        val_raw = val_raw.at[:, cls].add(contrib)
+                    else:
+                        val_raw = val_raw + contrib
+
             if k > 1:
                 out = jax.tree.map(lambda *a: jnp.stack(a), *trees_k)
             else:
                 out = trees_k[0]
-            return (new_pred, bag), out
+            return new_pred, bag, val_raw, out
 
-        (pred, _), trees = jax.lax.scan(
-            body, (pred0, base_w), jnp.arange(spec.num_rounds)
+        def body(carry, it):
+            pred, bag, val_raw, best_loss, best_iter, since, stopped = carry
+
+            def active(op):
+                return grow_round(*op, it)
+
+            def inactive(op):
+                pred, bag, val_raw = op
+                z = _zero_tree(cfg.num_leaves)
+                if k > 1:
+                    z = jax.tree.map(
+                        lambda a: jnp.broadcast_to(a, (k,) + a.shape), z
+                    )
+                return pred, bag, val_raw, z
+
+            if es:
+                # post-stop rounds take the near-zero-work no-op branch
+                pred, bag, val_raw, out = jax.lax.cond(
+                    stopped, inactive, active, (pred, bag, val_raw)
+                )
+            else:
+                # hot benchmark path: no conditional around the round body
+                pred, bag, val_raw, out = grow_round(pred, bag, val_raw, it)
+
+            if es:
+                vloss = val_loss_fn(val_raw, y_val)
+                improved = (~stopped) & (vloss < best_loss - 1e-9)
+                best_loss = jnp.where(improved, vloss, best_loss)
+                best_iter = jnp.where(improved, it, best_iter)
+                since = jnp.where(
+                    stopped, since, jnp.where(improved, 0, since + 1)
+                )
+                stopped = stopped | (since >= spec.early_stopping_round)
+
+            return (pred, bag, val_raw, best_loss, best_iter, since,
+                    stopped), out
+
+        if val_raw0 is None:
+            # dummy scalar keeps the carry structure static when ES is off
+            val_raw0 = jnp.zeros((), jnp.float32)
+        carry0 = (
+            pred0, base_w, val_raw0,
+            jnp.asarray(jnp.inf, jnp.float32), jnp.asarray(-1, jnp.int32),
+            jnp.asarray(0, jnp.int32), jnp.asarray(False),
         )
-        return trees, pred
+        (pred, _, _, _, best_iter, _, stopped), trees = jax.lax.scan(
+            body, carry0, jnp.arange(spec.num_rounds)
+        )
+        return trees, pred, (best_iter, stopped)
 
     y_extra = (None,) if k > 1 else ()
     if mesh is not None and mesh.shape.get(DATA_AXIS, 1) > 1:
         row = P(DATA_AXIS)
         rowk = P(DATA_AXIS, *y_extra)
+        es_in = (P(None, None), P(None), P(None, *y_extra)) if es else ()
         fn = jax.jit(shard_map(
             functools.partial(loop, axis_name=DATA_AXIS),
             mesh=mesh,
-            in_specs=(P(DATA_AXIS, None), rowk, row, rowk, P()),
+            in_specs=(P(DATA_AXIS, None), rowk, row, rowk, P()) + es_in,
             out_specs=(
                 TreeArrays(*([P()] * len(TreeArrays._fields))),
                 rowk,
+                (P(), P()),
             ),
         ))
     else:

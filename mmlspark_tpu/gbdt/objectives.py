@@ -18,7 +18,8 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 
-__all__ = ["get_objective", "sigmoid", "softmax", "init_raw_score", "OBJECTIVES"]
+__all__ = ["get_objective", "get_validation_loss", "sigmoid", "softmax",
+           "init_raw_score", "OBJECTIVES"]
 
 
 def sigmoid(x):
@@ -177,3 +178,54 @@ def init_raw_score(
     if key in ("poisson", "gamma", "tweedie"):
         return float(np.log(max(mean, 1e-12)))
     return 0.0
+
+
+def get_validation_loss(
+    objective: str,
+    alpha: float = 0.9,
+    tweedie_variance_power: float = 1.5,
+) -> Callable:
+    """Early-stopping validation loss fn(raw, y) -> scalar, on the SAME
+    scale the objective optimizes (raw is a log-space margin for
+    poisson/gamma/tweedie, a quantile margin for quantile, class logits for
+    multiclass where y is an int index vector, …) — MSE on raw would stop
+    training at an arbitrary iteration for those (reference: LightGBM's
+    per-objective default metric driving earlyStoppingRound,
+    LightGBMParams.scala:96-101).
+    """
+    obj = objective.lower()
+
+    def loss(raw, y):
+        if obj == "binary":
+            p = jax.nn.sigmoid(raw)
+            eps = 1e-7
+            return -jnp.mean(y * jnp.log(p + eps) + (1 - y) * jnp.log(1 - p + eps))
+        if obj == "multiclass":
+            logp = jax.nn.log_softmax(raw, axis=-1)
+            return -jnp.mean(logp[jnp.arange(raw.shape[0]), y])
+        if obj == "poisson":
+            return jnp.mean(jnp.exp(raw) - y * raw)
+        if obj == "gamma":
+            return jnp.mean(raw + y * jnp.exp(-raw))
+        if obj == "tweedie":
+            # rho→1 / rho→2 limits are the poisson / gamma NLLs;
+            # the generic form divides by (1-rho)(2-rho)
+            rho = tweedie_variance_power
+            if abs(rho - 1.0) < 1e-9:
+                return jnp.mean(jnp.exp(raw) - y * raw)
+            if abs(rho - 2.0) < 1e-9:
+                return jnp.mean(raw + y * jnp.exp(-raw))
+            return jnp.mean(
+                -y * jnp.exp((1 - rho) * raw) / (1 - rho)
+                + jnp.exp((2 - rho) * raw) / (2 - rho)
+            )
+        if obj == "quantile":
+            d = y - raw
+            return jnp.mean(jnp.maximum(alpha * d, (alpha - 1) * d))
+        if obj in ("l1", "mae", "regression_l1"):
+            return jnp.mean(jnp.abs(raw - y))
+        if obj == "mape":
+            return jnp.mean(jnp.abs(raw - y) / jnp.maximum(jnp.abs(y), 1.0))
+        return jnp.mean((raw - y) ** 2)
+
+    return loss

@@ -1,0 +1,73 @@
+"""Tracing/profiling utilities (SURVEY.md §5.1).
+
+The reference's tracing story is the `Timer` pipeline stage (wall-clock per
+fit/transform, Timer.scala:55-124) plus per-test timing; the TPU-native
+equivalent adds `jax.profiler` device traces — the tool that actually shows
+where HBM bandwidth and MXU time go. Usage:
+
+    with device_trace("/tmp/trace"):          # XPlane trace for xprof/tensorboard
+        booster = Booster.train(...)
+
+    with annotate("histogram"):               # named region inside a trace
+        ...
+
+    stats = profile_fn(fn, *args)             # quick wall+device timing dict
+
+`device_trace` is also switchable by env var: MMLSPARK_TPU_TRACE_DIR set ->
+every `device_trace(None)` call traces into it; unset -> no-op context.
+bench.py wraps its timed sections in `device_trace(None)` so a single env
+var turns the headline benchmark into a profiled run.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Any, Callable
+
+__all__ = ["device_trace", "annotate", "profile_fn", "block_until_ready"]
+
+
+@contextlib.contextmanager
+def device_trace(trace_dir: str | None):
+    """jax.profiler.trace wrapper; no-op when no directory is configured."""
+    target = trace_dir or os.environ.get("MMLSPARK_TPU_TRACE_DIR")
+    if not target:
+        yield None
+        return
+    import jax
+
+    os.makedirs(target, exist_ok=True)
+    with jax.profiler.trace(target):
+        yield target
+
+
+def annotate(name: str):
+    """Named region (TraceAnnotation) visible in the device trace."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+def block_until_ready(tree: Any) -> Any:
+    import jax
+
+    return jax.block_until_ready(tree)
+
+
+def profile_fn(fn: Callable, *args, warmup: int = 1, iters: int = 3,
+               **kwargs) -> dict:
+    """Quick timing: compile (first-call) time, then steady-state wall time
+    with device completion awaited. Returns seconds."""
+    t0 = time.perf_counter()
+    out = block_until_ready(fn(*args, **kwargs))
+    first = time.perf_counter() - t0
+    for _ in range(max(warmup - 1, 0)):
+        block_until_ready(fn(*args, **kwargs))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = block_until_ready(fn(*args, **kwargs))
+    steady = (time.perf_counter() - t0) / iters
+    return {"first_call_s": first, "steady_s": steady,
+            "compile_overhead_s": max(first - steady, 0.0), "out": out}

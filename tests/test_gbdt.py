@@ -7,6 +7,7 @@ SerializationFuzzing role) and a partitions-as-workers distributed check
 (mesh8 = the reference's repartition(2) trick, done with 8 CPU devices).
 """
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -418,3 +419,80 @@ class TestReviewRegressions:
             valid=(x[500:], y[500:]),
         )
         assert b.num_trees > 0
+
+
+class TestHistKernel:
+    """Kernel registry (core/kernels.py, NativeLoader analogue) + the Pallas
+    histogram kernel vs the XLA one-hot-matmul fallback."""
+
+    def test_variants_agree(self):
+        from mmlspark_tpu.gbdt.hist_kernel import (
+            histogram_pallas_interpret,
+            histogram_xla,
+        )
+
+        rng = np.random.default_rng(0)
+        n, f, b, c = 700, 5, 16, 3
+        bins = jnp.asarray(rng.integers(0, b, size=(n, f)), jnp.int32)
+        stats = jnp.asarray(rng.normal(size=(n, c)), jnp.float32)
+        hx = np.asarray(histogram_xla(bins, stats, b))
+        hp = np.asarray(histogram_pallas_interpret(bins, stats, b))
+        np.testing.assert_allclose(hx, hp, rtol=1e-5, atol=1e-5)
+        from mmlspark_tpu.gbdt.hist_kernel import histogram_xla_scatter
+        hs = np.asarray(histogram_xla_scatter(bins, stats, b))
+        np.testing.assert_allclose(hx, hs, rtol=1e-5, atol=1e-5)
+        # sanity against a plain numpy scatter
+        ref = np.zeros((f, b, c))
+        bn = np.asarray(bins)
+        st = np.asarray(stats)
+        for j in range(f):
+            np.add.at(ref[j], bn[:, j], st)
+        np.testing.assert_allclose(hx, ref, rtol=1e-4, atol=1e-4)
+
+    def test_registry_resolution(self):
+        from mmlspark_tpu.core import kernels
+
+        assert "gbdt_histogram" in kernels.registered_kernels()
+        try:
+            kernels.set_kernel_mode("pallas_interpret")
+            from mmlspark_tpu.gbdt.hist_kernel import (
+                histogram_pallas_interpret,
+            )
+
+            assert kernels.resolve("gbdt_histogram") is histogram_pallas_interpret
+            kernels.set_kernel_mode("xla")
+            from mmlspark_tpu.gbdt.hist_kernel import histogram_xla
+
+            assert kernels.resolve("gbdt_histogram") is histogram_xla
+        finally:
+            kernels.set_kernel_mode(None)
+        # auto on CPU resolves to the scatter variant (fast on CPU/GPU)
+        assert kernels.resolve("gbdt_histogram").__name__ == "histogram_xla_scatter"
+
+    def test_fit_under_interpret_kernel_matches_xla(self):
+        from mmlspark_tpu.core import kernels
+
+        x, y = make_classification(n=300)
+        opts = TrainOptions(objective="binary", num_iterations=3, num_leaves=7)
+        try:
+            kernels.set_kernel_mode("xla")
+            bx = Booster.train(x, y, opts)
+            kernels.set_kernel_mode("pallas_interpret")
+            bp = Booster.train(x, y, opts)
+        finally:
+            kernels.set_kernel_mode(None)
+        np.testing.assert_allclose(bx.predict(x), bp.predict(x), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_fused_es_stops_and_truncates_on_mesh(self, mesh8):
+        # ES must stay on the fused path and give the same model on a mesh
+        x, y = make_classification(n=1600)
+        opts = TrainOptions(
+            objective="binary", num_iterations=120, num_leaves=15,
+            early_stopping_round=5,
+        )
+        b1 = Booster.train(x[:1280], y[:1280], opts, valid=(x[1280:], y[1280:]))
+        bm = Booster.train(x[:1280], y[:1280], opts, valid=(x[1280:], y[1280:]),
+                           mesh=mesh8)
+        assert b1.num_trees < 120 and b1.num_trees == b1.best_iteration + 1
+        assert bm.num_trees < 120 and bm.num_trees == bm.best_iteration + 1
