@@ -224,27 +224,13 @@ class Booster:
         frng = np.random.default_rng(feat_seed)
         drng = np.random.default_rng(drop_seed)
 
-        use_goss = opts.boosting_type == "goss"
+        # host loop below only serves dart (gbdt/goss/rf return from the
+        # fused branch); bagging is the only row sampling dart uses
         use_bagging = (
-            opts.boosting_type in ("gbdt", "dart", "rf")
+            opts.boosting_type == "dart"
             and opts.bagging_fraction < 1.0
             and opts.bagging_freq > 0
-        ) or opts.boosting_type == "rf"
-
-        @jax.jit
-        def goss_mask(g, seed):
-            # padded rows carry nonzero gradients (y=0, pred=init) and must
-            # not set the top-k bar (mirrors the fused path's masking)
-            ga = jnp.abs(g) * (base_mask > 0)
-            n_top = max(int(opts.top_rate * n), 1)
-            thresh = jax.lax.top_k(ga, n_top)[0][-1]
-            is_top = ga >= thresh
-            key = jax.random.PRNGKey(seed)
-            keep_small = jax.random.uniform(key, ga.shape) < opts.other_rate / max(
-                1.0 - opts.top_rate, 1e-6
-            )
-            amp = (1.0 - opts.top_rate) / max(opts.other_rate, 1e-6)
-            return jnp.where(is_top, 1.0, jnp.where(keep_small, amp, 0.0))
+        )
 
         trees: list[dict[str, np.ndarray]] = list(prev_trees)
         tree_classes: list[int] = [int(c) for c in (warm.tree_class if warm is not None else [])]
@@ -356,14 +342,11 @@ class Booster:
             out.best_iteration = best_iter
             return out
 
+        # ---- dart host loop (drop bookkeeping spans rounds) --------------
         bag_mask = base_mask
         for it in range(start_iter, opts.num_iterations):
-            if use_bagging and (
-                opts.boosting_type == "rf"
-                or opts.bagging_freq == 0
-                or it % max(opts.bagging_freq, 1) == 0
-            ):
-                frac = opts.bagging_fraction if opts.bagging_fraction < 1.0 else 0.632
+            if use_bagging and it % max(opts.bagging_freq, 1) == 0:
+                frac = opts.bagging_fraction
                 keep = (rng.random(n_pad) < frac) & (base_mask_np > 0)
                 bag_mask = jnp.asarray(np.where(keep, base_mask_np, 0.0), jnp.float32)
             if opts.feature_fraction < 1.0:
@@ -376,8 +359,7 @@ class Booster:
 
             # dart: drop a subset of existing trees for this round's gradients
             # (multiclass dart falls back to gbdt updates)
-            dart_mode = opts.boosting_type == "dart" and k == 1
-            rf_mode = opts.boosting_type == "rf"
+            dart_mode = k == 1
             pred_round = pred
             dropped: list[int] = []
             if dart_mode and dart_contribs:
@@ -390,10 +372,7 @@ class Booster:
 
             for cls in range(k):
                 g, h = grad_hess(pred_round, cls)
-                mask = bag_mask
-                if use_goss:
-                    mask = base_mask * goss_mask(g, bag_seed + it)
-                tree, row_val = grow(bins_dev, g, h, mask, feat_mask)
+                tree, row_val = grow(bins_dev, g, h, bag_mask, feat_mask)
                 if es_active:
                     contrib = tree_val_contrib(tree)
                     if k > 1:
@@ -415,8 +394,6 @@ class Booster:
                     dart_contribs.append(row_val_np)
                     dart_weights.append(norm_new)
                     trees.append(_tree_to_host(tree))  # scaled at the end
-                elif rf_mode:
-                    trees.append(_tree_to_host(tree))  # pred stays at init
                 elif opts.objective == "multiclass":
                     pred = pred.at[:, cls].add(row_val)
                     trees.append(_tree_to_host(tree))
@@ -442,14 +419,11 @@ class Booster:
             if log and (it + 1) % 10 == 0:
                 log(f"iter {it + 1}/{opts.num_iterations}")
 
-        if opts.boosting_type == "dart" and k == 1 and dart_weights:
+        if k == 1 and dart_weights:
             start = len(prev_trees)
             trees = trees[:start] + [
                 _scale_tree(t, dart_weights[i]) for i, t in enumerate(trees[start:])
             ]
-        if opts.boosting_type == "rf" and trees:
-            scale = 1.0 / max(len(trees) // k, 1)
-            trees = [_scale_tree(t, scale) for t in trees]
 
         out = Booster._from_tree_dicts(
             trees, tree_classes, mapper, opts, init, feature_names or []

@@ -243,7 +243,11 @@ def make_fused_train_fn(
             pred, bag, val_raw, best_loss, best_iter, since, stopped = carry
 
             def active(op):
-                return grow_round(*op, it)
+                pred, bag, val_raw, out = grow_round(*op, it)
+                # loss evaluated INSIDE the branch: stopped rounds must not
+                # keep paying a full validation reduction for a masked result
+                vloss = val_loss_fn(val_raw, y_val)
+                return pred, bag, val_raw, out, vloss
 
             def inactive(op):
                 pred, bag, val_raw = op
@@ -252,11 +256,12 @@ def make_fused_train_fn(
                     z = jax.tree.map(
                         lambda a: jnp.broadcast_to(a, (k,) + a.shape), z
                     )
-                return pred, bag, val_raw, z
+                # +inf can never register as an improvement
+                return pred, bag, val_raw, z, jnp.asarray(jnp.inf, jnp.float32)
 
             if es:
                 # post-stop rounds take the near-zero-work no-op branch
-                pred, bag, val_raw, out = jax.lax.cond(
+                pred, bag, val_raw, out, vloss = jax.lax.cond(
                     stopped, inactive, active, (pred, bag, val_raw)
                 )
             else:
@@ -264,7 +269,6 @@ def make_fused_train_fn(
                 pred, bag, val_raw, out = grow_round(pred, bag, val_raw, it)
 
             if es:
-                vloss = val_loss_fn(val_raw, y_val)
                 improved = (~stopped) & (vloss < best_loss - 1e-9)
                 best_loss = jnp.where(improved, vloss, best_loss)
                 best_iter = jnp.where(improved, it, best_iter)

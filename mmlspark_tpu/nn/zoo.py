@@ -142,8 +142,27 @@ class ModelDownloader:
     def local_path(self, name: str) -> str:
         return os.path.join(self.local_repo, f"{name}.model")
 
+    def sweep_orphan_tmps(self, min_age_s: float = 3600.0) -> int:
+        """Remove stale `.*.tmp` files left by abandoned (timed-out) copy
+        workers. Age-gated: a fresh tmp may still be written by a live
+        worker thread. Returns the number removed."""
+        removed = 0
+        now = time.time()
+        for fname in os.listdir(self.local_repo):
+            if not (fname.startswith(".") and fname.endswith(".tmp")):
+                continue
+            path = os.path.join(self.local_repo, fname)
+            try:
+                if now - os.path.getmtime(path) > min_age_s:
+                    os.unlink(path)
+                    removed += 1
+            except OSError:
+                pass  # raced with a concurrent sweep/writer
+        return removed
+
     def download_model(self, schema: ModelSchema, force: bool = False) -> str:
         """Fetch + verify + register; returns the local bundle path."""
+        self.sweep_orphan_tmps()
         dest = self.local_path(schema.name)
         if os.path.exists(dest) and not force:
             return dest
@@ -159,8 +178,9 @@ class ModelDownloader:
         def copy():
             # unique tmp per attempt, and the WORKER never touches dest: a
             # timed-out attempt's abandoned thread can only ever finish
-            # writing its own orphan tmp (harmless, swept below) — it cannot
-            # install an unverified file at dest behind a later sha check
+            # writing its own orphan tmp (age-swept by sweep_orphan_tmps on
+            # later downloads) — it cannot install an unverified file at
+            # dest behind a later sha check
             import tempfile
 
             fd, tmp = tempfile.mkstemp(

@@ -1,0 +1,116 @@
+"""Deterministic synthetic datasets for the quality-gate suites.
+
+Stand-ins for the reference's $DATASETS_HOME benchmark CSVs
+(Benchmarks.scala:114-125; e.g. BreastTissue / PimaIndian / airfoil /
+energyefficiency in benchmarks_VerifyLightGBM{Classifier,Regressor}.csv) —
+zero-egress environment, so each is a seeded generator with the same role:
+small tabular problems of varying difficulty, class arity, and noise.
+Generators are frozen: changing them invalidates the committed baselines.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mmlspark_tpu.core.schema import Table
+
+
+def _table(x, y):
+    return Table({"features": x, "label": y.astype(np.float64)})
+
+
+def breast_tissue_like(n=420, f=9, seed=11):
+    """6-class, well-separated clusters + overlap (BreastTissue role)."""
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(scale=2.2, size=(6, f))
+    y = rng.integers(0, 6, size=n)
+    x = centers[y] + rng.normal(scale=1.0, size=(n, f))
+    return _table(x, y)
+
+
+def pima_like(n=768, f=8, seed=12):
+    """Binary, noisy nonlinear boundary (PimaIndian diabetes role)."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, f))
+    logits = x[:, 0] + 0.8 * x[:, 1] * x[:, 2] - 0.6 * np.abs(x[:, 3]) + 0.4
+    y = (logits + rng.normal(scale=1.2, size=n) > 0).astype(int)
+    return _table(x, y)
+
+
+def breast_cancer_like(n=560, f=10, seed=13):
+    """Binary, nearly separable (breast-cancer role: reference gbdt acc
+    0.9925)."""
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 2, size=n)
+    x = rng.normal(size=(n, f)) + y[:, None] * np.linspace(1.6, 0.2, f)
+    return _table(x, y)
+
+
+def transfusion_like(n=748, f=4, seed=14):
+    """Binary, weak signal / high Bayes error (blood-transfusion role)."""
+    rng = np.random.default_rng(seed)
+    x = np.abs(rng.normal(size=(n, f))) * [1.0, 3.0, 10.0, 20.0]
+    logits = 0.3 * x[:, 1] - 0.04 * x[:, 3]
+    y = (logits + rng.normal(scale=1.0, size=n) > 0.4).astype(int)
+    return _table(x, y)
+
+
+def airfoil_like(n=1503, f=5, seed=21):
+    """Regression, smooth nonlinear response (airfoil noise role)."""
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(-1, 1, size=(n, f))
+    y = (
+        20.0 * np.sin(2.5 * x[:, 0])
+        + 8.0 * x[:, 1] * x[:, 2]
+        + 5.0 * np.square(x[:, 3])
+        + rng.normal(scale=1.5, size=n)
+        + 120.0
+    )
+    return _table(x, y)
+
+
+def energy_efficiency_like(n=768, f=8, seed=22):
+    """Regression, additive with interactions (energyefficiency role)."""
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(0, 1, size=(n, f))
+    y = (
+        15.0 * x[:, 0]
+        - 10.0 * x[:, 1]
+        + 6.0 * x[:, 2] * x[:, 3]
+        + 3.0 * np.sin(6.0 * x[:, 4])
+        + rng.normal(scale=1.0, size=n)
+        + 20.0
+    )
+    return _table(x, y)
+
+
+def concrete_like(n=1030, f=8, seed=23):
+    """Regression, heteroscedastic noise (Concrete strength role)."""
+    rng = np.random.default_rng(seed)
+    x = np.abs(rng.normal(size=(n, f)))
+    base = 12.0 * x[:, 0] + 6.0 * np.sqrt(x[:, 1] + 0.1) - 4.0 * x[:, 2]
+    y = base + rng.normal(scale=0.5 + 0.8 * x[:, 3], size=n) + 35.0
+    return _table(x, y)
+
+
+def counts_like(n=900, f=6, seed=24):
+    """Poisson counts (for poisson/tweedie objective gates)."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, f))
+    lam = np.exp(0.6 * x[:, 0] - 0.4 * x[:, 1] + 0.1)
+    y = rng.poisson(lam).astype(float)
+    return _table(x, y)
+
+
+CLASSIFICATION = {
+    "BreastTissue": breast_tissue_like,
+    "PimaIndian": pima_like,
+    "BreastCancer": breast_cancer_like,
+    "Transfusion": transfusion_like,
+}
+
+REGRESSION = {
+    "airfoil": airfoil_like,
+    "energyefficiency": energy_efficiency_like,
+    "Concrete": concrete_like,
+}

@@ -1,0 +1,99 @@
+"""Benchmark-CSV quality-gate harness.
+
+Reference: `Benchmark`/`Benchmarks` (src/core/test/benchmarks/src/main/scala/
+Benchmarks.scala:15-112): each suite computes a metric per
+(dataset × boosting type), appends it to a round-trippable CSV, writes
+`new_benchmarks_<suite>.csv` next to the committed baseline, and
+`verifyBenchmarks` (:93-110) asserts every value is within the benchmark's
+precision of the committed `benchmarks_<suite>.csv`. A metric drift beyond
+precision in ANY mode turns the suite red; the new CSV makes intentional
+re-baselining a file copy.
+
+Datasets: the reference loads $DATASETS_HOME CSVs fetched by the build
+(Benchmarks.scala:114-125); this environment has zero egress, so
+datasets.py generates deterministic seeded synthetic tables with the same
+roles (binary / multiclass / regression), and the baselines committed here
+gate THIS framework's trained quality the same way.
+
+Re-baselining: MMLSPARK_TPU_REGEN_BENCHMARKS=1 pytest tests/benchmarks
+rewrites the committed baseline files in place.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from dataclasses import dataclass
+from pathlib import Path
+
+HERE = Path(__file__).parent
+REGEN_ENV = "MMLSPARK_TPU_REGEN_BENCHMARKS"
+
+
+@dataclass
+class Benchmark:
+    """One gated measurement (reference Benchmarks.scala:15-30)."""
+
+    name: str
+    value: float
+    precision: float
+
+    def round_value(self) -> float:
+        return round(self.value, 8)
+
+
+def baseline_path(suite: str) -> Path:
+    return HERE / f"benchmarks_{suite}.csv"
+
+
+def new_path(suite: str) -> Path:
+    return HERE / f"new_benchmarks_{suite}.csv"
+
+
+def write_csv(path: Path, benchmarks: list[Benchmark]) -> None:
+    with open(path, "w", newline="") as fh:
+        w = csv.writer(fh)
+        w.writerow(["name", "value", "precision"])
+        for b in benchmarks:
+            w.writerow([b.name, b.round_value(), b.precision])
+
+
+def read_csv(path: Path) -> dict[str, Benchmark]:
+    out: dict[str, Benchmark] = {}
+    with open(path, newline="") as fh:
+        for row in csv.DictReader(fh):
+            out[row["name"]] = Benchmark(
+                row["name"], float(row["value"]), float(row["precision"])
+            )
+    return out
+
+
+def verify_benchmarks(suite: str, benchmarks: list[Benchmark]) -> None:
+    """Reference verifyBenchmarks (Benchmarks.scala:93-110): write the new
+    CSV, then compare every entry against the committed baseline within the
+    BASELINE's precision. Missing/extra entries are failures too."""
+    write_csv(new_path(suite), benchmarks)
+    if os.environ.get(REGEN_ENV):
+        write_csv(baseline_path(suite), benchmarks)
+        return
+    base = baseline_path(suite)
+    assert base.exists(), (
+        f"no committed baseline {base}; run with {REGEN_ENV}=1 to create it"
+    )
+    expected = read_csv(base)
+    got = {b.name: b for b in benchmarks}
+    missing = sorted(set(expected) - set(got))
+    extra = sorted(set(got) - set(expected))
+    assert not missing and not extra, (
+        f"benchmark set drift: missing={missing} extra={extra} "
+        f"(re-baseline with {REGEN_ENV}=1 if intentional)"
+    )
+    errors = []
+    for name, exp in expected.items():
+        g = got[name]
+        if abs(g.value - exp.value) > exp.precision:
+            errors.append(
+                f"{name}: got {g.value:.6f}, baseline {exp.value:.6f} "
+                f"± {exp.precision}"
+            )
+    assert not errors, "quality-gate regressions:\n" + "\n".join(errors)

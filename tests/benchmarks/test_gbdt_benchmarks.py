@@ -1,0 +1,114 @@
+"""GBDT quality-gate regression suites.
+
+Reference: VerifyLightGBMClassifier / VerifyLightGBMRegressor benchmark
+tests asserting accuracy / RMSE per (dataset × boosting type) against the
+committed CSVs (src/lightgbm/src/test/resources/
+benchmarks_VerifyLightGBMClassifier.csv:1-33, _Regressor.csv:1-21, compared
+by Benchmarks.verifyBenchmarks, Benchmarks.scala:93-110). Any regression in
+any boosting mode or key objective turns these suites red.
+"""
+
+import numpy as np
+
+from mmlspark_tpu.gbdt import GBDTClassifier, GBDTRegressor
+
+from .datasets import CLASSIFICATION, REGRESSION, counts_like
+from .harness import Benchmark, verify_benchmarks
+
+BOOSTING_TYPES = ("gbdt", "rf", "dart", "goss")
+
+
+def _split(table, frac=0.75):
+    n = len(table)
+    cut = int(n * frac)
+    return table.slice(0, cut), table.slice(cut, n)
+
+
+def _accuracy(model, table) -> float:
+    out = model.transform(table)
+    pred = np.asarray(out["prediction"], np.float64)
+    y = np.asarray(table["label"], np.float64)
+    return float((pred == y).mean())
+
+
+def _rmse(model, table) -> float:
+    out = model.transform(table)
+    pred = np.asarray(out["prediction"], np.float64)
+    y = np.asarray(table["label"], np.float64)
+    return float(np.sqrt(np.mean((pred - y) ** 2)))
+
+
+class TestClassifierBenchmarks:
+    def test_verify_classifier_benchmarks(self):
+        results = []
+        for ds_name, gen in CLASSIFICATION.items():
+            table = gen()
+            train, test = _split(table)
+            for boosting in BOOSTING_TYPES:
+                clf = GBDTClassifier(
+                    boosting_type=boosting,
+                    num_iterations=30,
+                    num_leaves=15,
+                    bagging_fraction=0.85,
+                    bagging_freq=1,
+                    seed=42,
+                )
+                acc = _accuracy(clf.fit(train), test)
+                # the gate must catch real regressions but tolerate benign
+                # cross-backend float drift (reference uses ±0.01…±0.1)
+                results.append(Benchmark(f"{ds_name}_{boosting}", acc, 0.04))
+        verify_benchmarks("classifier", results)
+
+
+class TestRegressorBenchmarks:
+    def test_verify_regressor_benchmarks(self):
+        results = []
+        for ds_name, gen in REGRESSION.items():
+            table = gen()
+            train, test = _split(table)
+            y_test = np.asarray(test["label"], np.float64)
+            scale = float(y_test.std())
+            for boosting in BOOSTING_TYPES:
+                reg = GBDTRegressor(
+                    boosting_type=boosting,
+                    num_iterations=30,
+                    num_leaves=15,
+                    bagging_fraction=0.85,
+                    bagging_freq=1,
+                    seed=42,
+                )
+                rmse = _rmse(reg.fit(train), test)
+                results.append(
+                    Benchmark(f"{ds_name}_{boosting}", rmse, 0.12 * scale)
+                )
+        verify_benchmarks("regressor", results)
+
+    def test_verify_objective_benchmarks(self):
+        """Key regressor objectives beyond L2 (reference
+        LightGBMRegressor.scala:17-36: quantile for drug discovery, poisson /
+        tweedie for counts, l1/huber robustness)."""
+        results = []
+        table = REGRESSION["airfoil"]()
+        train, test = _split(table)
+        y_scale = float(np.asarray(test["label"]).std())
+        for objective in ("l1", "huber", "quantile"):
+            reg = GBDTRegressor(objective=objective, num_iterations=30,
+                                num_leaves=15, seed=42)
+            rmse = _rmse(reg.fit(train), test)
+            results.append(Benchmark(f"airfoil_{objective}", rmse, 0.15 * y_scale))
+
+        counts = counts_like()
+        ctrain, ctest = _split(counts)
+        yc = np.asarray(ctest["label"], np.float64)
+        for objective in ("poisson", "tweedie"):
+            reg = GBDTRegressor(objective=objective, num_iterations=30,
+                                num_leaves=15, seed=42)
+            out = reg.fit(ctrain).transform(ctest)
+            pred = np.asarray(out["prediction"], np.float64)
+            # count objectives are gated on mean poisson deviance
+            eps = 1e-9
+            dev = float(np.mean(
+                2 * (yc * np.log((yc + eps) / (pred + eps)) - (yc - pred))
+            ))
+            results.append(Benchmark(f"counts_{objective}_deviance", dev, 0.15))
+        verify_benchmarks("objectives", results)
