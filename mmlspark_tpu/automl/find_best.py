@@ -27,6 +27,17 @@ class FindBestModel(HasLabelCol, Estimator):
     models = Param(None, "list of FITTED transformers to compare", required=True)
     evaluation_metric = Param("accuracy", "metric to rank by", ptype=str)
 
+    def _save_state(self):
+        return {"models": list(self.get("models"))}
+
+    def _load_state(self, state):
+        self.set(models=state["models"])
+
+    def params_to_dict(self):
+        d = dict(self._values)
+        d.pop("models", None)
+        return d
+
     def _fit(self, table: Table) -> "BestModel":
         models: list[Transformer] = self.get("models")
         metric = self.get("evaluation_metric")

@@ -127,6 +127,17 @@ class ImageLIME(HasInputCol, HasOutputCol, Transformer):
     fill_value = Param(0.0, "censored-pixel fill value", ptype=float)
     seed = Param(0, "mask sampling seed", ptype=int)
 
+    def _save_state(self):
+        return {"model": self.get("model")}
+
+    def _load_state(self, state):
+        self.set(model=state["model"])
+
+    def params_to_dict(self):
+        d = dict(self._values)
+        d.pop("model", None)
+        return d
+
     def _transform(self, table: Table) -> Table:
         model: Transformer = self.get("model")
         col = table[self.get("input_col")]

@@ -51,6 +51,17 @@ class _AutoTrainer(HasLabelCol, Estimator):
             raise TypeError("model param must be an Estimator")
         return est
 
+    def _save_state(self) -> dict[str, Any]:
+        return {"model": self.get("model")}
+
+    def _load_state(self, state: dict[str, Any]) -> None:
+        self.set(model=state["model"])
+
+    def params_to_dict(self) -> dict[str, Any]:
+        d = dict(self._values)
+        d.pop("model", None)
+        return d
+
 
 @register_stage
 class TrainClassifier(_AutoTrainer):

@@ -109,6 +109,44 @@ class RandomSpace:
             yield {n: d.sample(rng) for n, d in self.space.items()}
 
 
+def space_to_json(space: Any) -> dict:
+    """JSON codec for param spaces so TuneHyperparameters can save/load
+    (role of the reference's ComplexParam serialization for EstimatorParam)."""
+    if isinstance(space, GridSpace):
+        return {"kind": "grid", "space": {k: _dist_to_json(d) for k, d in space.space.items()}}
+    if isinstance(space, RandomSpace):
+        return {"kind": "random", "num_runs": space.num_runs, "seed": space.seed,
+                "space": {k: _dist_to_json(d) for k, d in space.space.items()}}
+    if isinstance(space, dict):
+        return {"kind": "dict", "space": {k: _dist_to_json(d) for k, d in space.items()}}
+    raise TypeError(f"cannot serialize param space of type {type(space).__name__}")
+
+
+def space_from_json(doc: dict) -> Any:
+    dists = {k: _dist_from_json(d) for k, d in doc["space"].items()}
+    if doc["kind"] == "grid":
+        return GridSpace(dists)
+    if doc["kind"] == "random":
+        return RandomSpace(dists, num_runs=doc["num_runs"], seed=doc["seed"])
+    return dists
+
+
+def _dist_to_json(dist: Any) -> dict:
+    if isinstance(dist, DiscreteHyperParam):
+        return {"kind": "discrete", "values": list(dist.values)}
+    if isinstance(dist, RangeHyperParam):
+        return {"kind": "range", "low": dist.low, "high": dist.high,
+                "is_int": dist.is_int, "n_grid": dist.n_grid}
+    raise TypeError(f"cannot serialize hyperparam dist {type(dist).__name__}")
+
+
+def _dist_from_json(doc: dict) -> Any:
+    if doc["kind"] == "discrete":
+        return DiscreteHyperParam(doc["values"])
+    return RangeHyperParam(doc["low"], doc["high"], is_int=doc["is_int"],
+                           n_grid=doc["n_grid"])
+
+
 def _kfold_indices(n: int, k: int, seed: int) -> list[tuple[np.ndarray, np.ndarray]]:
     """MLUtils.kFold equivalent."""
     rng = np.random.default_rng(seed)
@@ -147,6 +185,24 @@ class TuneHyperparameters(HasLabelCol, Estimator):
         if isinstance(sp, (GridSpace, RandomSpace)):
             return sp
         return RandomSpace(dict(sp), self.get("num_runs"), self.get("seed"))
+
+    def _save_state(self) -> dict[str, Any]:
+        models = self.get("models")
+        return {
+            "models": list(models) if isinstance(models, (list, tuple)) else [models],
+            "models_was_list": isinstance(models, (list, tuple)),
+            "param_space_doc": space_to_json(self.get("param_space")),
+        }
+
+    def _load_state(self, state: dict[str, Any]) -> None:
+        models = state["models"] if state["models_was_list"] else state["models"][0]
+        self.set(models=models, param_space=space_from_json(state["param_space_doc"]))
+
+    def params_to_dict(self) -> dict[str, Any]:
+        d = dict(self._values)
+        d.pop("models", None)
+        d.pop("param_space", None)
+        return d
 
     def _fit(self, table: Table) -> "TuneHyperparametersModel":
         models = self.get("models")

@@ -131,6 +131,17 @@ class RankingAdapter(Estimator):
     user_col = Param("user", "user id column", ptype=str)
     item_col = Param("item", "item id column", ptype=str)
 
+    def _save_state(self):
+        return {"recommender": self.get("recommender")}
+
+    def _load_state(self, state):
+        self.set(recommender=state["recommender"])
+
+    def params_to_dict(self):
+        d = dict(self._values)
+        d.pop("recommender", None)
+        return d
+
     def _fit(self, table: Table) -> "RankingAdapterModel":
         fitted = self.get("recommender").fit(table)
         m = RankingAdapterModel(
@@ -148,6 +159,12 @@ class RankingAdapterModel(Model):
     item_col = Param("item", "item id column", ptype=str)
 
     recommender_model: Any = None
+
+    def _save_state(self):
+        return {"recommender_model": self.recommender_model}
+
+    def _load_state(self, state):
+        self.recommender_model = state["recommender_model"]
 
     def _transform(self, table: Table) -> Table:
         """Test interactions -> per-user (prediction, label) id lists."""
@@ -181,6 +198,17 @@ class RankingTrainValidationSplit(Estimator):
     metric_name = Param("ndcgAt", "selection metric", ptype=str)
     param_maps = Param(None, "list of param dicts to evaluate (None = [{}])")
     seed = Param(0, "shuffle seed", ptype=int)
+
+    def _save_state(self):
+        return {"recommender": self.get("recommender")}
+
+    def _load_state(self, state):
+        self.set(recommender=state["recommender"])
+
+    def params_to_dict(self):
+        d = dict(self._values)
+        d.pop("recommender", None)
+        return d
 
     def split(self, table: Table) -> tuple[Table, Table]:
         """Per-user stratified split (:88+): each user's events split by
@@ -235,6 +263,16 @@ class RankingTrainValidationSplitModel(Model):
     best_model: Any = None
     validation_metrics: list = []
     best_params: dict = {}
+
+    def _save_state(self):
+        return {"best_model": self.best_model,
+                "validation_metrics": list(self.validation_metrics),
+                "best_params": dict(self.best_params)}
+
+    def _load_state(self, state):
+        self.best_model = state["best_model"]
+        self.validation_metrics = state["validation_metrics"]
+        self.best_params = state["best_params"]
 
     def _transform(self, table: Table) -> Table:
         return self.best_model.transform(table)

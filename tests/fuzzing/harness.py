@@ -1,0 +1,139 @@
+"""Metamorphic fuzzing harness over the stage registry.
+
+Reference: core/test/fuzzing/src/main/scala/Fuzzing.scala —
+`TestObject` (:19-31), `ExperimentFuzzing` (:78-106), `SerializationFuzzing`
+(:108-175) — and `FuzzingTest.scala:27-100`, which reflectively enumerates
+every Wrappable stage and fails when one lacks a fuzzer. Here the registry
+(`mmlspark_tpu.core.serialize.registry`) plays the role of JVM reflection:
+every `@register_stage` class must either supply TestObjects, be declared as
+the fitted-model class of a fuzzed estimator, or carry an explicit exemption.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import numpy as np
+
+from mmlspark_tpu.core.pipeline import Estimator, PipelineStage
+from mmlspark_tpu.core.schema import Table
+
+
+def qualname(cls: type) -> str:
+    return f"{cls.__module__}.{cls.__name__}"
+
+
+@dataclass
+class TestObject:
+    """A stage plus the tables needed to exercise it (Fuzzing.scala:19-31)."""
+
+    stage: Any
+    fit_table: Table | None = None          # estimators: table passed to fit
+    transform_table: Table | None = None    # table passed to transform (default: fit_table)
+    validation: Table | None = None         # optional expected transform output
+    model_class: str | None = None          # expected qualified name of the fitted model
+    skip_serialization: str | None = None   # reason serialization fuzz is impossible
+    skip_output_compare: str | None = None  # reason outputs are not comparable across runs
+    after_load: Callable[[Any], None] | None = None  # re-attach non-serializable hooks
+    rtol: float = 1e-5
+
+    def _transform_input(self) -> Table:
+        tbl = self.transform_table if self.transform_table is not None else self.fit_table
+        assert tbl is not None, "TestObject needs a transform_table or fit_table"
+        return tbl
+
+
+def experiment_fuzz(to: TestObject) -> tuple[Any, Table]:
+    """Fit/transform must run end to end (ExperimentFuzzing, Fuzzing.scala:78-106)."""
+    if isinstance(to.stage, Estimator):
+        assert to.fit_table is not None, f"{type(to.stage).__name__} needs fit_table"
+        model = to.stage.fit(to.fit_table)
+        if to.model_class is not None:
+            got = qualname(type(model))
+            assert got == to.model_class, (
+                f"{type(to.stage).__name__}.fit produced {got}, "
+                f"declared model_class is {to.model_class}"
+            )
+        out = model.transform(to._transform_input())
+    else:
+        model = to.stage
+        out = to.stage.transform(to._transform_input())
+    assert isinstance(out, Table)
+    if to.validation is not None:
+        assert out.equals(to.validation, rtol=to.rtol), (
+            f"output does not match validation table: {out!r} vs {to.validation!r}"
+        )
+    return model, out
+
+
+def _assert_tables_close(a: Table, b: Table, rtol: float, context: str) -> None:
+    assert set(a.columns) == set(b.columns), (
+        f"{context}: column mismatch {sorted(a.columns)} vs {sorted(b.columns)}"
+    )
+    assert len(a) == len(b), f"{context}: row count {len(a)} vs {len(b)}"
+    for k in a.columns:
+        va, vb = a[k], b[k]
+        if isinstance(va, np.ndarray) and isinstance(vb, np.ndarray) and np.issubdtype(
+            va.dtype, np.floating
+        ):
+            np.testing.assert_allclose(
+                np.asarray(va, np.float64), np.asarray(vb, np.float64),
+                rtol=rtol, atol=1e-6, equal_nan=True,
+                err_msg=f"{context}: column {k!r} differs",
+            )
+        else:
+            assert _loose_eq(va, vb), f"{context}: column {k!r} differs"
+
+
+def _loose_eq(a: Any, b: Any) -> bool:
+    a_l = a.tolist() if hasattr(a, "tolist") else list(a)
+    b_l = b.tolist() if hasattr(b, "tolist") else list(b)
+    return _cell_eq(a_l, b_l)
+
+
+def _cell_eq(a: Any, b: Any) -> bool:
+    if isinstance(a, (list, tuple)) and isinstance(b, (list, tuple)):
+        return len(a) == len(b) and all(_cell_eq(x, y) for x, y in zip(a, b))
+    if isinstance(a, float) and isinstance(b, float):
+        return bool(np.isclose(a, b, rtol=1e-5, atol=1e-6, equal_nan=True))
+    if hasattr(a, "__array__") or hasattr(b, "__array__"):
+        return bool(np.allclose(np.asarray(a, np.float64), np.asarray(b, np.float64),
+                                rtol=1e-5, atol=1e-6, equal_nan=True))
+    return a == b
+
+
+def serialization_fuzz(to: TestObject, tmp_path: str) -> None:
+    """Save/load roundtrips of the raw stage and (for estimators) the fitted
+    model; loaded stages must transform identically
+    (SerializationFuzzing, Fuzzing.scala:108-175)."""
+    raw_dir = os.path.join(tmp_path, "raw")
+    to.stage.save(raw_dir)
+    loaded = PipelineStage.load(raw_dir)
+    assert type(loaded) is type(to.stage)
+    if to.after_load is not None:
+        to.after_load(loaded)
+
+    if isinstance(to.stage, Estimator):
+        tbl = to._transform_input()
+        m1 = to.stage.fit(to.fit_table)
+        o1 = m1.transform(tbl)
+        m2 = loaded.fit(to.fit_table)
+        o2 = m2.transform(tbl)
+        if to.skip_output_compare is None:
+            _assert_tables_close(o1, o2, to.rtol, "refit-after-load")
+        model_dir = os.path.join(tmp_path, "model")
+        m1.save(model_dir)
+        m3 = PipelineStage.load(model_dir)
+        if to.after_load is not None:
+            to.after_load(m3)
+        o3 = m3.transform(tbl)
+        if to.skip_output_compare is None:
+            _assert_tables_close(o1, o3, to.rtol, "fitted-model-roundtrip")
+    else:
+        tbl = to._transform_input()
+        o1 = to.stage.transform(tbl)
+        o2 = loaded.transform(tbl)
+        if to.skip_output_compare is None:
+            _assert_tables_close(o1, o2, to.rtol, "transformer-roundtrip")

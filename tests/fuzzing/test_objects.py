@@ -1,0 +1,685 @@
+"""TestObject builders for every registered stage.
+
+One entry per registered stage class (keyed by qualified name). Model
+classes produced only by `fit` are declared in COVERED_BY_ESTIMATOR — the
+experiment fuzz asserts the estimator really produces that class, so the
+coverage claim is checked, not just declared (FuzzingTest.scala:27-100).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Callable
+
+import numpy as np
+
+from mmlspark_tpu.core.schema import Table
+from mmlspark_tpu.io_http.schema import HTTPRequestData, HTTPResponseData
+
+from .harness import TestObject
+
+# ---------------------------------------------------------------------------
+# shared fixture tables
+
+
+def _vec_table(n=120, f=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, f)).astype(np.float64)
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float64)
+    return Table({"features": x, "label": y})
+
+
+def _reg_table(n=120, f=4, seed=1):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, f)).astype(np.float64)
+    y = 2.0 * x[:, 0] - x[:, 1] + 0.05 * rng.normal(size=n)
+    return Table({"features": x, "label": y})
+
+
+def _image_table(n=4, hw=8, c=3, seed=0):
+    rng = np.random.default_rng(seed)
+    return Table({"image": rng.uniform(0, 255, size=(n, hw, hw, c)).astype(np.float32)})
+
+
+def _interactions(seed=3):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for u in range(6):
+        for i in rng.choice(8, size=4, replace=False):
+            rows.append((float(u), float(i), 1.0))
+    arr = np.asarray(rows, np.float64)
+    return Table({"user": arr[:, 0], "item": arr[:, 1], "rating": arr[:, 2]})
+
+
+def _docs():
+    return Table({"text": [
+        "the quick brown fox jumps", "pack my box with five dozen jugs",
+        "the lazy dog sleeps", "five quick foxes", "dogs and foxes play",
+        "the box is packed",
+    ]})
+
+
+def _scored_binary():
+    return Table({
+        "label": np.array([0.0, 0.0, 1.0, 1.0]),
+        "scored_labels": np.array([0.0, 1.0, 1.0, 1.0]),
+        "scores": np.array([0.1, 0.6, 0.7, 0.9]),
+    })
+
+
+def _json_response(payload) -> HTTPResponseData:
+    return HTTPResponseData(
+        200, "OK", {"Content-Type": "application/json"}, json.dumps(payload).encode()
+    )
+
+
+def _mlp_bundle(f=8, outputs=2):
+    from mmlspark_tpu.nn import ModelBundle
+
+    return ModelBundle.init("mlp", (f,), num_outputs=outputs)
+
+
+# ---------------------------------------------------------------------------
+# builders — ctx carries the live echo-server url (ctx["url"]) and a tmp dir
+
+
+def _core_objects(ctx) -> dict[str, list[TestObject]]:
+    from mmlspark_tpu.core.pipeline import Pipeline, Timer
+    from mmlspark_tpu.ops.indexer import ValueIndexer
+    from mmlspark_tpu.ops.stages import DropColumns
+
+    cat = Table({"c": ["a", "b", "a", "c"], "x": np.arange(4.0)})
+    return {
+        "mmlspark_tpu.core.pipeline.Pipeline": [TestObject(
+            Pipeline([ValueIndexer(input_col="c", output_col="i")]),
+            fit_table=cat,
+            model_class="mmlspark_tpu.core.pipeline.PipelineModel",
+        )],
+        "mmlspark_tpu.core.pipeline.Timer": [TestObject(
+            Timer(DropColumns(cols=["x"])),
+            transform_table=cat,
+        )],
+    }
+
+
+def _ops_objects(ctx) -> dict[str, list[TestObject]]:
+    from mmlspark_tpu.ops.adapter import MultiColumnAdapter
+    from mmlspark_tpu.ops.conversion import DataConversion
+    from mmlspark_tpu.ops.ensemble import EnsembleByKey
+    from mmlspark_tpu.ops.featurize import AssembleFeatures, Featurize
+    from mmlspark_tpu.ops.indexer import IndexToValue, ValueIndexer
+    from mmlspark_tpu.ops.minibatch import (
+        DynamicMiniBatchTransformer,
+        FixedMiniBatchTransformer,
+        FlattenBatch,
+        TimeIntervalMiniBatchTransformer,
+    )
+    from mmlspark_tpu.ops.missing import CleanMissingData
+    from mmlspark_tpu.ops.sample import PartitionSample
+    from mmlspark_tpu.ops.stages import (
+        Cacher,
+        CheckpointData,
+        ClassBalancer,
+        DropColumns,
+        Explode,
+        Lambda,
+        RenameColumn,
+        Repartition,
+        SelectColumns,
+        TextPreprocessor,
+        UDFTransformer,
+    )
+    from mmlspark_tpu.ops.summarize import SummarizeData
+
+    ab = Table({"a": np.arange(6.0), "b": np.arange(6.0) * 2, "c": list("xyzxyz")})
+    nanx = Table({"x": np.array([1.0, np.nan, 3.0]), "y": np.array([1.0, 2.0, 3.0])})
+    cat = Table({"c": ["a", "b", "a", "c"]})
+    indexed = Table({"i": np.array([0.0, 1.0, 0.0])},
+                    meta={"i": {"category_values": ["a", "b"]}})
+    batched = FixedMiniBatchTransformer(batch_size=2).transform(
+        Table({"v": np.arange(5.0)}))
+    ck_path = str(ctx["tmpdir"] / "ckpt_snapshot.npz")
+    return {
+        "mmlspark_tpu.ops.stages.DropColumns": [TestObject(
+            DropColumns(cols=["a"]), transform_table=ab,
+            validation=ab.drop("a"),
+        )],
+        "mmlspark_tpu.ops.stages.SelectColumns": [TestObject(
+            SelectColumns(cols=["b", "a"]), transform_table=ab,
+        )],
+        "mmlspark_tpu.ops.stages.RenameColumn": [TestObject(
+            RenameColumn(input_col="a", output_col="z"), transform_table=ab,
+        )],
+        "mmlspark_tpu.ops.stages.Repartition": [TestObject(
+            Repartition(n=2), transform_table=ab,
+        )],
+        "mmlspark_tpu.ops.stages.Explode": [TestObject(
+            Explode(input_col="vs"),
+            transform_table=Table({"vs": [[1, 2], [3]], "k": ["p", "q"]}),
+        )],
+        "mmlspark_tpu.ops.stages.Lambda": [TestObject(
+            Lambda(lambda tb: tb.with_column("y", np.asarray(tb["a"]) * 10)),
+            transform_table=ab,
+            skip_serialization="holds an arbitrary Python callable (reference "
+                               "Lambda serializes a Scala closure — not portable)",
+        )],
+        "mmlspark_tpu.ops.stages.UDFTransformer": [TestObject(
+            UDFTransformer(input_col="a", output_col="a2", udf=lambda v: v + 1),
+            transform_table=ab,
+            skip_serialization="holds an arbitrary Python callable",
+        )],
+        "mmlspark_tpu.ops.stages.Cacher": [TestObject(
+            Cacher(), transform_table=ab,
+        )],
+        "mmlspark_tpu.ops.stages.CheckpointData": [TestObject(
+            CheckpointData(to_disk=True, path=ck_path), transform_table=ab,
+        )],
+        "mmlspark_tpu.ops.stages.TextPreprocessor": [TestObject(
+            TextPreprocessor(input_col="c", output_col="c2", map={"x": "X"}),
+            transform_table=ab,
+        )],
+        "mmlspark_tpu.ops.stages.ClassBalancer": [TestObject(
+            ClassBalancer(input_col="c"),
+            fit_table=ab,
+            model_class="mmlspark_tpu.ops.stages.ClassBalancerModel",
+        )],
+        "mmlspark_tpu.ops.indexer.ValueIndexer": [TestObject(
+            ValueIndexer(input_col="c", output_col="i"),
+            fit_table=cat,
+            model_class="mmlspark_tpu.ops.indexer.ValueIndexerModel",
+        )],
+        "mmlspark_tpu.ops.indexer.IndexToValue": [TestObject(
+            IndexToValue(input_col="i", output_col="c2"), transform_table=indexed,
+        )],
+        "mmlspark_tpu.ops.missing.CleanMissingData": [TestObject(
+            CleanMissingData(input_cols=["x"], output_cols=["x"]),
+            fit_table=nanx,
+            model_class="mmlspark_tpu.ops.missing.CleanMissingDataModel",
+        )],
+        "mmlspark_tpu.ops.conversion.DataConversion": [TestObject(
+            DataConversion(cols=["a"], convert_to="integer"), transform_table=ab,
+        )],
+        "mmlspark_tpu.ops.summarize.SummarizeData": [TestObject(
+            SummarizeData(), transform_table=ab.drop("c"),
+        )],
+        "mmlspark_tpu.ops.sample.PartitionSample": [TestObject(
+            PartitionSample(mode="RandomSample", percent=0.5, seed=1),
+            transform_table=ab,
+        )],
+        "mmlspark_tpu.ops.ensemble.EnsembleByKey": [TestObject(
+            EnsembleByKey(keys=["c"], cols=["a"]), transform_table=ab,
+        )],
+        "mmlspark_tpu.ops.adapter.MultiColumnAdapter": [TestObject(
+            MultiColumnAdapter(
+                base_stage=ValueIndexer(),
+                input_cols=["c"], output_cols=["ci"],
+            ),
+            fit_table=ab,
+            model_class="mmlspark_tpu.ops.adapter.MultiColumnAdapterModel",
+        )],
+        "mmlspark_tpu.ops.featurize.AssembleFeatures": [TestObject(
+            AssembleFeatures(number_of_features=8),
+            fit_table=ab.drop("c"),
+            model_class="mmlspark_tpu.ops.featurize.AssembleFeaturesModel",
+        )],
+        "mmlspark_tpu.ops.featurize.Featurize": [TestObject(
+            Featurize(feature_columns={"f1": ["a", "b"]}),
+            fit_table=ab.drop("c"),
+            model_class="mmlspark_tpu.core.pipeline.PipelineModel",
+        )],
+        "mmlspark_tpu.ops.minibatch.FixedMiniBatchTransformer": [TestObject(
+            FixedMiniBatchTransformer(batch_size=2),
+            transform_table=Table({"v": np.arange(5.0)}),
+        )],
+        "mmlspark_tpu.ops.minibatch.DynamicMiniBatchTransformer": [TestObject(
+            DynamicMiniBatchTransformer(),
+            transform_table=Table({"v": np.arange(5.0)}),
+        )],
+        "mmlspark_tpu.ops.minibatch.TimeIntervalMiniBatchTransformer": [TestObject(
+            TimeIntervalMiniBatchTransformer(
+                interval_ms=60_000,
+                arrival_time_col="t",
+            ),
+            transform_table=Table({"v": np.arange(4.0),
+                                   "t": np.array([0.0, 1.0, 2.0, 3.0])}),
+        )],
+        "mmlspark_tpu.ops.minibatch.FlattenBatch": [TestObject(
+            FlattenBatch(), transform_table=batched,
+        )],
+    }
+
+
+def _gbdt_objects(ctx) -> dict[str, list[TestObject]]:
+    from mmlspark_tpu.gbdt import GBDTClassifier, GBDTRegressor
+
+    return {
+        "mmlspark_tpu.gbdt.estimators.GBDTClassifier": [TestObject(
+            GBDTClassifier(num_iterations=5, num_leaves=7),
+            fit_table=_vec_table(),
+            model_class="mmlspark_tpu.gbdt.estimators.GBDTClassificationModel",
+        )],
+        "mmlspark_tpu.gbdt.estimators.GBDTRegressor": [TestObject(
+            GBDTRegressor(num_iterations=5, num_leaves=7),
+            fit_table=_reg_table(),
+            model_class="mmlspark_tpu.gbdt.estimators.GBDTRegressionModel",
+        )],
+    }
+
+
+def _nn_objects(ctx) -> dict[str, list[TestObject]]:
+    from mmlspark_tpu.nn import DeepModelTransformer, DNNLearner, ImageFeaturizer, ModelBundle
+
+    f_table = Table({
+        "features": np.random.default_rng(0).normal(size=(12, 8)).astype(np.float32)
+    })
+    return {
+        "mmlspark_tpu.nn.runner.DeepModelTransformer": [TestObject(
+            DeepModelTransformer(input_col="features").set_model(_mlp_bundle(8, 3)),
+            transform_table=f_table,
+        )],
+        "mmlspark_tpu.nn.featurizer.ImageFeaturizer": [TestObject(
+            ImageFeaturizer(input_col="image").set_model(
+                ModelBundle.init("simple_cnn", (8, 8, 3), num_outputs=4)
+            ),
+            transform_table=_image_table(n=3),
+        )],
+        "mmlspark_tpu.nn.trainer.DNNLearner": [TestObject(
+            DNNLearner(
+                architecture="mlp", model_config={"features": (8,)},
+                epochs=2, batch_size=32, use_mesh=False, bfloat16=False, seed=5,
+            ),
+            fit_table=_vec_table(n=64, f=8),
+            model_class="mmlspark_tpu.nn.trainer.DNNModel",
+        )],
+    }
+
+
+def _image_objects(ctx) -> dict[str, list[TestObject]]:
+    from mmlspark_tpu.image import (
+        ImageSetAugmenter,
+        ImageTransformer,
+        ResizeImageTransformer,
+        UnrollBinaryImage,
+        UnrollImage,
+    )
+
+    imgs = _image_table(n=3, hw=8)
+    import io as _io
+
+    from PIL import Image
+
+    blobs = []
+    for i in range(2):
+        buf = _io.BytesIO()
+        Image.fromarray(
+            np.full((6, 6, 3), 40 * (i + 1), np.uint8)
+        ).save(buf, format="PNG")
+        blobs.append(buf.getvalue())
+    return {
+        "mmlspark_tpu.image.transformer.ImageTransformer": [TestObject(
+            ImageTransformer().resize(4, 4).gray(), transform_table=imgs,
+        )],
+        "mmlspark_tpu.image.transformer.ResizeImageTransformer": [TestObject(
+            ResizeImageTransformer(height=4, width=4), transform_table=imgs,
+        )],
+        "mmlspark_tpu.image.unroll.UnrollImage": [TestObject(
+            UnrollImage(), transform_table=imgs,
+        )],
+        "mmlspark_tpu.image.unroll.UnrollBinaryImage": [TestObject(
+            UnrollBinaryImage(), transform_table=Table({"bytes": blobs}),
+        )],
+        "mmlspark_tpu.image.augmenter.ImageSetAugmenter": [TestObject(
+            ImageSetAugmenter(), transform_table=imgs,
+        )],
+    }
+
+
+def _text_objects(ctx) -> dict[str, list[TestObject]]:
+    from mmlspark_tpu.text import (
+        IDF,
+        CountVectorizer,
+        HashingTF,
+        MultiNGram,
+        NGram,
+        PageSplitter,
+        StopWordsRemover,
+        TextFeaturizer,
+        Tokenizer,
+    )
+
+    docs = _docs()
+    toks = Tokenizer().transform(docs)
+    tf = HashingTF(num_features=16).transform(toks)
+    return {
+        "mmlspark_tpu.text.featurizer.Tokenizer": [TestObject(
+            Tokenizer(), transform_table=docs,
+        )],
+        "mmlspark_tpu.text.featurizer.StopWordsRemover": [TestObject(
+            StopWordsRemover(input_col="tokens"), transform_table=toks,
+        )],
+        "mmlspark_tpu.text.featurizer.NGram": [TestObject(
+            NGram(input_col="tokens", n=2), transform_table=toks,
+        )],
+        "mmlspark_tpu.text.featurizer.HashingTF": [TestObject(
+            HashingTF(num_features=16), transform_table=toks,
+        )],
+        "mmlspark_tpu.text.featurizer.CountVectorizer": [TestObject(
+            CountVectorizer(min_df=1),
+            fit_table=toks,
+            model_class="mmlspark_tpu.text.featurizer.CountVectorizerModel",
+        )],
+        "mmlspark_tpu.text.featurizer.IDF": [TestObject(
+            IDF(),
+            fit_table=tf,
+            model_class="mmlspark_tpu.text.featurizer.IDFModel",
+        )],
+        "mmlspark_tpu.text.featurizer.TextFeaturizer": [TestObject(
+            TextFeaturizer(num_features=32),
+            fit_table=docs,
+            model_class="mmlspark_tpu.core.pipeline.PipelineModel",
+        )],
+        "mmlspark_tpu.text.page_splitter.PageSplitter": [TestObject(
+            PageSplitter(input_col="text", max_page_length=12, min_page_length=4),
+            transform_table=docs,
+        )],
+        "mmlspark_tpu.text.multi_ngram.MultiNGram": [TestObject(
+            MultiNGram(input_col="tokens", lengths=[1, 2]), transform_table=toks,
+        )],
+    }
+
+
+def _automl_objects(ctx) -> dict[str, list[TestObject]]:
+    from mmlspark_tpu.automl import (
+        ComputeModelStatistics,
+        ComputePerInstanceStatistics,
+        DiscreteHyperParam,
+        FindBestModel,
+        GridSpace,
+        ImageLIME,
+        SuperpixelTransformer,
+        TrainClassifier,
+        TrainRegressor,
+        TuneHyperparameters,
+    )
+    from mmlspark_tpu.gbdt import GBDTClassifier
+    from mmlspark_tpu.nn import DeepModelTransformer, ModelBundle
+
+    vec = _vec_table()
+    good = GBDTClassifier(num_iterations=8, num_leaves=7).fit(vec)
+    bad = GBDTClassifier(num_iterations=1, num_leaves=2, learning_rate=0.001).fit(vec)
+    scorer = DeepModelTransformer(
+        input_col="image", fetch_dict={"probability": "probability"}
+    ).set_model(ModelBundle.init("simple_cnn", (8, 8, 3), num_outputs=3))
+    return {
+        "mmlspark_tpu.automl.train.TrainClassifier": [TestObject(
+            TrainClassifier(
+                model=GBDTClassifier(num_iterations=5, num_leaves=7),
+                label_col="label",
+            ),
+            fit_table=Table({"x": np.random.default_rng(0).normal(size=60),
+                             "label": ["y" if v > 0 else "n" for v in
+                                       np.random.default_rng(0).normal(size=60)]}),
+            model_class="mmlspark_tpu.automl.train.TrainedClassifierModel",
+        )],
+        "mmlspark_tpu.automl.train.TrainRegressor": [TestObject(
+            TrainRegressor(
+                model=__import__("mmlspark_tpu.gbdt", fromlist=["GBDTRegressor"]
+                                 ).GBDTRegressor(num_iterations=5, num_leaves=7),
+                label_col="label",
+            ),
+            fit_table=Table({"x": np.arange(40.0),
+                             "label": np.arange(40.0) * 2.0}),
+            model_class="mmlspark_tpu.automl.train.TrainedRegressorModel",
+        )],
+        "mmlspark_tpu.automl.tune.TuneHyperparameters": [TestObject(
+            TuneHyperparameters(
+                models=GBDTClassifier(),
+                param_space=GridSpace({"num_leaves": DiscreteHyperParam([3, 7]),
+                                       "num_iterations": DiscreteHyperParam([3])}),
+                num_folds=2, parallelism=1, evaluation_metric="accuracy",
+            ),
+            fit_table=vec,
+            model_class="mmlspark_tpu.automl.tune.TuneHyperparametersModel",
+        )],
+        "mmlspark_tpu.automl.find_best.FindBestModel": [TestObject(
+            FindBestModel(models=[bad, good], evaluation_metric="accuracy"),
+            fit_table=vec,
+            model_class="mmlspark_tpu.automl.find_best.BestModel",
+        )],
+        "mmlspark_tpu.automl.metrics.ComputeModelStatistics": [TestObject(
+            ComputeModelStatistics(scores_col="scores"),
+            transform_table=_scored_binary(),
+        )],
+        "mmlspark_tpu.automl.metrics.ComputePerInstanceStatistics": [TestObject(
+            ComputePerInstanceStatistics(scores_col="scores"),
+            transform_table=_scored_binary(),
+        )],
+        "mmlspark_tpu.automl.lime.SuperpixelTransformer": [TestObject(
+            SuperpixelTransformer(cell_size=4), transform_table=_image_table(n=2),
+        )],
+        "mmlspark_tpu.automl.lime.ImageLIME": [TestObject(
+            ImageLIME(model=scorer, cell_size=4, num_samples=16, seed=1),
+            transform_table=_image_table(n=1),
+        )],
+    }
+
+
+def _recommendation_objects(ctx) -> dict[str, list[TestObject]]:
+    from mmlspark_tpu.recommendation import (
+        SAR,
+        RankingAdapter,
+        RankingEvaluator,
+        RankingTrainValidationSplit,
+        RecommendationIndexer,
+    )
+
+    inter = _interactions()
+    named = Table({
+        "customer": ["bob", "amy", "bob", "ann"],
+        "product": ["x", "y", "z", "x"],
+        "rating": np.ones(4),
+    })
+    ranked = Table({
+        "prediction": [[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]],
+        "label": [[2.0, 9.0], [4.0]],
+    })
+    return {
+        "mmlspark_tpu.recommendation.indexer.RecommendationIndexer": [TestObject(
+            RecommendationIndexer(
+                user_input_col="customer", user_output_col="user",
+                item_input_col="product", item_output_col="item",
+            ),
+            fit_table=named,
+            model_class="mmlspark_tpu.recommendation.indexer.RecommendationIndexerModel",
+        )],
+        "mmlspark_tpu.recommendation.sar.SAR": [TestObject(
+            SAR(support_threshold=1),
+            fit_table=inter,
+            model_class="mmlspark_tpu.recommendation.sar.SARModel",
+        )],
+        "mmlspark_tpu.recommendation.ranking.RankingAdapter": [TestObject(
+            RankingAdapter(recommender=SAR(support_threshold=1), k=3),
+            fit_table=inter,
+            model_class="mmlspark_tpu.recommendation.ranking.RankingAdapterModel",
+        )],
+        "mmlspark_tpu.recommendation.ranking.RankingEvaluator": [TestObject(
+            RankingEvaluator(k=2), transform_table=ranked,
+        )],
+        "mmlspark_tpu.recommendation.ranking.RankingTrainValidationSplit": [TestObject(
+            RankingTrainValidationSplit(
+                recommender=SAR(support_threshold=1), k=3, min_ratings_per_user=2,
+            ),
+            fit_table=inter,
+            model_class=(
+                "mmlspark_tpu.recommendation.ranking.RankingTrainValidationSplitModel"
+            ),
+        )],
+    }
+
+
+def _with_udf(stage, fn):
+    stage.udf = fn
+    return stage
+
+
+def _io_http_objects(ctx) -> dict[str, list[TestObject]]:
+    from mmlspark_tpu.io_http import (
+        OCR,
+        AnalyzeImage,
+        CustomInputParser,
+        CustomOutputParser,
+        DetectFace,
+        EntityDetector,
+        HTTPTransformer,
+        JSONInputParser,
+        JSONOutputParser,
+        KeyPhraseExtractor,
+        LanguageDetector,
+        PartitionConsolidator,
+        SimpleHTTPTransformer,
+        StringOutputParser,
+        TextSentiment,
+    )
+
+    url = ctx["url"]
+    payloads = Table({"payload": [{"v": 1}, {"v": 2}]})
+    requests_tbl = JSONInputParser(input_col="payload", url=url).transform(payloads)
+    responses_tbl = Table({"response": [
+        _json_response({"echo": {"v": 1}}), _json_response({"echo": {"v": 2}}),
+    ]})
+    text_tbl = Table({"text_col": ["good day", "bad day"]})
+    img_tbl = Table({"img_url": ["http://x/a.png", "http://x/b.png"]})
+
+    def _ta_handler(req):
+        body = req.json()
+        doc = body["documents"][0]
+        return _json_response({"documents": [{"id": doc["id"], "score": 0.9}]})
+
+    def _vision_handler(req):
+        return _json_response({"language": "en", "regions": [], "categories": []})
+
+    def _set_ta_handler(stage):
+        stage.handler = _ta_handler
+
+    def _set_vision_handler(stage):
+        stage.handler = _vision_handler
+
+    def _make_ta(cls):
+        stage = cls(url=url + "/ta", output_col="out")
+        stage.set_col(text="text_col")
+        stage.handler = _ta_handler
+        return TestObject(stage, transform_table=text_tbl,
+                          after_load=_set_ta_handler)
+
+    def _make_vision(cls, **kw):
+        stage = cls(url=url + "/vision", output_col="out", **kw)
+        stage.set_col(image_url="img_url")
+        stage.handler = _vision_handler
+        return TestObject(stage, transform_table=img_tbl,
+                          after_load=_set_vision_handler)
+
+    consolidator = PartitionConsolidator(input_col="v", output_col="v2", num_lanes=2)
+    consolidator.fn = lambda v: v * 2
+
+    def _set_fn(stage):
+        stage.fn = lambda v: v * 2
+
+    return {
+        "mmlspark_tpu.io_http.transformer.HTTPTransformer": [TestObject(
+            HTTPTransformer(concurrency=2), transform_table=requests_tbl,
+            skip_output_compare="response objects carry per-call latency headers",
+        )],
+        "mmlspark_tpu.io_http.transformer.SimpleHTTPTransformer": [TestObject(
+            SimpleHTTPTransformer(url=url, flatten_output_field="echo.q",
+                                  output_col="answer", concurrency=2),
+            transform_table=Table({"input": [{"q": "hi"}, {"q": "yo"}]}),
+        )],
+        "mmlspark_tpu.io_http.transformer.JSONInputParser": [TestObject(
+            JSONInputParser(input_col="payload", url=url), transform_table=payloads,
+            skip_output_compare="output column holds HTTPRequestData objects",
+        )],
+        "mmlspark_tpu.io_http.transformer.JSONOutputParser": [TestObject(
+            JSONOutputParser(field_path="echo.v", output_col="v"),
+            transform_table=responses_tbl,
+        )],
+        "mmlspark_tpu.io_http.transformer.StringOutputParser": [TestObject(
+            StringOutputParser(output_col="s"), transform_table=responses_tbl,
+        )],
+        "mmlspark_tpu.io_http.transformer.CustomInputParser": [TestObject(
+            _with_udf(CustomInputParser(input_col="payload"),
+                      lambda v: HTTPRequestData.from_json(url, v)),
+            transform_table=payloads,
+            after_load=lambda s: _with_udf(s, lambda v: HTTPRequestData.from_json(url, v)),
+            skip_output_compare="output column holds HTTPRequestData objects",
+        )],
+        "mmlspark_tpu.io_http.transformer.CustomOutputParser": [TestObject(
+            _with_udf(CustomOutputParser(), lambda r: r.json()["echo"]),
+            transform_table=responses_tbl,
+            after_load=lambda s: _with_udf(s, lambda r: r.json()["echo"]),
+        )],
+        "mmlspark_tpu.io_http.consolidator.PartitionConsolidator": [TestObject(
+            consolidator, transform_table=Table({"v": np.arange(4.0)}),
+            after_load=_set_fn,
+        )],
+        "mmlspark_tpu.io_http.cognitive.TextSentiment": [_make_ta(TextSentiment)],
+        "mmlspark_tpu.io_http.cognitive.LanguageDetector": [_make_ta(LanguageDetector)],
+        "mmlspark_tpu.io_http.cognitive.EntityDetector": [_make_ta(EntityDetector)],
+        "mmlspark_tpu.io_http.cognitive.KeyPhraseExtractor": [_make_ta(KeyPhraseExtractor)],
+        "mmlspark_tpu.io_http.cognitive.OCR": [_make_vision(OCR)],
+        "mmlspark_tpu.io_http.cognitive.AnalyzeImage": [_make_vision(AnalyzeImage)],
+        "mmlspark_tpu.io_http.cognitive.DetectFace": [_make_vision(DetectFace)],
+    }
+
+
+BUILDER_GROUPS: list[Callable] = [
+    _core_objects,
+    _ops_objects,
+    _gbdt_objects,
+    _nn_objects,
+    _image_objects,
+    _text_objects,
+    _automl_objects,
+    _recommendation_objects,
+    _io_http_objects,
+]
+
+
+def build_all(ctx) -> dict[str, list[TestObject]]:
+    out: dict[str, list[TestObject]] = {}
+    for group in BUILDER_GROUPS:
+        for key, objs in group(ctx).items():
+            assert key not in out, f"duplicate TestObject key {key}"
+            out[key] = objs
+    return out
+
+
+# Model classes produced only by `fit`: the experiment fuzz of the estimator
+# asserts the fitted model really is this class (coverage is verified).
+COVERED_BY_ESTIMATOR: dict[str, str] = {
+    "mmlspark_tpu.core.pipeline.PipelineModel": "mmlspark_tpu.core.pipeline.Pipeline",
+    "mmlspark_tpu.ops.stages.ClassBalancerModel": "mmlspark_tpu.ops.stages.ClassBalancer",
+    "mmlspark_tpu.ops.indexer.ValueIndexerModel": "mmlspark_tpu.ops.indexer.ValueIndexer",
+    "mmlspark_tpu.ops.missing.CleanMissingDataModel": "mmlspark_tpu.ops.missing.CleanMissingData",
+    "mmlspark_tpu.ops.adapter.MultiColumnAdapterModel": "mmlspark_tpu.ops.adapter.MultiColumnAdapter",
+    "mmlspark_tpu.ops.featurize.AssembleFeaturesModel": "mmlspark_tpu.ops.featurize.AssembleFeatures",
+    "mmlspark_tpu.gbdt.estimators.GBDTClassificationModel": "mmlspark_tpu.gbdt.estimators.GBDTClassifier",
+    "mmlspark_tpu.gbdt.estimators.GBDTRegressionModel": "mmlspark_tpu.gbdt.estimators.GBDTRegressor",
+    "mmlspark_tpu.nn.trainer.DNNModel": "mmlspark_tpu.nn.trainer.DNNLearner",
+    "mmlspark_tpu.text.featurizer.CountVectorizerModel": "mmlspark_tpu.text.featurizer.CountVectorizer",
+    "mmlspark_tpu.text.featurizer.IDFModel": "mmlspark_tpu.text.featurizer.IDF",
+    "mmlspark_tpu.automl.train.TrainedClassifierModel": "mmlspark_tpu.automl.train.TrainClassifier",
+    "mmlspark_tpu.automl.train.TrainedRegressorModel": "mmlspark_tpu.automl.train.TrainRegressor",
+    "mmlspark_tpu.automl.tune.TuneHyperparametersModel": "mmlspark_tpu.automl.tune.TuneHyperparameters",
+    "mmlspark_tpu.automl.find_best.BestModel": "mmlspark_tpu.automl.find_best.FindBestModel",
+    "mmlspark_tpu.recommendation.indexer.RecommendationIndexerModel":
+        "mmlspark_tpu.recommendation.indexer.RecommendationIndexer",
+    "mmlspark_tpu.recommendation.sar.SARModel": "mmlspark_tpu.recommendation.sar.SAR",
+    "mmlspark_tpu.recommendation.ranking.RankingAdapterModel":
+        "mmlspark_tpu.recommendation.ranking.RankingAdapter",
+    "mmlspark_tpu.recommendation.ranking.RankingTrainValidationSplitModel":
+        "mmlspark_tpu.recommendation.ranking.RankingTrainValidationSplit",
+}
+
+# Stages that legitimately cannot be fuzzed, with the reason on record
+# (FuzzingTest.scala keeps the same explicit exemption list).
+EXEMPT: dict[str, str] = {}
