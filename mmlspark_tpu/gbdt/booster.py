@@ -574,23 +574,64 @@ class Booster:
         self._predict_cache[key] = run
         return run
 
-    def predict_raw(self, x: np.ndarray) -> np.ndarray:
-        """Raw margin scores: (n,) or (n, K) for multiclass."""
+    # Below this row count a single device dispatch (worst case: a tunneled
+    # remote TPU round-trip) costs far more than walking the trees on host —
+    # the latency-path analogue of LightGBM's per-row CPU predict
+    # (LightGBMBooster.scala:21-113). The host walk replays the jitted
+    # traversal with identical float32 accumulation order, so both paths are
+    # bit-identical.
+    HOST_PREDICT_MAX_ROWS = 512
+
+    def _predict_raw_host(self, bins: np.ndarray) -> np.ndarray:
+        n = bins.shape[0]
+        k = self.num_class
+        out = (np.zeros((n, k), np.float32) if k > 1
+               else np.full((n,), self.init_score, np.float32))
+        max_steps = int(self.feature.shape[1] // 2 + 1)
+        rows = np.arange(n)
+        for t in range(self.num_trees):
+            feature, thr = self.feature[t], self.threshold_bin[t]
+            cat, left, right = self.is_categorical[t], self.left[t], self.right[t]
+            node = np.zeros(n, np.int64)
+            for _ in range(max_steps):
+                f = np.maximum(feature[node], 0)
+                col = bins[rows, f]
+                go_left = np.where(cat[node], col == thr[node], col <= thr[node])
+                leaf = feature[node] < 0
+                node = np.where(leaf, node,
+                                np.where(go_left, left[node], right[node]))
+            val = self.value[t][node].astype(np.float32)
+            if k > 1:
+                out[:, int(self.tree_class[t])] += val
+            else:
+                out = out + val
+        return out
+
+    def predict_raw(self, x: np.ndarray, device: str | None = None) -> np.ndarray:
+        """Raw margin scores: (n,) or (n, K) for multiclass.
+
+        device: None = auto (host walk for small batches, jitted device
+        traversal otherwise), or explicitly "host" / "device"."""
         x = np.asarray(x, dtype=np.float64)
         if self.num_trees == 0:
             shape = (len(x), self.num_class) if self.num_class > 1 else (len(x),)
             return np.full(shape, self.init_score, np.float32)
-        bins = jnp.asarray(self.bin_mapper.transform(x), jnp.int32)
-        return np.asarray(self._traverse_fn()(bins))
+        if device is None:
+            device = "host" if len(x) <= self.HOST_PREDICT_MAX_ROWS else "device"
+        binned = self.bin_mapper.transform(x).astype(np.int32)
+        if device == "host":
+            return self._predict_raw_host(binned)
+        return np.asarray(self._traverse_fn()(jnp.asarray(binned)))
 
-    def predict(self, x: np.ndarray) -> np.ndarray:
+    def predict(self, x: np.ndarray, device: str | None = None) -> np.ndarray:
         """Probability / transformed prediction (reference
         LightGBMBooster.score semantics)."""
-        raw = self.predict_raw(x)
+        raw = np.asarray(self.predict_raw(x, device=device), np.float64)
         if self.objective == "binary":
-            return np.asarray(jax.nn.sigmoid(jnp.asarray(raw)))
+            return 1.0 / (1.0 + np.exp(-raw))
         if self.objective == "multiclass":
-            return np.asarray(jax.nn.softmax(jnp.asarray(raw), axis=-1))
+            e = np.exp(raw - raw.max(axis=-1, keepdims=True))
+            return e / e.sum(axis=-1, keepdims=True)
         if self.objective in ("poisson", "gamma", "tweedie"):
             return np.exp(raw)
         return raw

@@ -23,7 +23,7 @@ from .transformer import (
     CustomInputParser,
     CustomOutputParser,
 )
-from .serving import ServingServer, serve_model
+from .serving import ServingFleet, ServingServer, serve_model
 from .consolidator import PartitionConsolidator
 from .powerbi import PowerBIWriter
 from .cognitive import (
@@ -51,6 +51,7 @@ __all__ = [
     "StringOutputParser",
     "CustomInputParser",
     "CustomOutputParser",
+    "ServingFleet",
     "ServingServer",
     "serve_model",
     "PartitionConsolidator",

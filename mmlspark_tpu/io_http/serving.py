@@ -18,7 +18,10 @@ Multi-host serving = one ServingServer per host behind any TCP balancer
 
 from __future__ import annotations
 
+import collections
+import itertools
 import json
+import multiprocessing
 import queue
 import threading
 import time
@@ -31,7 +34,7 @@ import numpy as np
 from ..core.schema import Table
 from .schema import HTTPRequestData, HTTPResponseData, make_reply, parse_request
 
-__all__ = ["ServingServer", "serve_model"]
+__all__ = ["ServingServer", "ServingFleet", "serve_model"]
 
 
 @dataclass
@@ -39,6 +42,7 @@ class _Exchange:
     request: HTTPRequestData
     event: threading.Event = field(default_factory=threading.Event)
     response: HTTPResponseData | None = None
+    enqueued_at: float = 0.0
 
 
 class ServingServer:
@@ -51,21 +55,33 @@ class ServingServer:
 
     def __init__(
         self,
-        handler: Callable[[Table], Table],
+        handler: Callable[[Table], Table] | None = None,
         host: str = "127.0.0.1",
         port: int = 0,
         max_batch_size: int = 64,
         max_latency_ms: float = 5.0,
         reply_timeout_s: float = 30.0,
         api_path: str = "/",
+        mode: str = "continuous",
     ):
+        if mode not in ("continuous", "batch"):
+            raise ValueError(f"mode must be 'continuous' or 'batch', got {mode!r}")
+        if mode == "continuous" and handler is None:
+            raise ValueError("continuous mode needs a handler(Table) -> Table")
         self.handler = handler
         self.host, self.port = host, port
         self.max_batch_size = max_batch_size
         self.max_latency_ms = max_latency_ms
         self.reply_timeout_s = reply_timeout_s
         self.api_path = api_path
+        # "continuous": batcher thread drains the queue and replies directly
+        # (HTTPSourceV2.scala:336-474). "batch": the micro-batch engine is the
+        # CALLER — get_batch() drains pending requests as a Table, reply()
+        # completes them (HTTPSource.getBatch/HTTPSink, HTTPSource.scala:46-225).
+        self.mode = mode
         self._queue: queue.Queue[_Exchange] = queue.Queue()
+        self._pending: dict[str, _Exchange] = {}   # batch mode: id -> exchange
+        self._id_counter = itertools.count()
         self._server: ThreadingHTTPServer | None = None
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
@@ -75,6 +91,8 @@ class ServingServer:
         self.requests_seen = 0
         self.requests_answered = 0
         self._counter_lock = threading.Lock()
+        # rolling service latencies (seconds, enqueue -> reply written)
+        self._latencies: collections.deque[float] = collections.deque(maxlen=8192)
 
     # ------------------------------------------------------------------ #
 
@@ -90,9 +108,19 @@ class ServingServer:
                 ex = _Exchange(HTTPRequestData(
                     method="POST", url=self.path,
                     headers=dict(self.headers), entity=body,
-                ))
-                outer._queue.put(ex)
+                ), enqueued_at=time.perf_counter())
+                ex_id = None
+                if outer.mode == "batch":
+                    ex_id = str(next(outer._id_counter))
+                    with outer._counter_lock:
+                        outer._pending[ex_id] = ex
+                else:
+                    outer._queue.put(ex)
                 if not ex.event.wait(outer.reply_timeout_s):
+                    if ex_id is not None:
+                        # dead client: stop re-serving it via get_batch()
+                        with outer._counter_lock:
+                            outer._pending.pop(ex_id, None)
                     self.send_response(504)
                     self.end_headers()
                     return
@@ -105,13 +133,16 @@ class ServingServer:
                     self.wfile.write(resp.entity)
                 with outer._counter_lock:
                     outer.requests_answered += 1
+                    outer._latencies.append(time.perf_counter() - ex.enqueued_at)
 
             def do_GET(self):  # noqa: N802 — health/info endpoint
                 info = json.dumps({
                     "name": "mmlspark_tpu.serving",
                     "host": outer.host, "port": outer.port,
+                    "mode": outer.mode,
                     "seen": outer.requests_seen,
                     "answered": outer.requests_answered,
+                    "latency": outer.latency_stats(),
                 }).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
@@ -124,10 +155,12 @@ class ServingServer:
         self._server = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._server.server_address[1]
         st = threading.Thread(target=self._server.serve_forever, daemon=True)
-        bt = threading.Thread(target=self._batch_loop, daemon=True)
         st.start()
-        bt.start()
-        self._threads = [st, bt]
+        self._threads = [st]
+        if self.mode == "continuous":
+            bt = threading.Thread(target=self._batch_loop, daemon=True)
+            bt.start()
+            self._threads.append(bt)
         return self
 
     def stop(self) -> None:
@@ -139,6 +172,63 @@ class ServingServer:
     @property
     def url(self) -> str:
         return f"http://{self.host}:{self.port}{self.api_path}"
+
+    def latency_stats(self) -> dict[str, float]:
+        """p50/p99 service latency (ms) over the rolling window — the measured
+        version of the reference's ~1 ms continuous-mode claim
+        (docs/mmlspark-serving.md:10-11)."""
+        with self._counter_lock:
+            lat = list(self._latencies)
+        if not lat:
+            return {"n": 0, "p50_ms": float("nan"), "p99_ms": float("nan")}
+        arr = np.asarray(lat) * 1e3
+        return {
+            "n": len(arr),
+            "p50_ms": float(np.percentile(arr, 50)),
+            "p99_ms": float(np.percentile(arr, 99)),
+        }
+
+    def reset_latency_stats(self) -> None:
+        """Clear the rolling latency window (e.g. after warm-up requests)."""
+        with self._counter_lock:
+            self._latencies.clear()
+
+    # -- batch ("micro-batch source") mode ------------------------------- #
+
+    def get_batch(self, max_rows: int | None = None) -> Table:
+        """Drain pending requests into a Table with `id` + `request` columns
+        (reference `HTTPSource.getBatch`, HTTPSource.scala:46-225). The
+        caller scores the table and completes the requests with `reply`."""
+        if self.mode != "batch":
+            raise RuntimeError("get_batch() is only available in batch mode")
+        with self._counter_lock:
+            ids = list(self._pending)
+            if max_rows is not None:
+                ids = ids[:max_rows]
+            requests = [self._pending[i].request for i in ids]
+        return Table({"id": ids, "request": requests})
+
+    def reply(self, ids: list[str], responses: list[HTTPResponseData]) -> None:
+        """Complete batch-mode requests by id (reference `HTTPSink` keyed by
+        (name, partitionId, requestId), HTTPSourceV2.scala:421-476)."""
+        if self.mode != "batch":
+            raise RuntimeError("reply() is only available in batch mode")
+        if len(ids) != len(responses):
+            raise ValueError(
+                f"{len(responses)} responses for {len(ids)} request ids — "
+                "repliers must answer every drained request"
+            )
+        for ex_id, resp in zip(ids, responses):
+            with self._counter_lock:
+                ex = self._pending.pop(str(ex_id), None)
+            if ex is not None:
+                ex.response = resp
+                ex.event.set()
+
+    def reply_table(self, table: Table) -> None:
+        """reply() over a Table holding `id` + `reply` columns (the shape
+        `make_reply` produces when the `id` column is carried through)."""
+        self.reply(list(table["id"]), list(table["reply"]))
 
     # ------------------------------------------------------------------ #
 
@@ -206,3 +296,63 @@ def serve_model(
         return make_reply(scored, output_col)
 
     return ServingServer(handler, host=host, port=port, **server_kw).start()
+
+
+def _fleet_worker(handler_factory, conn, server_kw) -> None:
+    """Child-process entry: build the handler locally (models must not cross
+    the process boundary — the reference re-creates per-JVM servers the same
+    way, DistributedHTTPSource.scala:244-291) and serve until terminated."""
+    srv = ServingServer(handler_factory(), **server_kw).start()
+    conn.send((srv.host, srv.port))
+    srv._stop.wait()
+
+
+class ServingFleet:
+    """Distributed serving: one ServingServer PROCESS per "host".
+
+    Reference: DistributedHTTPSource's per-executor-JVM `JVMSharedServer`
+    (DistributedHTTPSource.scala:89-343) — here each host is a real OS
+    process with its own handler instance (clients spread requests across
+    `urls`, the role of the reference's load balancer).
+
+    `handler_factory` must be a picklable zero-arg callable returning the
+    `handler(Table) -> Table` for that host.
+    """
+
+    def __init__(self, handler_factory: Callable[[], Callable[[Table], Table]],
+                 n_hosts: int = 2, start_timeout_s: float = 60.0, **server_kw):
+        self.handler_factory = handler_factory
+        self.n_hosts = n_hosts
+        self.start_timeout_s = start_timeout_s
+        self.server_kw = server_kw
+        self._procs: list[multiprocessing.Process] = []
+        self.urls: list[str] = []
+
+    def start(self) -> "ServingFleet":
+        ctx = multiprocessing.get_context("spawn")
+        conns = []
+        for _ in range(self.n_hosts):
+            parent, child = ctx.Pipe()
+            p = ctx.Process(
+                target=_fleet_worker,
+                args=(self.handler_factory, child, self.server_kw),
+                daemon=True,
+            )
+            p.start()
+            self._procs.append(p)
+            conns.append(parent)
+        for parent in conns:
+            if not parent.poll(self.start_timeout_s):
+                self.stop()
+                raise TimeoutError("serving host failed to start")
+            host, port = parent.recv()
+            self.urls.append(f"http://{host}:{port}/")
+        return self
+
+    def stop(self) -> None:
+        for p in self._procs:
+            p.terminate()
+        for p in self._procs:
+            p.join(timeout=10)
+        self._procs = []
+        self.urls = []

@@ -54,6 +54,10 @@ class DeepModelTransformer(Model):
     )
     mini_batch_size = Param(64, "rows per compiled device batch", ptype=int)
     use_mesh = Param(False, "shard batches over the data mesh axis", ptype=bool)
+    bfloat16 = Param(
+        False, "run the forward in bfloat16 (MXU-native; outputs stay float32)",
+        ptype=bool,
+    )
 
     bundle: ModelBundle | None = None
     _apply_cache: dict | None = None
@@ -71,9 +75,12 @@ class DeepModelTransformer(Model):
         need_caps = any(f not in ("logits", "probability") for f in fetches)
         mean = np.asarray(bundle.preprocess.get("mean", 0.0), np.float32)
         std = np.asarray(bundle.preprocess.get("std", 1.0), np.float32)
+        use_bf16 = bool(self.get("bfloat16"))
 
         def forward(variables, x):
             x = (x.astype(jnp.float32) - mean) / std
+            if use_bf16:
+                x = x.astype(jnp.bfloat16)
             if need_caps:
                 logits, state = module.apply(
                     variables, x, train=False,
@@ -82,6 +89,7 @@ class DeepModelTransformer(Model):
             else:
                 logits = module.apply(variables, x, train=False)
                 state = None
+            logits = logits.astype(jnp.float32)
             outs = []
             for f in fetches:
                 if f == "logits":
@@ -89,7 +97,9 @@ class DeepModelTransformer(Model):
                 elif f == "probability":
                     outs.append(jax.nn.softmax(logits, axis=-1))
                 else:
-                    outs.append(_fetch_from_intermediates(state, f))
+                    outs.append(
+                        _fetch_from_intermediates(state, f).astype(jnp.float32)
+                    )
             return tuple(outs)
 
         if self.get("use_mesh"):
@@ -113,16 +123,26 @@ class DeepModelTransformer(Model):
 
         if self._apply_cache is None:
             self._apply_cache = {}
-        key = (fetches, self.get("mini_batch_size"), self.get("use_mesh"))
+        # id(bundle) in the key: assigning a new bundle directly (without
+        # set_model) must not score with stale cached/cast weights
+        key = (fetches, self.get("mini_batch_size"), self.get("use_mesh"),
+               self.get("bfloat16"), id(self.bundle))
         if key not in self._apply_cache:
-            self._apply_cache[key] = self._make_apply(fetches)
-        apply_fn = self._apply_cache[key]
+            variables = self.bundle.variables
+            if self.get("bfloat16"):
+                # cast weights ONCE; per-call casting would re-upload them
+                variables = jax.tree.map(
+                    lambda a: a.astype(jnp.bfloat16)
+                    if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a,
+                    variables,
+                )
+            self._apply_cache[key] = (self._make_apply(fetches), variables)
+        apply_fn, variables = self._apply_cache[key]
 
         bs = int(self.get("mini_batch_size"))
         if self.get("use_mesh"):
             d = get_mesh().shape[DATA_AXIS]
             bs = ((bs + d - 1) // d) * d
-        variables = self.bundle.variables
 
         # pad to a whole number of fixed-size batches: ONE compiled shape
         pad = (-n) % bs

@@ -92,6 +92,29 @@ class TestBinMapper:
 # --------------------------------------------------------------------- #
 
 class TestBooster:
+    def test_host_and_device_predict_identical(self):
+        """The host tree walk (latency path, no device dispatch) must be
+        bit-identical to the jitted device traversal — both binary and
+        multiclass, including rows that exercise categorical-style bins."""
+        x, y = make_classification()
+        b = Booster.train(
+            x, y, TrainOptions(objective="binary", num_iterations=12, num_leaves=15)
+        )
+        host = b.predict_raw(x, device="host")
+        dev = b.predict_raw(x, device="device")
+        np.testing.assert_array_equal(np.asarray(host), np.asarray(dev))
+
+        xm, ym = make_classification(classes=3)
+        bm = Booster.train(
+            xm, ym,
+            TrainOptions(objective="multiclass", num_class=3,
+                         num_iterations=8, num_leaves=7),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(bm.predict_raw(xm, device="host")),
+            np.asarray(bm.predict_raw(xm, device="device")),
+        )
+
     def test_binary_quality(self):
         x, y = make_classification()
         opts = TrainOptions(objective="binary", num_iterations=30, num_leaves=15)

@@ -1,0 +1,180 @@
+"""Serving-mode parity + latency proof.
+
+Reference: the three Spark Serving modes — driver batch (HTTPSource.scala:
+46-225), per-JVM distributed (DistributedHTTPSource.scala:89-343), and
+per-partition continuous at ~1 ms (HTTPSourceV2.scala:336-474,
+docs/mmlspark-serving.md:10-11). Here: batch-source mode (get_batch/reply),
+multi-process ServingFleet, and a measured p50/p99 latency gate on the
+continuous direct-reply path.
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.schema import Table
+from mmlspark_tpu.io_http import (
+    HTTPResponseData,
+    ServingFleet,
+    ServingServer,
+    make_reply,
+    parse_request,
+    serve_model,
+)
+
+
+def _post(url: str, payload: dict, timeout=10) -> dict:
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _get(url: str, timeout=10) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _echo_handler(table: Table) -> Table:
+    t = parse_request(table)
+    return make_reply(t.with_column("doubled", np.asarray(t["x"]) * 2), "doubled")
+
+
+# module-level so ServingFleet's spawn context can pickle it
+def _fleet_factory():
+    return _echo_handler
+
+
+class TestContinuousLatency:
+    def test_p50_single_digit_ms(self):
+        """The continuous-path latency gate: warm jitted-step serving must
+        answer at single-digit-ms p50 (reference claim ~1 ms,
+        docs/mmlspark-serving.md:10-11; our gate is p50 < 10 ms, p99 < 50 ms
+        on a shared CI CPU)."""
+        srv = ServingServer(_echo_handler, max_latency_ms=0.2).start()
+        try:
+            for _ in range(20):                      # warm-up
+                _post(srv.url, {"x": 1.0})
+            srv.reset_latency_stats()
+            for i in range(200):
+                out = _post(srv.url, {"x": float(i)})
+                assert out == {"doubled": 2.0 * i}
+            stats = srv.latency_stats()
+        finally:
+            srv.stop()
+        assert stats["n"] == 200
+        print(f"serving latency p50={stats['p50_ms']:.2f}ms "
+              f"p99={stats['p99_ms']:.2f}ms")
+        assert stats["p50_ms"] < 10.0, stats
+        assert stats["p99_ms"] < 50.0, stats
+
+    def test_latency_in_info_endpoint(self):
+        srv = ServingServer(_echo_handler).start()
+        try:
+            _post(srv.url, {"x": 3.0})
+            info = _get(srv.url)
+            assert info["answered"] == 1
+            assert info["latency"]["n"] == 1
+            assert info["latency"]["p50_ms"] > 0
+        finally:
+            srv.stop()
+
+
+class TestBatchMode:
+    def test_get_batch_reply_roundtrip(self):
+        """Caller-driven micro-batch: requests park until get_batch drains
+        them and reply() completes each exchange (HTTPSource semantics)."""
+        srv = ServingServer(mode="batch").start()
+        results = {}
+
+        def client(i):
+            results[i] = _post(srv.url, {"x": float(i)})
+
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(4)]
+        try:
+            for t in threads:
+                t.start()
+            # wait until all four requests are parked
+            import time
+
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                batch = srv.get_batch()
+                if len(batch) == 4:
+                    break
+                time.sleep(0.01)
+            assert len(batch) == 4
+            scored = parse_request(batch)
+            out = make_reply(
+                scored.with_column("y", np.asarray(scored["x"]) + 1), "y"
+            )
+            srv.reply_table(out.with_column("id", batch["id"]))
+            for t in threads:
+                t.join(timeout=5)
+        finally:
+            srv.stop()
+        assert len(results) == 4
+        for i, r in results.items():
+            assert r == {"y": i + 1.0}
+
+    def test_mode_guards(self):
+        cont = ServingServer(_echo_handler)
+        with pytest.raises(RuntimeError):
+            cont.get_batch()
+        with pytest.raises(ValueError):
+            ServingServer(mode="continuous")  # no handler
+        with pytest.raises(ValueError):
+            ServingServer(_echo_handler, mode="nope")
+
+
+class TestServingFleet:
+    def test_two_host_fleet(self):
+        """Two real server processes (per-'host' JVMSharedServer analogue):
+        requests round-robined across hosts all answer, and each host's info
+        endpoint reports its own counters."""
+        fleet = ServingFleet(_fleet_factory, n_hosts=2).start()
+        try:
+            assert len(fleet.urls) == 2
+            assert fleet.urls[0] != fleet.urls[1]
+            for i in range(10):
+                out = _post(fleet.urls[i % 2], {"x": float(i)})
+                assert out == {"doubled": 2.0 * i}
+            infos = [_get(u) for u in fleet.urls]
+        finally:
+            fleet.stop()
+        assert [i["answered"] for i in infos] == [5, 5]
+
+
+class TestServeModelLatency:
+    def test_model_serving_latency(self):
+        """End-to-end: a fitted GBDT behind serve_model answers warm requests
+        within the latency gate (persistent jitted scoring step)."""
+        from mmlspark_tpu.gbdt import GBDTClassifier
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(200, 4))
+        y = (x[:, 0] > 0).astype(np.float64)
+        model = GBDTClassifier(num_iterations=5, num_leaves=7).fit(
+            Table({"features": x, "label": y})
+        )
+        srv = serve_model(model, input_cols=["f0", "f1", "f2", "f3"],
+                          max_latency_ms=0.2)
+        try:
+            row = {f"f{j}": float(x[0, j]) for j in range(4)}
+            for _ in range(10):                      # warm-up + compile
+                _post(srv.url, row)
+            srv.reset_latency_stats()
+            for _ in range(50):
+                out = _post(srv.url, row)
+            assert out["prediction"] in (0.0, 1.0)
+            stats = srv.latency_stats()
+        finally:
+            srv.stop()
+        print(f"model serving p50={stats['p50_ms']:.2f}ms "
+              f"p99={stats['p99_ms']:.2f}ms")
+        assert stats["p50_ms"] < 25.0, stats
