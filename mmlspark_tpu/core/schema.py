@@ -57,6 +57,14 @@ def _as_column(values: Any) -> Any:
         return values
     if isinstance(values, np.ndarray):
         return values
+    if all(hasattr(values, a) for a in ("data", "indices", "indptr", "shape")):
+        # CSR matrix (scipy or gbdt.sparse.CSRMatrix): keep sparse — the
+        # GBDT binned-dense path consumes it without densifying. The hasattr
+        # probe mirrors gbdt.sparse.is_sparse, inlined to keep this hot
+        # constructor import-free for dense tables.
+        from ..gbdt.sparse import as_features
+
+        return as_features(values)
     if isinstance(values, (list, tuple)):
         vals = list(values)
         if vals and all(isinstance(v, (int, float, bool, np.number)) for v in vals):
@@ -215,7 +223,7 @@ class Table:
             idx = idx.astype(np.intp)
         cols: dict[str, Any] = {}
         for k, v in self._cols.items():
-            if isinstance(v, np.ndarray):
+            if isinstance(v, np.ndarray) or hasattr(v, "indptr"):
                 cols[k] = v[idx]
             else:
                 cols[k] = [v[i] for i in idx.tolist()]

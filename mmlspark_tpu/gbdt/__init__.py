@@ -10,6 +10,7 @@ reduce-scatter.
 """
 
 from .binning import BinMapper
+from .sparse import CSRMatrix
 from .booster import Booster
 from .estimators import (
     GBDTClassifier,
@@ -22,6 +23,7 @@ from .estimators import (
 
 __all__ = [
     "BinMapper",
+    "CSRMatrix",
     "Booster",
     "GBDTClassifier",
     "GBDTClassificationModel",

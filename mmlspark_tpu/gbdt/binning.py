@@ -44,16 +44,29 @@ class BinMapper:
     upper_bounds: np.ndarray = field(default_factory=lambda: np.zeros((0, 0)))
     category_maps: dict[int, dict[float, int]] = field(default_factory=dict)
 
-    def fit(self, x: np.ndarray) -> "BinMapper":
-        x = np.asarray(x, dtype=np.float64)
-        n, f = x.shape
+    def fit(self, x) -> "BinMapper":
+        """Accepts a dense (n, F) matrix or a CSR input (CSRMatrix / scipy).
+
+        The sparse path feeds one dense column at a time into the identical
+        per-feature sketch, so sparse and dense fits are bit-identical
+        (the reference's generateSparseDataset produces the same BinMapper
+        as its dense path inside lib_lightgbm, LightGBMUtils.scala:358-394)."""
+        from .sparse import as_features, is_sparse
+
+        if is_sparse(x):
+            x = as_features(x)
+            f = x.shape[1]
+            columns = x.iter_columns()
+        else:
+            x = np.asarray(x, dtype=np.float64)
+            f = x.shape[1]
+            columns = (x[:, j] for j in range(f))
         self.num_features = f
         cat = set(int(i) for i in self.categorical_indexes)
         # +1 for the reserved missing/other bin
         bounds = np.full((f, self.max_bin + 1), np.inf, dtype=np.float64)
         nbins = np.zeros(f, dtype=np.int32)
-        for j in range(f):
-            col = x[:, j]
+        for j, col in enumerate(columns):
             finite = col[np.isfinite(col)]
             if j in cat:
                 vals, counts = np.unique(finite, return_counts=True)
@@ -62,7 +75,9 @@ class BinMapper:
                 self.category_maps[j] = {float(v): i + 1 for i, v in enumerate(kept)}
                 nbins[j] = len(kept) + 1
                 continue
-            uniq = np.unique(finite)
+            # canonicalize -0.0 -> +0.0: CSR inputs drop signed zeros, and
+            # boundaries must serialize identically for sparse/dense parity
+            uniq = np.unique(finite + 0.0)
             if len(uniq) == 0:
                 nbins[j] = 1
                 continue
@@ -84,8 +99,23 @@ class BinMapper:
     def total_bins(self) -> int:
         return int(self.num_bins.max(initial=1))
 
-    def transform(self, x: np.ndarray) -> np.ndarray:
-        """Raw (n, F) float matrix -> (n, F) int32 bin matrix."""
+    def transform(self, x, memory_budget_mb: float | None = None) -> np.ndarray:
+        """Raw (n, F) float matrix (dense or CSR) -> (n, F) int32 bin matrix.
+
+        CSR inputs are densified in row chunks sized by `memory_budget_mb`
+        (the binned-dense strategy: only the int32 bin matrix is ever fully
+        materialized, never the raw float64 matrix)."""
+        from .sparse import DEFAULT_MEMORY_BUDGET_MB, as_features, is_sparse
+
+        if is_sparse(x):
+            csr = as_features(x)
+            budget = memory_budget_mb or DEFAULT_MEMORY_BUDGET_MB
+            step = csr.chunk_rows(budget)
+            out = np.zeros(csr.shape, dtype=np.int32)
+            for start in range(0, csr.shape[0], step):
+                stop = min(start + step, csr.shape[0])
+                out[start:stop] = self.transform(csr.to_dense(start, stop))
+            return out
         x = np.asarray(x, dtype=np.float64)
         n, f = x.shape
         if f != self.num_features:

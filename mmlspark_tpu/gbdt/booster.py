@@ -112,7 +112,9 @@ class Booster:
         feature_names: list[str] | None = None,
         log: Callable[[str], None] | None = None,
     ) -> "Booster":
-        x = np.asarray(x, dtype=np.float64)
+        from .sparse import as_features
+
+        x = as_features(x)  # CSR stays sparse until binning (binned-dense path)
         y = np.asarray(y, dtype=np.float64)
         n, f = x.shape
         k = opts.num_class if opts.objective == "multiclass" else 1
@@ -253,7 +255,7 @@ class Booster:
             log(f"early stopping is not supported for boosting_type={opts.boosting_type}; ignored")
         if es_active:
             xv, yv = valid
-            xv = np.asarray(xv, np.float64)
+            xv = as_features(xv)
             yv = np.asarray(yv, np.float64)
             xv_bins = jnp.asarray(mapper.transform(xv), jnp.int32)
             nv = len(yv)
@@ -612,7 +614,9 @@ class Booster:
 
         device: None = auto (host walk for small batches, jitted device
         traversal otherwise), or explicitly "host" / "device"."""
-        x = np.asarray(x, dtype=np.float64)
+        from .sparse import as_features
+
+        x = as_features(x)
         if self.num_trees == 0:
             shape = (len(x), self.num_class) if self.num_class > 1 else (len(x),)
             return np.full(shape, self.init_score, np.float32)

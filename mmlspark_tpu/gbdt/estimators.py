@@ -30,6 +30,13 @@ from ..core.schema import SCORE_KIND, Table
 from ..core.serialize import register_stage
 from ..parallel.mesh import get_mesh
 from .booster import Booster, TrainOptions
+from .sparse import as_features
+
+
+def _features_from(table: Table, col: str):
+    """Features column -> float64 ndarray, or CSRMatrix when the column holds
+    a sparse matrix (the SparseVector-dataset path, LightGBMUtils.scala:358-394)."""
+    return as_features(table[col])
 
 __all__ = [
     "GBDTClassifier",
@@ -64,6 +71,13 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
     categorical_slot_indexes = Param((), "indexes of categorical feature slots", ptype=(list, tuple))
     model_string = Param(None, "warm-start model text (reference modelString)", ptype=str)
     boost_from_average = Param(True, "init score from label average", ptype=bool)
+    # Determinism contract (reference LightGBMClassifier.scala:82-85): with
+    # use_mesh=True every device holds the IDENTICAL model by construction
+    # (replicated tree growth over psum-merged histograms). Relative to the
+    # single-device model, histograms are float32 sums whose psum reduction
+    # order differs, so split gains can differ at ~1e-6 relative; on rare
+    # near-tie splits this flips a branch. Documented tolerance: predictions
+    # agree to ~1e-3 relative; on well-separated data models are bit-identical.
     use_mesh = Param(False, "shard rows over the data mesh axis (psum histograms)", ptype=bool)
     verbosity = Param(1, "logging verbosity", ptype=int)
     seed = Param(0, "master rng seed", ptype=int)
@@ -98,8 +112,8 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
         )
 
     def _fit_arrays(self, table: Table):
-        x = np.asarray(table[self.get("features_col")], dtype=np.float64)
-        if x.ndim == 1:
+        x = _features_from(table, self.get("features_col"))
+        if getattr(x, "ndim", 2) == 1:
             x = x[:, None]
         y = np.asarray(table[self.get("label_col")], dtype=np.float64)
         w = None
@@ -201,8 +215,8 @@ class GBDTClassificationModel(_BoosterModelMixin, HasFeaturesCol, HasPredictionC
     classes: np.ndarray | None = None
 
     def _transform(self, table: Table) -> Table:
-        x = np.asarray(table[self.get("features_col")], dtype=np.float64)
-        if x.ndim == 1:
+        x = _features_from(table, self.get("features_col"))
+        if getattr(x, "ndim", 2) == 1:
             x = x[:, None]
         raw = self.booster.predict_raw(x)
         prob = self.booster.predict(x)
@@ -294,8 +308,8 @@ class GBDTRegressionModel(_BoosterModelMixin, HasFeaturesCol, HasPredictionCol, 
     booster: Booster | None = None
 
     def _transform(self, table: Table) -> Table:
-        x = np.asarray(table[self.get("features_col")], dtype=np.float64)
-        if x.ndim == 1:
+        x = _features_from(table, self.get("features_col"))
+        if getattr(x, "ndim", 2) == 1:
             x = x[:, None]
         pred = self.booster.predict(x)
         return table.with_column(
