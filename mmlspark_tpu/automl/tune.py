@@ -179,6 +179,11 @@ class TuneHyperparameters(HasLabelCol, Estimator):
     param_space = Param(None, "GridSpace | RandomSpace | dict of dists", required=True)
     num_runs = Param(10, "random-search runs (dict param_space only)", ptype=int)
     refit = Param(True, "refit best params on the full table", ptype=bool)
+    # BASELINE config #5: the grid placed over ICI partitions — the default
+    # mesh is split into N disjoint data submeshes and each trial fits on
+    # one (reference thread-pool trials, TuneHyperparameters.scala:79-92,
+    # share the whole cluster instead). 0 = all trials on the default mesh.
+    trial_submeshes = Param(0, "disjoint data submeshes for parallel trials", ptype=int)
 
     def _space(self):
         sp = self.get("param_space")
@@ -227,8 +232,17 @@ class TuneHyperparameters(HasLabelCol, Estimator):
             evaluation_metric=metric,
         )
 
-        def run_trial(args):
-            mi, pm = args
+        submesh_pool: "queue.Queue | None" = None
+        if self.get("trial_submeshes"):
+            import queue as _queue
+
+            from ..parallel.mesh import get_mesh, split_mesh
+
+            submesh_pool = _queue.Queue()
+            for sub in split_mesh(get_mesh(), int(self.get("trial_submeshes"))):
+                submesh_pool.put(sub)
+
+        def run_folds(mi, pm):
             scores = []
             for train_idx, valid_idx in folds:
                 train, valid = table.gather(train_idx), table.gather(valid_idx)
@@ -242,6 +256,19 @@ class TuneHyperparameters(HasLabelCol, Estimator):
                     )
                 scores.append(float(np.asarray(row[metric])[0]))
             return float(np.mean(scores))
+
+        def run_trial(args):
+            mi, pm = args
+            if submesh_pool is None:
+                return run_folds(mi, pm)
+            from ..parallel.mesh import use_mesh
+
+            sub = submesh_pool.get()   # blocks until an ICI partition frees up
+            try:
+                with use_mesh(sub):
+                    return run_folds(mi, pm)
+            finally:
+                submesh_pool.put(sub)
 
         with ThreadPoolExecutor(max_workers=self.get("parallelism")) as pool:
             results = list(pool.map(run_trial, trials))

@@ -243,6 +243,10 @@ class Table:
             a, b = self._cols[k], other._cols[k]
             if isinstance(a, np.ndarray) and isinstance(b, np.ndarray):
                 cols[k] = np.concatenate([a, b], axis=0)
+            elif hasattr(a, "indptr") and hasattr(b, "indptr"):
+                from ..gbdt.sparse import CSRMatrix
+
+                cols[k] = CSRMatrix.vstack(a, b)  # stays sparse
             else:
                 cols[k] = list(a) + list(b)
         return Table(cols, self._meta)

@@ -41,6 +41,10 @@ class TrainOptions:
 
     objective: str = "regression"
     boosting_type: str = "gbdt"       # gbdt | rf | dart | goss
+    # data_parallel (default) | voting_parallel (reference tree_learner,
+    # LightGBMParams.scala:12-14); voting uses `top_k` local candidates
+    tree_learner: str = "data_parallel"
+    top_k: int = 20
     num_iterations: int = 100
     learning_rate: float = 0.1
     num_leaves: int = 31
@@ -163,6 +167,9 @@ class Booster:
             lambda_l2=opts.lambda_l2,
             min_gain_to_split=opts.min_gain_to_split,
             learning_rate=1.0 if opts.boosting_type == "rf" else opts.learning_rate,
+            voting_top_k=(
+                opts.top_k if str(opts.tree_learner).startswith("voting") else 0
+            ),
         )
         cat_mask = np.zeros(f, bool)
         for ci in opts.categorical_indexes:

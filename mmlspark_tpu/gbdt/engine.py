@@ -64,6 +64,11 @@ class GrowConfig(NamedTuple):
     lambda_l2: float = 0.0
     min_gain_to_split: float = 0.0
     learning_rate: float = 0.1
+    # voting-parallel (tree_learner=voting_parallel, LightGBMParams.scala:12-13):
+    # each shard proposes its top-k features by local root gain, shards vote,
+    # and only the globally top-2k voted features' histograms are merged —
+    # the top_k/all_gather mapping from SURVEY.md §2.2. 0 = full data-parallel.
+    voting_top_k: int = 0
 
 
 def pad_rows(n: int, shards: int) -> int:
@@ -144,7 +149,7 @@ def make_grow_fn(
     def grow(bins, grad, hess, sample_mask, feature_mask, axis_name=None):
         n = bins.shape[0]
 
-        def hist_for(mask):
+        def local_hist(mask):
             # channels: [grad, hess, row count] — count is unweighted so
             # min_data_in_leaf means ROWS (LightGBM semantics), not weight
             # mass, even under sample weights / GOSS amplification.
@@ -152,10 +157,7 @@ def make_grow_fn(
                 [grad * mask, hess * mask, (mask > 0).astype(jnp.float32)],
                 axis=-1,
             )
-            h = _histogram(bins, stats, num_bins)
-            if axis_name is not None:
-                h = jax.lax.psum(h, axis_name)
-            return h  # (F, B, 3)
+            return _histogram(bins, stats, num_bins)           # (F, B, 3)
 
         # -- static bin-validity masks ---------------------------------
         bin_idx = jnp.arange(num_bins)                         # (B,)
@@ -163,30 +165,88 @@ def make_grow_fn(
         valid_num = bin_idx[None, :] < (fbins[:, None] - 1)    # (F, B)
         # categorical: any real bin can be the one-vs-rest bin
         valid_cat = bin_idx[None, :] < fbins[:, None]
-        valid_bin = jnp.where(is_cat_f[:, None], valid_cat, valid_num)
-        valid_bin = valid_bin & (feature_mask[:, None] > 0)
+        valid_base = jnp.where(is_cat_f[:, None], valid_cat, valid_num)
 
-        def best_split_of(hist, node_g, node_h, node_c):
-            """hist: (F,B,3) for one node -> (gain, feature, bin)."""
-            cum = jnp.cumsum(hist, axis=1)                     # (F,B,3)
+        # -- voting-parallel feature pre-selection (per tree) -----------
+        # Each shard proposes top-k features by LOCAL root-split gain
+        # (lax.top_k); a psum of one-hot proposals is the vote tally (the
+        # all_gather+count collapse); only the winning 2k features'
+        # histograms are merged for this tree. Reference semantics:
+        # tree_learner=voting_parallel inside lib_lightgbm
+        # (LightGBMParams.scala:12-13).
+        def split_gain_tensor(hist, ng, nh, nc, vb):
+            """(F,B) split gains for one node's histogram — the single source
+            of the gain/constraint rule (shared by the splitter and the
+            voting ranking so they can never drift apart)."""
+            cum = jnp.cumsum(hist, axis=1)
             # numeric: left = bins <= b (cumulative); categorical: left = bin == b
             left = jnp.where(is_cat_f[:, None, None], hist, cum)
             gl, hl, cl = left[..., 0], left[..., 1], left[..., 2]
-            gr, hr, cr = node_g - gl, node_h - hl, node_c - cl
+            gr, hr, cr = ng - gl, nh - hl, nc - cl
             ok = (
-                valid_bin
+                vb
                 & (cl >= cfg.min_data_in_leaf)
                 & (cr >= cfg.min_data_in_leaf)
                 & (hl >= cfg.min_sum_hessian_in_leaf)
                 & (hr >= cfg.min_sum_hessian_in_leaf)
             )
-            parent_obj = _leaf_objective(node_g, node_h, cfg.lambda_l1, cfg.lambda_l2)
+            parent = _leaf_objective(ng, nh, cfg.lambda_l1, cfg.lambda_l2)
             gain = (
                 _leaf_objective(gl, hl, cfg.lambda_l1, cfg.lambda_l2)
                 + _leaf_objective(gr, hr, cfg.lambda_l1, cfg.lambda_l2)
-                - parent_obj
+                - parent
             )
-            gain = jnp.where(ok, gain, -jnp.inf)
+            return jnp.where(ok, gain, -jnp.inf)
+
+        sel_vec = None      # (F,) 0/1 — None = all features (data-parallel)
+        sel_ids = None      # (k2,) voted feature ids (psum only these)
+        tot_feat = 0        # any kept feature's bins sum to the node totals
+        root_h0 = None
+        if axis_name is not None and cfg.voting_top_k > 0:
+            h_local = local_hist(sample_mask)
+            tot_local = h_local[0].sum(axis=0)                 # (3,)
+            vb = valid_base & (feature_mask[:, None] > 0)
+            gains_f = split_gain_tensor(
+                h_local, tot_local[0], tot_local[1], tot_local[2], vb
+            ).max(axis=1)                                      # (F,)
+            k2 = min(2 * cfg.voting_top_k, num_features)
+            top_gains, top_ids = jax.lax.top_k(gains_f, k2)
+            # a -inf "candidate" is a filler slot, not a proposal — it must
+            # not vote, or junk low-index features outpoll informative ones
+            ballots = (top_gains > -jnp.inf).astype(jnp.float32)
+            votes = jnp.zeros((num_features,), jnp.float32).at[top_ids].add(ballots)
+            votes = jax.lax.psum(votes, axis_name)
+            # deterministic tie-break: more votes first, then lower feature id
+            sel_score = votes * (num_features + 1) - jnp.arange(num_features)
+            _, sel_ids = jax.lax.top_k(sel_score, k2)
+            sel_vec = jnp.zeros((num_features,), jnp.float32).at[sel_ids].set(1.0)
+            feature_mask = feature_mask * sel_vec
+            tot_feat = jnp.argmin(-sel_vec).astype(jnp.int32)  # first kept feature
+
+        def hist_for(mask):
+            h = local_hist(mask)
+            if sel_ids is not None:
+                # the communication saving that motivates voting mode: only
+                # the k2 voted features' histograms cross the ICI (k2*B*3
+                # floats instead of F*B*3), scattered back to full shape.
+                # fresh zeros (not zeros_like) keep the result axis-invariant
+                # under shard_map — h itself is device-varying.
+                h_sel = jax.lax.psum(h[sel_ids], axis_name)    # (k2, B, 3)
+                h = jnp.zeros(h.shape, h.dtype).at[sel_ids].set(h_sel)
+            elif axis_name is not None:
+                h = jax.lax.psum(h, axis_name)
+            return h  # (F, B, 3)
+
+        if sel_ids is not None:
+            root_h0 = jnp.zeros(h_local.shape, h_local.dtype).at[sel_ids].set(
+                jax.lax.psum(h_local[sel_ids], axis_name)
+            )
+
+        valid_bin = valid_base & (feature_mask[:, None] > 0)
+
+        def best_split_of(hist, node_g, node_h, node_c):
+            """hist: (F,B,3) for one node -> (gain, feature, bin)."""
+            gain = split_gain_tensor(hist, node_g, node_h, node_c, valid_bin)
             flat = jnp.argmax(gain)
             f, b = flat // num_bins, flat % num_bins
             return gain.reshape(-1)[flat], f.astype(jnp.int32), b.astype(jnp.int32)
@@ -208,7 +268,9 @@ def make_grow_fn(
             # the varying-manual-axis type so lax.cond branches agree
             node_of_row = jax.lax.pcast(node_of_row, (axis_name,), to="varying")
         hists = jnp.zeros((m, num_features, num_bins, 3), jnp.float32)
-        hists = hists.at[0].set(hist_for(sample_mask))
+        hists = hists.at[0].set(
+            root_h0 if root_h0 is not None else hist_for(sample_mask)
+        )
         depth = jnp.zeros((m,), jnp.int32)
         # cached per-leaf best splits (recomputed only for new children)
         best_gain = jnp.full((m,), -jnp.inf, jnp.float32)
@@ -216,8 +278,10 @@ def make_grow_fn(
         best_b = jnp.zeros((m,), jnp.int32)
 
         def node_totals(h):
-            # summing any single feature's bins over a node = node totals
-            t = h[:, 0, :, :].sum(axis=1)                      # (M, 3)
+            # summing any single KEPT feature's bins over a node = node
+            # totals (every row lands in exactly one bin per feature);
+            # tot_feat is 0 normally, the first voted feature under voting
+            t = h[:, tot_feat, :, :].sum(axis=1)               # (M, 3)
             return t[:, 0], t[:, 1], t[:, 2]                   # grad, hess, count
 
         g0, f0, b0 = best_split_of(hists[0], *(x[0] for x in node_totals(hists)))

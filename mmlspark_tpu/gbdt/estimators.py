@@ -79,6 +79,11 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
     # near-tie splits this flips a branch. Documented tolerance: predictions
     # agree to ~1e-3 relative; on well-separated data models are bit-identical.
     use_mesh = Param(False, "shard rows over the data mesh axis (psum histograms)", ptype=bool)
+    tree_learner = Param(
+        "data_parallel", "data_parallel | voting_parallel (LightGBMParams.scala:12-14)",
+        ptype=str,
+    )
+    top_k = Param(20, "voting-parallel local candidate count", ptype=int)
     verbosity = Param(1, "logging verbosity", ptype=int)
     seed = Param(0, "master rng seed", ptype=int)
 
@@ -105,6 +110,8 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
             feature_fraction=self.get("feature_fraction"),
             early_stopping_round=self.get("early_stopping_round"),
             categorical_indexes=tuple(self.get("categorical_slot_indexes") or ()),
+            tree_learner=self.get("tree_learner"),
+            top_k=self.get("top_k"),
             num_class=num_class,
             boost_from_average=self.get("boost_from_average"),
             init_model=init_model,

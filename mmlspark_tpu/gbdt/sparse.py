@@ -104,6 +104,18 @@ class CSRMatrix:
         return CSRMatrix(self.data[take], self.indices[take], out_indptr,
                          (len(idx), self.shape[1]))
 
+    @staticmethod
+    def vstack(a: "CSRMatrix", b: "CSRMatrix") -> "CSRMatrix":
+        """Row-wise concatenation without densifying (Table.concat path)."""
+        if a.shape[1] != b.shape[1]:
+            raise ValueError(f"column mismatch: {a.shape[1]} vs {b.shape[1]}")
+        return CSRMatrix(
+            np.concatenate([a.data, b.data]),
+            np.concatenate([a.indices, b.indices]),
+            np.concatenate([a.indptr, a.indptr[-1] + b.indptr[1:]]),
+            (a.shape[0] + b.shape[0], a.shape[1]),
+        )
+
     # -- densification -----------------------------------------------------
     def to_dense(self, start: int = 0, stop: int | None = None) -> np.ndarray:
         """Densify rows [start, stop) — the bounded transient used by the

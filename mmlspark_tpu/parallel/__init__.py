@@ -11,6 +11,8 @@ from .mesh import (
     get_mesh,
     set_default_mesh,
     make_mesh,
+    split_mesh,
+    use_mesh,
     data_sharding,
     replicated_sharding,
     shard_rows,
@@ -34,6 +36,8 @@ __all__ = [
     "get_mesh",
     "set_default_mesh",
     "make_mesh",
+    "split_mesh",
+    "use_mesh",
     "data_sharding",
     "replicated_sharding",
     "shard_rows",

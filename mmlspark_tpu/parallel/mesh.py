@@ -34,7 +34,9 @@ __all__ = [
     "initialize_runtime",
     "get_mesh",
     "set_default_mesh",
+    "use_mesh",
     "make_mesh",
+    "split_mesh",
     "data_sharding",
     "replicated_sharding",
     "shard_rows",
@@ -97,8 +99,15 @@ def make_mesh(
     return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
 
 
+_tls = threading.local()
+
+
 def get_mesh() -> Mesh:
-    """The process-default mesh (created lazily over all devices)."""
+    """The current mesh: a thread-local override (see `use_mesh`) if one is
+    active, else the process default (created lazily over all devices)."""
+    override = getattr(_tls, "mesh", None)
+    if override is not None:
+        return override
     global _default_mesh
     with _lock:
         if _default_mesh is None:
@@ -110,6 +119,33 @@ def set_default_mesh(mesh: Mesh | None) -> None:
     global _default_mesh
     with _lock:
         _default_mesh = mesh
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh):
+    """Thread-local mesh override: stages that consult `get_mesh()` inside
+    the block run on `mesh`. This is how task-parallel trials bind disjoint
+    submeshes — one trial per ICI partition (BASELINE config #5; reference
+    thread-pool trials, TuneHyperparameters.scala:79-92)."""
+    prev = getattr(_tls, "mesh", None)
+    _tls.mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _tls.mesh = prev
+
+
+def split_mesh(mesh: Mesh, n: int) -> list[Mesh]:
+    """Partition a mesh's DATA axis into `n` disjoint submeshes (same
+    non-data axes). Each submesh is an independent ICI partition: trials
+    placed on different submeshes share no devices."""
+    axes = mesh.axis_names
+    grid = np.asarray(mesh.devices)
+    d = mesh.shape[DATA_AXIS]
+    if n <= 0 or d % n != 0:
+        raise ValueError(f"cannot split data axis of size {d} into {n} submeshes")
+    ax = list(axes).index(DATA_AXIS)  # split along the data axis wherever it sits
+    return [Mesh(piece, axes) for piece in np.split(grid, n, axis=ax)]
 
 
 def data_sharding(mesh: Mesh | None = None, *trailing_axes: str | None) -> NamedSharding:

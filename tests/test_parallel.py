@@ -180,6 +180,55 @@ class TestDistributedDeterminism:
         # same decisions even where a near-tie split flipped
         assert ((p_dist > 0.5) == (p_ref > 0.5)).mean() > 0.99
 
+    def test_voting_parallel_with_large_topk_equals_data_parallel(self):
+        """tree_learner=voting_parallel with 2k >= F must select every
+        feature, making it byte-identical to data_parallel (the vote is a
+        no-op) — validates the vote/merge plumbing end to end."""
+        from mmlspark_tpu.core.schema import Table
+        from mmlspark_tpu.gbdt import GBDTClassifier
+        from mmlspark_tpu.parallel.mesh import set_default_mesh
+
+        x, y = self._gbdt_data()
+        tbl = Table({"features": x, "label": y})
+        set_default_mesh(make_mesh(n_data=8))
+        try:
+            data_par = GBDTClassifier(num_iterations=8, num_leaves=15,
+                                      use_mesh=True).fit(tbl)
+            voting = GBDTClassifier(num_iterations=8, num_leaves=15,
+                                    use_mesh=True,
+                                    tree_learner="voting_parallel",
+                                    top_k=x.shape[1]).fit(tbl)
+        finally:
+            set_default_mesh(None)
+        assert voting.booster.to_text() == data_par.booster.to_text()
+
+    def test_voting_parallel_restricts_and_still_learns(self):
+        """With small top_k, each tree splits only on the globally voted 2k
+        features, and accuracy stays competitive (voting approximates full
+        merge, LightGBM's voting_parallel contract)."""
+        from mmlspark_tpu.core.schema import Table
+        from mmlspark_tpu.gbdt import GBDTClassifier
+        from mmlspark_tpu.parallel.mesh import set_default_mesh
+
+        rng = np.random.default_rng(4)
+        x = rng.normal(size=(512, 24))
+        y = (x[:, 3] - 0.8 * x[:, 11] > 0).astype(np.float64)
+        tbl = Table({"features": x, "label": y})
+        set_default_mesh(make_mesh(n_data=8))
+        try:
+            model = GBDTClassifier(num_iterations=10, num_leaves=15,
+                                   use_mesh=True,
+                                   tree_learner="voting_parallel",
+                                   top_k=2).fit(tbl)
+        finally:
+            set_default_mesh(None)
+        imp = np.asarray(model.get_feature_importances("split"))
+        # the two informative features dominate the voted set
+        assert imp[3] > 0 and imp[11] > 0
+        out = model.transform(tbl)
+        acc = (np.asarray(out["prediction"], np.float64) == y).mean()
+        assert acc > 0.9, acc
+
     @pytest.mark.parametrize("n_devices", [2, 8])
     def test_dnn_step_matches_single_device(self, n_devices):
         """Data-parallel DNN training must match the single-device run on the

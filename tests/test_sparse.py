@@ -146,3 +146,15 @@ class TestSparseTraining:
         m2 = GBDTRegressor(num_iterations=10, num_leaves=15).fit(
             Table({"features": x, "label": yr}))
         assert m1.booster.to_text() == m2.booster.to_text()
+
+
+class TestSparseTableOps:
+    def test_concat_stays_sparse(self):
+        x1, _ = sparse_data(n=30, seed=11)
+        x2, _ = sparse_data(n=20, seed=12)
+        t1 = Table({"features": sp.csr_matrix(x1), "k": np.arange(30.0)})
+        t2 = Table({"features": sp.csr_matrix(x2), "k": np.arange(20.0)})
+        cat = t1.concat(t2)
+        col = cat["features"]
+        assert isinstance(col, CSRMatrix)
+        np.testing.assert_array_equal(col.to_dense(), np.vstack([x1, x2]))
