@@ -247,6 +247,11 @@ class Table:
                 from ..gbdt.sparse import CSRMatrix
 
                 cols[k] = CSRMatrix.vstack(a, b)  # stays sparse
+            elif hasattr(a, "indptr") or hasattr(b, "indptr"):
+                raise ValueError(
+                    f"column {k!r} is sparse on one side and dense on the "
+                    "other; convert one side before concat"
+                )
             else:
                 cols[k] = list(a) + list(b)
         return Table(cols, self._meta)

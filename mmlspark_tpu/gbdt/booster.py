@@ -118,6 +118,16 @@ class Booster:
     ) -> "Booster":
         from .sparse import as_features
 
+        tl = str(opts.tree_learner)
+        if tl not in ("serial", "data", "data_parallel", "voting", "voting_parallel"):
+            raise ValueError(
+                f"tree_learner={tl!r} is not supported; use data_parallel or "
+                "voting_parallel (LightGBMParams.scala:12-14)"
+            )
+        if tl.startswith("voting") and mesh is None and log is not None:
+            log("tree_learner=voting_parallel has no effect without a mesh "
+                "(use_mesh=True); training data_parallel")
+
         x = as_features(x)  # CSR stays sparse until binning (binned-dense path)
         y = np.asarray(y, dtype=np.float64)
         n, f = x.shape

@@ -32,10 +32,21 @@ from .cognitive import (
     LanguageDetector,
     EntityDetector,
     KeyPhraseExtractor,
+    NER,
     OCR,
+    RecognizeText,
+    GenerateThumbnails,
+    TagImage,
+    DescribeImage,
     AnalyzeImage,
     DetectFace,
+    FindSimilarFace,
+    GroupFaces,
+    IdentifyFaces,
+    VerifyFaces,
+    BingImageSearch,
 )
+from .search import AzureSearchWriter
 
 __all__ = [
     "HTTPRequestData",
@@ -61,7 +72,18 @@ __all__ = [
     "LanguageDetector",
     "EntityDetector",
     "KeyPhraseExtractor",
+    "NER",
     "OCR",
+    "RecognizeText",
+    "GenerateThumbnails",
+    "TagImage",
+    "DescribeImage",
     "AnalyzeImage",
     "DetectFace",
+    "FindSimilarFace",
+    "GroupFaces",
+    "IdentifyFaces",
+    "VerifyFaces",
+    "BingImageSearch",
+    "AzureSearchWriter",
 ]

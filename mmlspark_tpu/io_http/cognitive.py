@@ -31,9 +31,19 @@ __all__ = [
     "LanguageDetector",
     "EntityDetector",
     "KeyPhraseExtractor",
+    "NER",
     "OCR",
+    "RecognizeText",
+    "GenerateThumbnails",
+    "TagImage",
+    "DescribeImage",
     "AnalyzeImage",
     "DetectFace",
+    "FindSimilarFace",
+    "GroupFaces",
+    "IdentifyFaces",
+    "VerifyFaces",
+    "BingImageSearch",
 ]
 
 
@@ -69,22 +79,34 @@ class CognitiveServiceBase(HasOutputCol, Transformer):
                     vals[name] = v
         return vals
 
+    def _row_request(self, row_vals: dict[str, Any], i: int) -> HTTPRequestData:
+        """Default: POST the JSON body; GET-style stages override."""
+        return HTTPRequestData.from_json(
+            self.get("url"), self._row_body(row_vals, i), headers=self._headers()
+        )
+
+    def _send_one(self, req: HTTPRequestData) -> HTTPResponseData:
+        if self.handler is not None:
+            return self.handler(req)
+        from .clients import http_send
+
+        return http_send(req, timeout=self.get("timeout"))
+
+    def _exchange(self, reqs: list[HTTPRequestData]) -> list[HTTPResponseData]:
+        if self.handler is not None:
+            return [self.handler(r) for r in reqs]
+        client = HTTPClient(concurrency=self.get("concurrency"),
+                            timeout=self.get("timeout"))
+        return client.send_all(reqs)
+
     def _transform(self, table: Table) -> Table:
         n = table.num_rows
         sv = self._service_values(table)
         reqs = []
         for i in range(n):
             row_vals = {k: v[i] for k, v in sv.items()}
-            body = self._row_body(row_vals, i)
-            reqs.append(HTTPRequestData.from_json(
-                self.get("url"), body, headers=self._headers()
-            ))
-        if self.handler is not None:
-            resps = [self.handler(r) for r in reqs]
-        else:
-            client = HTTPClient(concurrency=self.get("concurrency"),
-                                timeout=self.get("timeout"))
-            resps = client.send_all(reqs)
+            reqs.append(self._row_request(row_vals, i))
+        resps = self._exchange(reqs)
         parsed, errors = [], []
         for r in resps:
             if isinstance(r, HTTPResponseData) and r.ok:
@@ -196,3 +218,213 @@ class DetectFace(_VisionBase):
         if self.get("return_face_attributes"):
             body["returnFaceAttributes"] = ",".join(self.get("return_face_attributes"))
         return body
+
+
+@register_stage
+class NER(_TextAnalyticsBase):
+    """Named-entity recognition (reference: NER, TextAnalytics.scala:31-120).
+    Output: the document payload with its `entities` list."""
+
+
+class _AsyncPollBase(_VisionBase):
+    """Async-poll pattern (reference RecognizeText's `FixedPollingHandler`,
+    ComputerVision.scala:192-278): the initial POST answers 202 with an
+    `Operation-Location` header; the result is GET-polled from there until
+    status leaves "Running"/"NotStarted"."""
+
+    # seconds-scale budget like the reference's polling handler — real async
+    # recognition takes several seconds (~5 min total here before giving up)
+    poll_interval_s = Param(1.0, "delay between result polls (s)", ptype=float)
+    max_polls = Param(300, "poll attempts before giving up", ptype=int)
+
+    def _poll_operation(self, resp: HTTPResponseData) -> HTTPResponseData:
+        import time as _time
+
+        if not (isinstance(resp, HTTPResponseData) and resp.status_code == 202):
+            return resp
+        loc = resp.headers.get("Operation-Location") or resp.headers.get(
+            "operation-location"
+        )
+        if not loc:
+            return HTTPResponseData(502, "202 without Operation-Location")
+        poll_req = HTTPRequestData(method="GET", url=loc, headers=self._headers())
+        for _ in range(int(self.get("max_polls"))):
+            r = self._send_one(poll_req)
+            if not (isinstance(r, HTTPResponseData) and r.ok):
+                return r
+            status = (r.json() or {}).get("status", "")
+            if status not in ("Running", "NotStarted", ""):
+                return r
+            _time.sleep(self.get("poll_interval_s"))
+        return HTTPResponseData(504, "poll limit reached")
+
+    def _exchange(self, reqs):
+        from ..utils.async_utils import buffered_map
+
+        initial = CognitiveServiceBase._exchange(self, reqs)
+        # rows poll concurrently through the same window width as the
+        # initial requests — sequential polling would sum every row's wait
+        return list(buffered_map(self._poll_operation, initial,
+                                 max(int(self.get("concurrency")), 1)))
+
+
+@register_stage
+class RecognizeText(_AsyncPollBase):
+    """Async text recognition (ComputerVision.scala:192-278). Output: the
+    final operation payload (`recognitionResult` with lines/words)."""
+
+    mode = Param("Printed", "Printed | Handwritten", ptype=str)
+
+    def _row_request(self, row_vals, i):
+        url = f"{self.get('url')}?mode={self.get('mode')}"
+        return HTTPRequestData.from_json(
+            url, self._row_body(row_vals, i), headers=self._headers()
+        )
+
+
+@register_stage
+class GenerateThumbnails(_VisionBase):
+    """Thumbnail generation (ComputerVision.scala:222-260). Output: the raw
+    thumbnail image bytes."""
+
+    width = Param(64, "thumbnail width (px)", ptype=int)
+    height = Param(64, "thumbnail height (px)", ptype=int)
+    smart_cropping = Param(True, "center on the region of interest", ptype=bool)
+
+    def _row_request(self, row_vals, i):
+        url = (f"{self.get('url')}?width={self.get('width')}"
+               f"&height={self.get('height')}"
+               f"&smartCropping={str(self.get('smart_cropping')).lower()}")
+        return HTTPRequestData.from_json(
+            url, self._row_body(row_vals, i), headers=self._headers()
+        )
+
+    def _parse(self, resp):
+        return resp.entity  # image bytes, not JSON
+
+
+@register_stage
+class TagImage(_VisionBase):
+    """Image tagging (ComputerVision.scala:380-420). Output: `tags` list."""
+
+    def _parse(self, resp):
+        return (resp.json() or {}).get("tags")
+
+
+@register_stage
+class DescribeImage(_VisionBase):
+    """Image description (ComputerVision.scala:422-460). Output: the
+    `description` payload (captions + tags)."""
+
+    max_candidates = Param(1, "caption candidates to return", ptype=int)
+
+    def _row_request(self, row_vals, i):
+        url = f"{self.get('url')}?maxCandidates={self.get('max_candidates')}"
+        return HTTPRequestData.from_json(
+            url, self._row_body(row_vals, i), headers=self._headers()
+        )
+
+    def _parse(self, resp):
+        return (resp.json() or {}).get("description")
+
+
+# ---------------------------------------------------------------------------
+# Face suite (reference: Face.scala:19-347)
+
+
+@register_stage
+class FindSimilarFace(CognitiveServiceBase):
+    """Find faces similar to a query face (Face.scala:120-180)."""
+
+    face_id = ServiceParam(None, "query face id (scalar or column)")
+    face_ids = ServiceParam(None, "candidate face id list (scalar or column)")
+    max_candidates = Param(20, "max matches returned", ptype=int)
+    mode = Param("matchPerson", "matchPerson | matchFace", ptype=str)
+
+    def _row_body(self, row_vals, i):
+        return {
+            "faceId": row_vals.get("face_id"),
+            "faceIds": list(row_vals.get("face_ids") or []),
+            "maxNumOfCandidatesReturned": self.get("max_candidates"),
+            "mode": self.get("mode"),
+        }
+
+
+@register_stage
+class GroupFaces(CognitiveServiceBase):
+    """Partition faces into similarity groups (Face.scala:182-220)."""
+
+    face_ids = ServiceParam(None, "face id list (scalar or column)")
+
+    def _row_body(self, row_vals, i):
+        return {"faceIds": list(row_vals.get("face_ids") or [])}
+
+
+@register_stage
+class IdentifyFaces(CognitiveServiceBase):
+    """Identify faces against a person group (Face.scala:222-280)."""
+
+    person_group_id = ServiceParam(None, "person group id (scalar or column)")
+    face_ids = ServiceParam(None, "face id list (scalar or column)")
+    max_candidates = Param(1, "candidates per face", ptype=int)
+    confidence_threshold = Param(None, "identification confidence floor", ptype=float)
+
+    def _row_body(self, row_vals, i):
+        body = {
+            "personGroupId": row_vals.get("person_group_id"),
+            "faceIds": list(row_vals.get("face_ids") or []),
+            "maxNumOfCandidatesReturned": self.get("max_candidates"),
+        }
+        if self.get("confidence_threshold") is not None:
+            body["confidenceThreshold"] = self.get("confidence_threshold")
+        return body
+
+
+@register_stage
+class VerifyFaces(CognitiveServiceBase):
+    """Verify two faces belong to one person (Face.scala:282-347)."""
+
+    face_id1 = ServiceParam(None, "first face id (scalar or column)")
+    face_id2 = ServiceParam(None, "second face id (scalar or column)")
+
+    def _row_body(self, row_vals, i):
+        return {"faceId1": row_vals.get("face_id1"),
+                "faceId2": row_vals.get("face_id2")}
+
+
+@register_stage
+class BingImageSearch(CognitiveServiceBase):
+    """Bing image search (reference: ImageSearch.scala:23-296). Output: the
+    `value` list of image results (contentUrl etc.)."""
+
+    query = ServiceParam(None, "search query (scalar or column)")
+    count = Param(10, "results per query", ptype=int)
+    offset = Param(0, "result offset (paging)", ptype=int)
+    market = Param(None, "market code, e.g. en-US", ptype=str)
+
+    def _row_request(self, row_vals, i):
+        from urllib.parse import urlencode
+
+        params = {"q": row_vals.get("query", ""), "count": self.get("count"),
+                  "offset": self.get("offset")}
+        if self.get("market"):
+            params["mkt"] = self.get("market")
+        return HTTPRequestData(
+            method="GET",
+            url=f"{self.get('url')}?{urlencode(params)}",
+            headers=self._headers(),
+        )
+
+    def _parse(self, resp):
+        return (resp.json() or {}).get("value")
+
+    @staticmethod
+    def download_from_urls(urls, concurrency: int = 4, timeout: float = 30.0):
+        """Fetch image bytes for result URLs (reference
+        BingImageSearch.downloadFromUrls, ImageSearch.scala:240-296); failed
+        fetches yield None."""
+        client = HTTPClient(concurrency=concurrency, timeout=timeout)
+        reqs = [HTTPRequestData(method="GET", url=u, headers={}) for u in urls]
+        resps = client.send_all(reqs)
+        return [r.entity if isinstance(r, HTTPResponseData) and r.ok else None
+                for r in resps]

@@ -522,15 +522,107 @@ def _with_udf(stage, fn):
     return stage
 
 
+def _face_handler(req):
+    return _json_response({"isIdentical": True, "groups": [["a"]],
+                           "candidates": [], "confidence": 0.9})
+
+
+def _recognize_handler(req):
+    if req.method == "GET":
+        return _json_response({"status": "Succeeded",
+                               "recognitionResult": {"lines": []}})
+    return HTTPResponseData(202, "Accepted",
+                            {"Operation-Location": "http://fake/op/1"}, b"")
+
+
+def _face_to(cls, values):
+    from .harness import TestObject as _TO
+
+    stage = cls(url="http://fake/face", output_col="out")
+    stage.set(**values)
+    stage.handler = _face_handler
+
+    def _attach(s):
+        s.handler = _face_handler
+
+    return _TO(stage, transform_table=Table({"dummy": [1.0]}), after_load=_attach)
+
+
+def _recognize_text_to(ctx):
+    from mmlspark_tpu.io_http import RecognizeText
+
+    stage = RecognizeText(url="http://fake/recognizeText", output_col="out",
+                          poll_interval_s=0.0)
+    stage.set(image_url="http://x/a.png")
+    stage.handler = _recognize_handler
+
+    def _attach(s):
+        s.handler = _recognize_handler
+
+    return TestObject(stage, transform_table=Table({"dummy": [1.0]}),
+                      after_load=_attach)
+
+
+def _bing_handler(req):
+    return _json_response({"value": [{"contentUrl": "http://x/a.png"}]})
+
+
+def _bing_to():
+    from mmlspark_tpu.io_http import BingImageSearch
+
+    stage = BingImageSearch(url="http://fake/bing", output_col="out")
+    stage.set(query="cats")
+    stage.handler = _bing_handler
+
+    def _attach(s):
+        s.handler = _bing_handler
+
+    return TestObject(stage, transform_table=Table({"dummy": [1.0]}),
+                      after_load=_attach)
+
+
+def _azure_search_handler(req):
+    if req.method == "GET":
+        return _json_response({"name": "idx"})
+    if req.url.split("?")[0].endswith("docs/index"):
+        n = len(req.json()["value"])
+        return _json_response({"value": [{"key": str(i), "status": True}
+                                         for i in range(n)]})
+    return _json_response({"name": "idx"})
+
+
+def _azure_search_to():
+    from mmlspark_tpu.io_http import AzureSearchWriter
+
+    stage = AzureSearchWriter(
+        service_url="http://fake/search",
+        index_definition={"name": "idx", "fields": [
+            {"name": "id", "type": "Edm.String", "key": True}]},
+    )
+    stage.handler = _azure_search_handler
+
+    def _attach(s):
+        s.handler = _azure_search_handler
+
+    return TestObject(stage, transform_table=Table({"id": ["1", "2"]}),
+                      after_load=_attach)
+
+
 def _io_http_objects(ctx) -> dict[str, list[TestObject]]:
     from mmlspark_tpu.io_http import (
+        NER,
         OCR,
         AnalyzeImage,
         CustomInputParser,
         CustomOutputParser,
+        DescribeImage,
         DetectFace,
         EntityDetector,
+        FindSimilarFace,
+        GenerateThumbnails,
+        GroupFaces,
         HTTPTransformer,
+        IdentifyFaces,
         JSONInputParser,
         JSONOutputParser,
         KeyPhraseExtractor,
@@ -538,7 +630,9 @@ def _io_http_objects(ctx) -> dict[str, list[TestObject]]:
         PartitionConsolidator,
         SimpleHTTPTransformer,
         StringOutputParser,
+        TagImage,
         TextSentiment,
+        VerifyFaces,
     )
 
     url = ctx["url"]
@@ -625,9 +719,24 @@ def _io_http_objects(ctx) -> dict[str, list[TestObject]]:
         "mmlspark_tpu.io_http.cognitive.LanguageDetector": [_make_ta(LanguageDetector)],
         "mmlspark_tpu.io_http.cognitive.EntityDetector": [_make_ta(EntityDetector)],
         "mmlspark_tpu.io_http.cognitive.KeyPhraseExtractor": [_make_ta(KeyPhraseExtractor)],
+        "mmlspark_tpu.io_http.cognitive.NER": [_make_ta(NER)],
         "mmlspark_tpu.io_http.cognitive.OCR": [_make_vision(OCR)],
         "mmlspark_tpu.io_http.cognitive.AnalyzeImage": [_make_vision(AnalyzeImage)],
         "mmlspark_tpu.io_http.cognitive.DetectFace": [_make_vision(DetectFace)],
+        "mmlspark_tpu.io_http.cognitive.TagImage": [_make_vision(TagImage)],
+        "mmlspark_tpu.io_http.cognitive.DescribeImage": [_make_vision(DescribeImage)],
+        "mmlspark_tpu.io_http.cognitive.GenerateThumbnails": [_make_vision(GenerateThumbnails)],
+        "mmlspark_tpu.io_http.cognitive.RecognizeText": [_recognize_text_to(ctx)],
+        "mmlspark_tpu.io_http.cognitive.FindSimilarFace": [_face_to(
+            FindSimilarFace, {"face_id": "q", "face_ids": ["a", "b"]})],
+        "mmlspark_tpu.io_http.cognitive.GroupFaces": [_face_to(
+            GroupFaces, {"face_ids": ["a", "b", "c"]})],
+        "mmlspark_tpu.io_http.cognitive.IdentifyFaces": [_face_to(
+            IdentifyFaces, {"person_group_id": "pg", "face_ids": ["a"]})],
+        "mmlspark_tpu.io_http.cognitive.VerifyFaces": [_face_to(
+            VerifyFaces, {"face_id1": "a", "face_id2": "a"})],
+        "mmlspark_tpu.io_http.cognitive.BingImageSearch": [_bing_to()],
+        "mmlspark_tpu.io_http.search.AzureSearchWriter": [_azure_search_to()],
     }
 
 
