@@ -267,6 +267,19 @@ class Table:
         cut = int(round(fraction * self.num_rows))
         return self.gather(perm[:cut]), self.gather(perm[cut:])
 
+    # -- fluent ML sugar (reference core/spark FluentAPI.scala:13-30) ------
+    def ml_transform(self, *stages) -> "Table":
+        """`table.ml_transform(s1, s2, ...)` = run transformers in order
+        (reference `df.mlTransform(stage)`)."""
+        current = self
+        for stage in stages:
+            current = stage.transform(current)
+        return current
+
+    def ml_fit(self, estimator):
+        """`table.ml_fit(est)` = est.fit(table) (reference `df.mlFit`)."""
+        return estimator.fit(self)
+
     # -- misc --------------------------------------------------------------
     def __repr__(self) -> str:
         parts = []

@@ -253,6 +253,11 @@ class _AsyncPollBase(_VisionBase):
             if not (isinstance(r, HTTPResponseData) and r.ok):
                 return r
             status = (r.json() or {}).get("status", "")
+            if status == "Failed":
+                # terminal failure is an ERROR, not a parsed success — route
+                # through the error_col/raise path with the payload attached
+                return HTTPResponseData(502, "async operation Failed",
+                                        dict(r.headers), r.entity)
             if status not in ("Running", "NotStarted", ""):
                 return r
             _time.sleep(self.get("poll_interval_s"))

@@ -167,13 +167,6 @@ class ModelDownloader:
         if os.path.exists(dest) and not force:
             return dest
         src = schema.uri
-        if src.startswith("file://"):
-            src = src[len("file://"):]
-        if src.startswith(("http://", "https://")):
-            raise RuntimeError(
-                "remote HTTP model sources are unavailable in this "
-                "environment; stage the file locally and use a file:// uri"
-            )
 
         def copy():
             # unique tmp per attempt, and the WORKER never touches dest: a
@@ -189,7 +182,12 @@ class ModelDownloader:
             )
             os.close(fd)
             try:
-                shutil.copyfile(src, tmp)
+                # scheme-dispatched fetch: local, file://, http(s)://, or
+                # fsspec-backed cloud stores (utils.storage — the
+                # HadoopUtils/remote-repo analogue)
+                from ..utils.storage import copy_to_local
+
+                copy_to_local(src, tmp)
             except BaseException:
                 if os.path.exists(tmp):
                     os.unlink(tmp)

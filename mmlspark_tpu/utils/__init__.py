@@ -1,2 +1,4 @@
 from .async_utils import buffered_map, buffered_map_safe, retry_with_backoff, RetryError
 from .profiling import device_trace, annotate, profile_fn, block_until_ready
+from .datagen import ColumnSpec, generate_table, random_specs
+from . import storage

@@ -1,0 +1,115 @@
+"""Storage abstraction: scheme-dispatched file access.
+
+Reference: `core/hadoop/HadoopUtils.scala` + the `org.apache.hadoop.fs`
+usage throughout `ModelDownloader.scala:54-119` (remote Azure-blob repo →
+local/HDFS repo). TPU-first equivalent: one small URI-dispatch layer —
+local paths and `file://` natively, `http(s)://` read-only via urllib,
+`gs://`/`s3://` through fsspec when installed (gated, never required) —
+so callers (the model zoo, checkpoint paths) never branch on scheme.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import urllib.parse
+import urllib.request
+from typing import BinaryIO
+
+__all__ = [
+    "scheme_of",
+    "exists",
+    "read_bytes",
+    "write_bytes",
+    "open_read",
+    "copy_to_local",
+]
+
+_FSSPEC_SCHEMES = ("gs", "s3", "abfs", "az", "hdfs")
+
+
+def scheme_of(uri: str) -> str:
+    """'' for plain local paths; otherwise the lowercase URI scheme."""
+    parsed = urllib.parse.urlparse(uri)
+    # windows drive letters / bare paths have no netloc and 0-1 char scheme
+    if len(parsed.scheme) <= 1:
+        return ""
+    return parsed.scheme.lower()
+
+
+def _local_path(uri: str) -> str:
+    if uri.startswith("file://"):
+        return urllib.parse.urlparse(uri).path or uri[len("file://"):]
+    return uri
+
+
+def _fsspec_fs(scheme: str):
+    try:
+        import fsspec  # optional, never a hard dependency
+    except ImportError as e:
+        raise NotImplementedError(
+            f"{scheme}:// access needs fsspec (+ the {scheme} driver) "
+            "installed; stage the file locally or serve it over http"
+        ) from e
+    return fsspec.filesystem(scheme)
+
+
+def exists(uri: str) -> bool:
+    scheme = scheme_of(uri)
+    if scheme in ("", "file"):
+        return os.path.exists(_local_path(uri))
+    if scheme in ("http", "https"):
+        req = urllib.request.Request(uri, method="HEAD")
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return 200 <= r.status < 300
+        except Exception:  # noqa: BLE001 — absent/unreachable both mean "no"
+            return False
+    if scheme in _FSSPEC_SCHEMES:
+        return _fsspec_fs(scheme).exists(uri)
+    raise ValueError(f"unsupported storage scheme {scheme!r} in {uri!r}")
+
+
+def open_read(uri: str) -> BinaryIO:
+    scheme = scheme_of(uri)
+    if scheme in ("", "file"):
+        return open(_local_path(uri), "rb")
+    if scheme in ("http", "https"):
+        return urllib.request.urlopen(uri, timeout=60)
+    if scheme in _FSSPEC_SCHEMES:
+        return _fsspec_fs(scheme).open(uri, "rb")
+    raise ValueError(f"unsupported storage scheme {scheme!r} in {uri!r}")
+
+
+def read_bytes(uri: str) -> bytes:
+    with open_read(uri) as f:
+        return f.read()
+
+
+def write_bytes(uri: str, data: bytes) -> None:
+    scheme = scheme_of(uri)
+    if scheme in ("", "file"):
+        path = _local_path(uri)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(data)
+        return
+    if scheme in ("http", "https"):
+        raise ValueError("http(s) storage is read-only")
+    if scheme in _FSSPEC_SCHEMES:
+        with _fsspec_fs(scheme).open(uri, "wb") as f:
+            f.write(data)
+        return
+    raise ValueError(f"unsupported storage scheme {scheme!r} in {uri!r}")
+
+
+def copy_to_local(uri: str, dest_path: str) -> str:
+    """Stream any readable URI to a local file (the remote→local repo hop,
+    ModelDownloader.scala:54-119)."""
+    scheme = scheme_of(uri)
+    if scheme in ("", "file"):
+        shutil.copyfile(_local_path(uri), dest_path)
+        return dest_path
+    with open_read(uri) as src, open(dest_path, "wb") as dst:
+        shutil.copyfileobj(src, dst)
+    return dest_path

@@ -339,15 +339,17 @@ class TestAzureSearchOverSocket:
 
     def test_existing_index_not_recreated(self, cog_server):
         url, state = cog_server
-        before = len([c for c in state["calls"]
-                      if c["path"].split("?")[0].endswith("/search/indexes")
-                      and "method" not in c])
+
+        def create_posts():
+            return len([c for c in state["calls"]
+                        if c["path"].split("?")[0].endswith("/search/indexes")
+                        and "method" not in c])
+
         writer = AzureSearchWriter(
             service_url=url + "/search",
-            index_definition={"name": "test-idx", "fields": []},
+            index_definition={"name": "idempotent-idx", "fields": []},
         )
-        writer.transform(Table({"id": ["9"]}))
-        after = len([c for c in state["calls"]
-                     if c["path"].split("?")[0].endswith("/search/indexes")
-                     and "method" not in c])
-        assert after == before    # no second create POST
+        writer.transform(Table({"id": ["1"]}))     # creates the index
+        between = create_posts()
+        writer.transform(Table({"id": ["2"]}))     # probe hits, no re-create
+        assert create_posts() == between

@@ -1,0 +1,184 @@
+"""Aux-subsystem tests: plot module, datagen, storage abstraction, remote
+model zoo fetch, FluentAPI sugar.
+
+Reference: src/plot/src/main/python/plot.py:17-40, core/test/datagen
+(GenerateDataset/DatasetConstraints), core/hadoop + ModelDownloader's
+remote repo (ModelDownloader.scala:54-119), core/spark FluentAPI.scala:13-30.
+"""
+
+import http.server
+import threading
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.schema import Table
+from mmlspark_tpu.plot import confusion_matrix, plot_confusion_matrix, plot_roc
+from mmlspark_tpu.utils import ColumnSpec, generate_table, random_specs, storage
+
+
+class TestPlot:
+    def _scored(self):
+        return Table({
+            "label": np.array([0.0, 0.0, 1.0, 1.0, 1.0]),
+            "scored_labels": np.array([0.0, 1.0, 1.0, 1.0, 0.0]),
+            "scores": np.array([0.1, 0.6, 0.8, 0.9, 0.4]),
+        })
+
+    def test_confusion_matrix_counts(self):
+        m = confusion_matrix(self._scored())
+        assert m.tolist() == [[1, 1], [1, 2]]
+
+    def test_plot_confusion_matrix_renders(self):
+        m, ax = plot_confusion_matrix(self._scored())
+        assert ax is not None and m.sum() == 5
+
+    def test_plot_roc(self):
+        (fpr, tpr, _), auc_value, ax = plot_roc(self._scored())
+        assert 0.5 < auc_value <= 1.0
+        assert fpr[0] == 0.0 and tpr[-1] == 1.0
+        assert ax is not None
+
+    def test_headless_skip_render(self):
+        m, ax = plot_confusion_matrix(self._scored(), ax=False)
+        assert ax is None and m.shape == (2, 2)
+
+
+class TestDatagen:
+    def test_constraints_respected(self):
+        specs = [
+            ColumnSpec("d", "double", low=-1, high=1, null_fraction=0.2),
+            ColumnSpec("i", "int", low=0, high=9),
+            ColumnSpec("b", "bool"),
+            ColumnSpec("s", "string", length=4),
+            ColumnSpec("c", "category", cardinality=3),
+            ColumnSpec("v", "vector", length=6),
+        ]
+        t = generate_table(specs, 200, seed=1)
+        assert t.num_rows == 200
+        d = np.asarray(t["d"], np.float64)
+        finite = d[np.isfinite(d)]
+        assert finite.min() >= -1 and finite.max() <= 1
+        assert 0.05 < np.isnan(d).mean() < 0.5
+        i = np.asarray(t["i"])
+        assert i.min() >= 0 and i.max() <= 9
+        assert all(len(s) == 4 for s in t["s"])
+        assert set(t["c"]) <= {"level_0", "level_1", "level_2"}
+        assert t.meta("c")["category_values"] == ["level_0", "level_1", "level_2"]
+        assert np.asarray(t["v"]).shape == (200, 6)
+
+    def test_deterministic_by_seed(self):
+        specs = random_specs(5, seed=3)
+        t1 = generate_table(specs, 50, seed=7)
+        t2 = generate_table(specs, 50, seed=7)
+        assert t1.equals(t2)
+
+    def test_feeds_serialization_roundtrip(self):
+        """Datagen tables drive a stage save/load roundtrip (the reference's
+        datagen-for-serialization-tests purpose)."""
+        from mmlspark_tpu.core.pipeline import PipelineStage
+        from mmlspark_tpu.ops.indexer import ValueIndexer
+
+        t = generate_table([ColumnSpec("c", "category", cardinality=4)], 100, seed=2)
+        model = ValueIndexer(input_col="c", output_col="i").fit(t)
+        import tempfile
+
+        d = tempfile.mkdtemp()
+        model.save(d)
+        loaded = PipelineStage.load(d)
+        assert loaded.transform(t).equals(model.transform(t))
+
+    def test_bad_spec_rejected(self):
+        with pytest.raises(ValueError):
+            ColumnSpec("x", "floaty")
+        with pytest.raises(ValueError):
+            ColumnSpec("x", "double", null_fraction=2.0)
+
+
+class TestStorage:
+    def test_local_roundtrip(self, tmp_path):
+        p = str(tmp_path / "a" / "b.bin")
+        storage.write_bytes(p, b"hello")
+        assert storage.exists(p)
+        assert storage.read_bytes(p) == b"hello"
+        assert storage.read_bytes("file://" + p) == b"hello"
+        assert not storage.exists(str(tmp_path / "nope"))
+
+    def test_scheme_of(self):
+        assert storage.scheme_of("/plain/path") == ""
+        assert storage.scheme_of("file:///x") == "file"
+        assert storage.scheme_of("https://h/x") == "https"
+        assert storage.scheme_of("C:\\win\\path") in ("", "c")
+
+    def test_http_read_and_exists(self, tmp_path):
+        served = tmp_path / "blob.bin"
+        served.write_bytes(b"remote-bytes")
+        handler = lambda *a, **kw: http.server.SimpleHTTPRequestHandler(
+            *a, directory=str(tmp_path), **kw)
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            url = f"http://127.0.0.1:{srv.server_address[1]}/blob.bin"
+            assert storage.exists(url)
+            assert storage.read_bytes(url) == b"remote-bytes"
+            assert not storage.exists(url + ".missing")
+            with pytest.raises(ValueError):
+                storage.write_bytes(url, b"nope")
+            dest = str(tmp_path / "fetched.bin")
+            storage.copy_to_local(url, dest)
+            assert open(dest, "rb").read() == b"remote-bytes"
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_unknown_scheme(self):
+        with pytest.raises(ValueError):
+            storage.read_bytes("weird://x/y")
+
+
+class TestRemoteZoo:
+    def test_download_model_over_http(self, tmp_path):
+        """ModelDownloader fetches a bundle from an http:// uri with sha256
+        verification (remote repo → local repo, ModelDownloader.scala:54-119)."""
+        import hashlib
+
+        from mmlspark_tpu.nn import ModelBundle, ModelDownloader, ModelSchema
+
+        src = tmp_path / "serve" / "tiny.model"
+        src.parent.mkdir()
+        ModelBundle.init("mlp", (4,), num_outputs=2).save(str(src))
+        sha = hashlib.sha256(src.read_bytes()).hexdigest()
+        handler = lambda *a, **kw: http.server.SimpleHTTPRequestHandler(
+            *a, directory=str(src.parent), **kw)
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            url = f"http://127.0.0.1:{srv.server_address[1]}/tiny.model"
+            repo = ModelDownloader(str(tmp_path / "repo"))
+            schema = ModelSchema(name="tiny-http", uri=url, sha256=sha)
+            local = repo.download_model(schema)
+            bundle = ModelBundle.load(local)
+            assert bundle.architecture == "mlp"
+            # corrupted hash still rejected over http
+            bad = ModelSchema(name="bad-http", uri=url, sha256="0" * 64)
+            with pytest.raises(IOError):
+                repo.download_model(bad)
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+
+class TestFluentAPI:
+    def test_ml_transform_and_fit(self):
+        from mmlspark_tpu.ops.indexer import ValueIndexer
+        from mmlspark_tpu.ops.stages import DropColumns, RenameColumn
+
+        t = Table({"c": ["a", "b", "a"], "junk": np.arange(3.0)})
+        model = t.ml_fit(ValueIndexer(input_col="c", output_col="i"))
+        out = t.ml_transform(
+            model,
+            DropColumns(cols=["junk"]),
+            RenameColumn(input_col="i", output_col="idx"),
+        )
+        assert out.columns == ["c", "idx"]
+        assert list(np.asarray(out["idx"])) == [0.0, 1.0, 0.0]
