@@ -122,6 +122,18 @@ class BinMapper:
             raise ValueError(f"expected {self.num_features} features, got {f}")
         out = np.zeros((n, f), dtype=np.int32)
         cat = set(self.category_maps)
+        # native dataset-build path (the generateDenseDataset analogue,
+        # mmlspark_tpu/native): numeric features binned in C++ when the
+        # toolchain is available — bit-identical to the numpy path below
+        from ..native import bin_numeric as _native_bin
+
+        is_cat_arr = np.zeros(f, np.uint8)
+        for j in cat:
+            is_cat_arr[j] = 1
+        did_native = _native_bin(
+            x, np.asarray(self.upper_bounds, np.float64),
+            np.asarray(self.num_bins, np.int32), is_cat_arr, out,
+        )
         for j in range(f):
             col = x[:, j]
             if j in cat:
@@ -138,6 +150,8 @@ class BinMapper:
                 hit = (idx < len(keys)) & (keys[idx_c] == safe)
                 out[:, j] = np.where(hit, bins_of[idx_c], MISSING_BIN)
                 continue
+            if did_native:
+                continue  # numeric features already binned in C++
             nb = int(self.num_bins[j])
             if nb <= 1:
                 continue

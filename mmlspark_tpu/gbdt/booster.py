@@ -604,9 +604,20 @@ class Booster:
     def _predict_raw_host(self, bins: np.ndarray) -> np.ndarray:
         n = bins.shape[0]
         k = self.num_class
+        max_steps = int(self.feature.shape[1] // 2 + 1)
+        # native per-row scoring (the LGBM_BoosterPredictForMat analogue,
+        # mmlspark_tpu/native); bit-identical to the numpy walk below
+        from ..native import predict_trees as _native_predict
+
+        res = _native_predict(
+            np.asarray(bins, np.int32), self.feature, self.threshold_bin,
+            self.is_categorical, self.left, self.right, self.value,
+            self.tree_class, k, max_steps, self.init_score,
+        )
+        if res is not None:
+            return res
         out = (np.zeros((n, k), np.float32) if k > 1
                else np.full((n,), self.init_score, np.float32))
-        max_steps = int(self.feature.shape[1] // 2 + 1)
         rows = np.arange(n)
         for t in range(self.num_trees):
             feature, thr = self.feature[t], self.threshold_bin[t]

@@ -1,0 +1,143 @@
+"""Native host-kernel loader (the NativeLoader analogue).
+
+Reference: `NativeLoader.java:47-105` extracts the right `.so` for the
+platform and `System.load`s it before any native call. Here: the C++
+kernels in `kernels.cpp` are compiled ON DEMAND with the system toolchain
+(g++, cached by source mtime) and bound via ctypes; every entry point has a
+pure-numpy fallback, so a missing toolchain degrades to the Python path
+instead of failing (`available()` reports which path is active).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+
+import numpy as np
+
+__all__ = ["available", "get_lib", "bin_numeric", "predict_trees"]
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "kernels.cpp")
+_LOCK = threading.Lock()
+_LIB: "ctypes.CDLL | None | bool" = None  # None = untried, False = unavailable
+
+_I32 = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+_U8 = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+_F32 = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+_F64 = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+_I64 = ctypes.c_int64
+
+
+def _build_dir() -> str:
+    d = os.environ.get("MMLSPARK_TPU_NATIVE_DIR") or os.path.join(_DIR, "_build")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _compile() -> str | None:
+    """Never raises: any filesystem/toolchain problem returns None (the
+    caller falls back to numpy, as NativeLoader falls back on resource
+    lookup failure)."""
+    try:
+        out = os.path.join(_build_dir(), "libmmlsparktpu.so")
+        if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(_SRC):
+            return out
+        # unique tmp + atomic rename: concurrent builders can't corrupt the .so
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_build_dir())
+        os.close(fd)
+    except OSError:
+        return None  # read-only install dir, missing kernels.cpp, ...
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", tmp]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        if proc.returncode != 0:
+            os.unlink(tmp)
+            return None
+        os.replace(tmp, out)
+        return out
+    except (OSError, subprocess.TimeoutExpired):
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        return None
+
+
+def get_lib() -> "ctypes.CDLL | None":
+    global _LIB
+    with _LOCK:
+        if _LIB is not None:
+            return _LIB or None
+        if os.environ.get("MMLSPARK_TPU_NO_NATIVE"):
+            _LIB = False
+            return None
+        path = _compile()
+        if path is None:
+            _LIB = False
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            _LIB = False
+            return None
+        lib.mmlspark_bin_numeric.argtypes = [
+            _F64, _I64, _I64, _F64, _I64, _I32, _U8, _I32,
+        ]
+        lib.mmlspark_bin_numeric.restype = None
+        lib.mmlspark_predict_trees.argtypes = [
+            _I32, _I64, _I64, _I64, _I64,
+            _I32, _I32, _U8, _I32, _I32, _F32, _I32,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_float, _F32,
+        ]
+        lib.mmlspark_predict_trees.restype = None
+        _LIB = lib
+        return lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def bin_numeric(x: np.ndarray, upper_bounds: np.ndarray, num_bins: np.ndarray,
+                is_cat: np.ndarray, out: np.ndarray) -> bool:
+    """Fill `out` for numeric features; returns False when the native lib is
+    unavailable (caller runs the numpy path)."""
+    lib = get_lib()
+    if lib is None:
+        return False
+    n, f = x.shape
+    lib.mmlspark_bin_numeric(
+        np.ascontiguousarray(x, np.float64), n, f,
+        np.ascontiguousarray(upper_bounds, np.float64), upper_bounds.shape[1],
+        np.ascontiguousarray(num_bins, np.int32),
+        np.ascontiguousarray(is_cat, np.uint8),
+        out,
+    )
+    return True
+
+
+def predict_trees(bins: np.ndarray, feature: np.ndarray, threshold: np.ndarray,
+                  is_cat: np.ndarray, left: np.ndarray, right: np.ndarray,
+                  value: np.ndarray, tree_class: np.ndarray, k: int,
+                  max_steps: int, init_score: float) -> "np.ndarray | None":
+    """SoA tree-walk scoring; None when the native lib is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n, f = bins.shape
+    t, m = feature.shape
+    out = (np.zeros((n, k), np.float32) if k > 1 else np.zeros((n,), np.float32))
+    lib.mmlspark_predict_trees(
+        np.ascontiguousarray(bins, np.int32), n, f, t, m,
+        np.ascontiguousarray(feature, np.int32),
+        np.ascontiguousarray(threshold, np.int32),
+        np.ascontiguousarray(is_cat, np.uint8),
+        np.ascontiguousarray(left, np.int32),
+        np.ascontiguousarray(right, np.int32),
+        np.ascontiguousarray(value, np.float32),
+        np.ascontiguousarray(tree_class, np.int32),
+        k, max_steps, float(init_score), out,
+    )
+    return out

@@ -1,0 +1,110 @@
+// Host-side native kernels for the TPU framework's runtime edge.
+//
+// Reference analogue: the reference's dataset-build and per-row predict are
+// C++ (lib_lightgbm via generateDenseDataset, LightGBMUtils.scala:326-394,
+// and LGBM_BoosterPredictForMat, LightGBMBooster.scala:38-113). The TPU
+// compute path is XLA/Pallas; these kernels cover the HOST hot paths around
+// it — feature binning during dataset build and small-batch tree-walk
+// scoring (the serving latency path) — loaded via ctypes by
+// mmlspark_tpu/native/__init__.py with a numpy fallback when no toolchain
+// is available (the NativeLoader role, NativeLoader.java:47-105).
+//
+// Both kernels are written to be BIT-IDENTICAL to their numpy/XLA
+// counterparts: same searchsorted semantics for binning, same float32
+// accumulation order for prediction.
+
+#include <cmath>
+#include <cstdint>
+
+extern "C" {
+
+// Numeric-feature binning: replicates
+//   np.searchsorted(upper_bounds[j,1:nb], col, side='left') + 1,
+//   clipped to [1, nb-1]; NaN/inf -> bin 0.
+// Categorical features (is_cat[j] != 0) and single-bin features are left
+// untouched for the Python side to fill.
+void mmlspark_bin_numeric(
+    const double* x,            // (n, f) row-major
+    int64_t n, int64_t f,
+    const double* upper_bounds, // (f, ub_stride) row-major; bounds at [1..nb-1]
+    int64_t ub_stride,
+    const int32_t* num_bins,    // (f,)
+    const uint8_t* is_cat,      // (f,)
+    int32_t* out)               // (n, f) row-major, pre-zeroed
+{
+    for (int64_t j = 0; j < f; ++j) {
+        const int32_t nb = num_bins[j];
+        if (is_cat[j] || nb <= 1) continue;
+        const double* ub = upper_bounds + j * ub_stride + 1;  // skip bin 0
+        const int64_t m = nb - 1;  // number of real boundaries
+        for (int64_t i = 0; i < n; ++i) {
+            const double v = x[i * f + j];
+            if (!std::isfinite(v)) {
+                out[i * f + j] = 0;  // MISSING_BIN
+                continue;
+            }
+            // lower_bound == searchsorted(side='left')
+            int64_t lo = 0, hi = m;
+            while (lo < hi) {
+                const int64_t mid = (lo + hi) >> 1;
+                if (ub[mid] < v) lo = mid + 1; else hi = mid;
+            }
+            int64_t b = lo + 1;
+            if (b < 1) b = 1;
+            if (b > nb - 1) b = nb - 1;
+            out[i * f + j] = static_cast<int32_t>(b);
+        }
+    }
+}
+
+// Array-of-trees SoA traversal over binned rows: replicates the jitted
+// device traversal (and the numpy host walk) exactly — fixed max_steps
+// gather-walk per tree, float32 accumulation in tree order.
+void mmlspark_predict_trees(
+    const int32_t* bins,        // (n, f) row-major
+    int64_t n, int64_t f,
+    int64_t num_trees, int64_t nodes_per_tree,
+    const int32_t* feature,     // (T, M)
+    const int32_t* threshold,   // (T, M)
+    const uint8_t* is_cat,      // (T, M)
+    const int32_t* left,        // (T, M)
+    const int32_t* right,       // (T, M)
+    const float* value,         // (T, M)
+    const int32_t* tree_class,  // (T,)
+    int32_t k,                  // 1 = scalar output, >1 = (n, k) multiclass
+    int32_t max_steps,
+    float init_score,
+    float* out)                 // (n,) or (n, k), pre-zeroed
+{
+    if (k <= 1) {
+        for (int64_t i = 0; i < n; ++i) out[i] = init_score;
+    }
+    for (int64_t t = 0; t < num_trees; ++t) {
+        const int64_t off = t * nodes_per_tree;
+        const int32_t* tf = feature + off;
+        const int32_t* tt = threshold + off;
+        const uint8_t* tc = is_cat + off;
+        const int32_t* tl = left + off;
+        const int32_t* tr = right + off;
+        const float* tv = value + off;
+        const int32_t cls = tree_class[t];
+        for (int64_t i = 0; i < n; ++i) {
+            int32_t node = 0;
+            for (int32_t s = 0; s < max_steps; ++s) {
+                const int32_t feat = tf[node];
+                if (feat < 0) break;  // leaf
+                const int32_t col = bins[i * f + feat];
+                const bool go_left = tc[node] ? (col == tt[node])
+                                              : (col <= tt[node]);
+                node = go_left ? tl[node] : tr[node];
+            }
+            if (k > 1) {
+                out[i * k + cls] += tv[node];
+            } else {
+                out[i] += tv[node];
+            }
+        }
+    }
+}
+
+}  // extern "C"

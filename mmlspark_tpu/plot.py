@@ -24,25 +24,27 @@ def confusion_matrix(table: Table, label_col: str = "label",
     p = np.asarray(table[prediction_col], np.float64)
     classes = np.unique(np.concatenate([y, p]))
     k = len(classes)
-    idx = {c: i for i, c in enumerate(classes.tolist())}
+    yi = np.searchsorted(classes, y)
+    pi = np.searchsorted(classes, p)
     m = np.zeros((k, k), np.int64)
-    for yi, pi in zip(y, p):
-        m[idx[yi], idx[pi]] += 1
+    np.add.at(m, (yi, pi), 1)
     return m
 
 
 def _axes(ax):
+    """ax=False -> no rendering; ax=None -> a fresh standalone Figure axes
+    (no pyplot state, no global-backend mutation — callers own the figure
+    via ax.figure)."""
     if ax is False:
         return None
     if ax is not None:
         return ax
-    import matplotlib
+    from matplotlib.backends.backend_agg import FigureCanvasAgg
+    from matplotlib.figure import Figure
 
-    matplotlib.use("Agg", force=False)
-    import matplotlib.pyplot as plt
-
-    _, ax = plt.subplots()
-    return ax
+    fig = Figure()
+    FigureCanvasAgg(fig)
+    return fig.add_subplot()
 
 
 def plot_confusion_matrix(table: Table, label_col: str = "label",

@@ -28,13 +28,24 @@ __all__ = [
 _FSSPEC_SCHEMES = ("gs", "s3", "abfs", "az", "hdfs")
 
 
+_KNOWN_SCHEMES = ("file", "http", "https") + _FSSPEC_SCHEMES
+
+
 def scheme_of(uri: str) -> str:
-    """'' for plain local paths; otherwise the lowercase URI scheme."""
+    """'' for local paths; otherwise the lowercase URI scheme. A colon-y
+    first path segment that is NOT a known scheme (e.g. 'model:v2.bin') is
+    still a local path — the pre-abstraction zoo copied such names with
+    shutil and that behavior is preserved."""
     parsed = urllib.parse.urlparse(uri)
     # windows drive letters / bare paths have no netloc and 0-1 char scheme
     if len(parsed.scheme) <= 1:
         return ""
-    return parsed.scheme.lower()
+    scheme = parsed.scheme.lower()
+    if scheme in _KNOWN_SCHEMES:
+        return scheme
+    if parsed.netloc:
+        return scheme  # URL-shaped but unknown -> callers reject it loudly
+    return ""          # colon-y local filename like 'model:v2.bin'
 
 
 def _local_path(uri: str) -> str:

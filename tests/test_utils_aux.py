@@ -135,6 +135,20 @@ class TestStorage:
         with pytest.raises(ValueError):
             storage.read_bytes("weird://x/y")
 
+    def test_colon_local_filename_is_local(self, tmp_path):
+        """'model:v2.bin'-style names are local paths, not schemes (the
+        pre-abstraction zoo copied them with shutil)."""
+        import os
+
+        cwd = os.getcwd()
+        os.chdir(tmp_path)
+        try:
+            storage.write_bytes("model:v2.bin", b"x")
+            assert storage.exists("model:v2.bin")
+            assert storage.read_bytes("model:v2.bin") == b"x"
+        finally:
+            os.chdir(cwd)
+
 
 class TestRemoteZoo:
     def test_download_model_over_http(self, tmp_path):
