@@ -158,7 +158,32 @@ def bench_model_runner() -> dict:
     probs = np.asarray(out["output"])
     elapsed = time.perf_counter() - t0
     assert probs.shape[0] == N_IMAGES and np.isfinite(probs).all()
-    return {"images_per_sec": N_IMAGES / elapsed, "transform_seconds": elapsed}
+
+    # compute ceiling: the same forward on device-RESIDENT data — the gap to
+    # the end-to-end number is host<->device transfer, not MXU time
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def fwd(v, xb):
+        xf = (xb.astype(jnp.float32) - 127.5) / 63.75
+        return bundle.module.apply(v, xf, train=False)
+
+    xd = jax.device_put(images)
+    jax.block_until_ready(fwd(bundle.variables, xd[:IMG_BATCH]))
+    t0 = time.perf_counter()
+    outs = [fwd(bundle.variables, xd[i:i + IMG_BATCH])
+            for i in range(0, N_IMAGES, IMG_BATCH)]
+    np.asarray(jnp.concatenate(outs))
+    resident = N_IMAGES / (time.perf_counter() - t0)
+    # ResNet-20 CIFAR forward ~= 8.2e7 FLOPs/img (2 * ~41M MACs)
+    tflops = resident * 8.2e7 / 1e12
+    return {
+        "images_per_sec": N_IMAGES / elapsed,
+        "transform_seconds": elapsed,
+        "resident_images_per_sec": resident,
+        "resident_tflops": tflops,
+    }
 
 
 def bench_serving() -> dict:
@@ -252,8 +277,20 @@ def main() -> None:
             "model_runner_vs_baseline": round(
                 runner["images_per_sec"] / BASELINE_IMAGES_PER_SEC, 3),
             "model_runner_baseline_images_per_sec": BASELINE_IMAGES_PER_SEC,
+            "model_runner_resident_images_per_sec": round(
+                runner.get("resident_images_per_sec", 0.0), 1),
+            "model_runner_resident_tflops": round(
+                runner.get("resident_tflops", 0.0), 3),
             "serving_p50_ms": round(serving["p50_ms"], 3) if serving else None,
             "serving_p99_ms": round(serving["p99_ms"], 3) if serving else None,
+            "headroom_note": (
+                "end-to-end runner throughput is host->device transfer bound: "
+                f"the device-resident forward runs "
+                f"{runner['resident_images_per_sec'] / max(runner['images_per_sec'], 1):.1f}x "
+                "faster (see resident_* fields); gbdt fit is one fused XLA "
+                "program per config — remaining headroom is histogram-kernel "
+                "tiling and multi-chip scaling"
+            ),
         },
     }))
 
