@@ -1,0 +1,36 @@
+"""Transfer learning — the `DeepLearning - Transfer Learning` notebook flow:
+featurize images with a truncated pretrained network (ImageFeaturizer), then
+train a cheap downstream model on the embeddings.
+"""
+
+import numpy as np
+
+from mmlspark_tpu.core.schema import Table
+from mmlspark_tpu.gbdt import GBDTClassifier
+from mmlspark_tpu.nn import ImageFeaturizer, ModelBundle
+
+
+def main():
+    rng = np.random.default_rng(5)
+    n, classes = 256, 3
+    y = rng.integers(0, classes, size=n).astype(np.float64)
+    x = rng.normal(size=(n, 16, 16, 3)).astype(np.float32)
+    x[..., 0] += y[:, None, None] * 2.0       # class signal in channel 0
+
+    base = ModelBundle.init("resnet20_cifar", (16, 16, 3), num_outputs=10)
+    featurizer = ImageFeaturizer(
+        input_col="image", output_col="features", cut_output_layers=1,
+    ).set_model(base)
+
+    table = Table({"image": x, "label": y})
+    feats = featurizer.transform(table)
+    model = feats.ml_fit(GBDTClassifier(num_iterations=30, num_leaves=15,
+                                        objective="multiclass"))
+    pred = np.asarray(model.transform(feats)["prediction"], np.float64)
+    acc = float((pred == y).mean())
+    print(f"transfer-learning train accuracy over {classes} classes: {acc:.3f}")
+    assert acc > 0.8
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+"""Hyperparameter tuning — the `HyperParameterTuning - Fighting Breast
+Cancer` notebook flow: random/grid search with k-fold CV, then best-model
+selection (TuneHyperparameters + FindBestModel).
+"""
+
+import numpy as np
+
+from mmlspark_tpu.automl import (
+    DiscreteHyperParam,
+    FindBestModel,
+    GridSpace,
+    RangeHyperParam,
+    TuneHyperparameters,
+)
+from mmlspark_tpu.core.schema import Table
+from mmlspark_tpu.gbdt import GBDTClassifier
+
+
+def main():
+    rng = np.random.default_rng(11)
+    n = 600
+    x = rng.normal(size=(n, 9))
+    y = (x[:, 0] + x[:, 1] ** 2 - x[:, 2] > 0.5).astype(np.float64)
+    table = Table({"features": x, "label": y})
+
+    tuned = TuneHyperparameters(
+        models=GBDTClassifier(),
+        param_space=GridSpace({
+            "num_leaves": DiscreteHyperParam([7, 15, 31]),
+            "learning_rate": RangeHyperParam(0.05, 0.2, n_grid=2),
+            "num_iterations": DiscreteHyperParam([25]),
+        }),
+        num_folds=3, parallelism=4, evaluation_metric="accuracy",
+    ).fit(table)
+    print(f"best params {tuned.best_params} -> CV accuracy {tuned.best_metric:.3f}")
+
+    # compare the tuned model against a deliberately weak baseline
+    weak = GBDTClassifier(num_iterations=2, num_leaves=2).fit(table)
+    best = FindBestModel(
+        models=[weak, tuned.best_model], evaluation_metric="accuracy",
+    ).fit(table)
+    assert best.best_model is tuned.best_model
+    print("FindBestModel picked the tuned model")
+
+
+if __name__ == "__main__":
+    main()

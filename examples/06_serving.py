@@ -1,0 +1,45 @@
+"""Model serving — the `SparkServing - Deploying a Classifier` notebook
+flow: train, deploy behind a local HTTP endpoint (continuous direct-reply
+path), POST rows, read the measured service latency.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+
+from mmlspark_tpu.core.schema import Table
+from mmlspark_tpu.gbdt import GBDTClassifier
+from mmlspark_tpu.io_http import serve_model
+
+
+def main():
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(2000, 4))
+    y = (x[:, 0] - x[:, 1] > 0).astype(np.float64)
+    model = Table({"features": x, "label": y}).ml_fit(
+        GBDTClassifier(num_iterations=30, num_leaves=15)
+    )
+
+    server = serve_model(model, input_cols=["f0", "f1", "f2", "f3"],
+                         max_latency_ms=0.5)
+    try:
+        correct = 0
+        for i in range(50):
+            row = {f"f{j}": float(x[i, j]) for j in range(4)}
+            req = urllib.request.Request(
+                server.url, data=json.dumps(row).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=10) as r:
+                pred = json.loads(r.read())["prediction"]
+            correct += pred == y[i]
+        stats = server.latency_stats()
+        print(f"served 50 rows, accuracy {correct / 50:.2f}, "
+              f"p50 {stats['p50_ms']:.2f} ms, p99 {stats['p99_ms']:.2f} ms")
+    finally:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()
