@@ -30,11 +30,11 @@ def main():
     ))
     scored = model.transform(test)
 
+    # ComputeModelStatistics takes the (n, 2) probability column directly
+    # (it slices the positive-class column itself)
     stats = ComputeModelStatistics(
         scored_labels_col="prediction", scores_col="probability",
-    ).transform(scored.with_column(
-        "probability", np.asarray(scored["probability"])[:, 1]
-    ))
+    ).transform(scored)
     row = next(stats.rows())
     print(f"accuracy={row['accuracy']:.4f}  AUC={row['AUC']:.4f}")
 

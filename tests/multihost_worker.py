@@ -1,0 +1,56 @@
+"""Worker for the multi-host rendezvous test (run as a subprocess).
+
+Exercises the product path: `initialize_runtime` (the jax.distributed
+rendezvous that replaces the reference's driver-socket handshake and
+ssh/MPI, SURVEY.md §5.8) -> global mesh over ALL processes' devices ->
+cross-process psum on the data axis.
+"""
+
+import os
+import sys
+
+
+def main() -> None:
+    rank, n_procs, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=2"
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from mmlspark_tpu.parallel.mesh import initialize_runtime, make_mesh
+
+    initialize_runtime(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=n_procs,
+        process_id=rank,
+    )
+
+    import numpy as np
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()                     # global across processes
+    mesh = make_mesh(n_data=len(devs))
+    psum = jax.jit(shard_map(
+        lambda x: jax.lax.psum(x, "data"), mesh=mesh,
+        in_specs=P("data"), out_specs=P(),
+    ))
+    # per-process local shards -> one global row-sharded array
+    sharding = NamedSharding(mesh, P("data"))
+    shards = [
+        jax.device_put(np.full((1, 1), float(rank + 1), np.float32), d)
+        for d in jax.local_devices()
+    ]
+    garr = jax.make_array_from_single_device_arrays(
+        (len(devs), 1), sharding, shards
+    )
+    out = psum(garr)
+    val = float(np.asarray(out.addressable_data(0))[0, 0])
+    print(f"RESULT rank={rank} n_devices={len(devs)} "
+          f"n_local={len(jax.local_devices())} psum={val}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

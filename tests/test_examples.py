@@ -11,6 +11,7 @@ import pytest
 
 REPO = pathlib.Path(__file__).parent.parent
 EXAMPLES = sorted((REPO / "examples").glob("*.py"))
+assert EXAMPLES, "examples/ glob matched nothing — the smoke gate would pass vacuously"
 
 
 @pytest.mark.parametrize("script", EXAMPLES, ids=lambda p: p.name)
