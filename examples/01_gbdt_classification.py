@@ -4,6 +4,8 @@
 Train -> evaluate -> feature importances -> save/load native model.
 """
 
+import _backend  # noqa: F401 — honors JAX_PLATFORMS=cpu (see _backend.py)
+
 import numpy as np
 
 from mmlspark_tpu.automl import ComputeModelStatistics

@@ -2,6 +2,8 @@
 Discovery` notebook flow: predict a conditional quantile instead of the mean.
 """
 
+import _backend  # noqa: F401 — honors JAX_PLATFORMS=cpu (see _backend.py)
+
 import numpy as np
 
 from mmlspark_tpu.core.schema import Table

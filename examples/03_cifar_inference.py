@@ -3,6 +3,8 @@ Network` notebook flow: a ResNet bundle scored over an image table with the
 jit-compiled DeepModelTransformer (the CNTKModel.transform analogue).
 """
 
+import _backend  # noqa: F401 — honors JAX_PLATFORMS=cpu (see _backend.py)
+
 import numpy as np
 
 from mmlspark_tpu.core.schema import Table

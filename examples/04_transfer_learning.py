@@ -3,6 +3,8 @@ featurize images with a truncated pretrained network (ImageFeaturizer), then
 train a cheap downstream model on the embeddings.
 """
 
+import _backend  # noqa: F401 — honors JAX_PLATFORMS=cpu (see _backend.py)
+
 import numpy as np
 
 from mmlspark_tpu.core.schema import Table

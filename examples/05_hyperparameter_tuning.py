@@ -3,6 +3,8 @@ Cancer` notebook flow: random/grid search with k-fold CV, then best-model
 selection (TuneHyperparameters + FindBestModel).
 """
 
+import _backend  # noqa: F401 — honors JAX_PLATFORMS=cpu (see _backend.py)
+
 import numpy as np
 
 from mmlspark_tpu.automl import (

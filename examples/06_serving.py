@@ -3,6 +3,8 @@ flow: train, deploy behind a local HTTP endpoint (continuous direct-reply
 path), POST rows, read the measured service latency.
 """
 
+import _backend  # noqa: F401 — honors JAX_PLATFORMS=cpu (see _backend.py)
+
 import json
 import urllib.request
 

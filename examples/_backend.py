@@ -1,0 +1,16 @@
+"""Importing this module makes an example honor ``JAX_PLATFORMS=cpu``.
+
+The environment's sitecustomize may pre-register a TPU PJRT plugin and pin
+the platform order ahead of the env var; when the chip is unreachable,
+backend init then hangs instead of falling back. A ``jax.config.update``
+before first device use wins over the pin, so CI (which exports
+``JAX_PLATFORMS=cpu``) always runs the examples on the CPU backend while a
+direct ``python examples/...`` run still uses the real device.
+"""
+
+import os
+
+if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

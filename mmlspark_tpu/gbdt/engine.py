@@ -292,60 +292,68 @@ def make_grow_fn(
         State = tuple  # (tree, node_of_row, hists, depth, best_*, num_nodes, done)
 
         def step(k, state):
+            # No lax.cond: the step computes the split unconditionally and
+            # gates every state update on `act` (selects are cheap; a cond
+            # carrying the multi-MB hists state costs more than the masked
+            # ops it skips, and trees that exhaust their gain before
+            # num_leaves are the rare case). Active-step results are
+            # bit-identical to the old cond branch.
             (tree, node_of_row, hists, depth, best_gain, best_f, best_b,
              num_nodes, done) = state
-            ng, nh, nc = node_totals(hists)
             splittable = tree.is_leaf & (depth < max_depth) & (best_gain > cfg.min_gain_to_split)
             cand_gain = jnp.where(splittable, best_gain, -jnp.inf)
             p = jnp.argmax(cand_gain).astype(jnp.int32)
             no_split = (cand_gain[p] <= cfg.min_gain_to_split) | (cand_gain[p] == -jnp.inf)
             done = done | no_split
+            act = ~done
 
-            def do_split(args):
-                (tree, node_of_row, hists, depth, best_gain, best_f, best_b,
-                 num_nodes) = args
-                f, b = best_f[p], best_b[p]
-                cat = is_cat_f[f]
-                nl_id, nr_id = num_nodes, num_nodes + 1
-                col = bins[jnp.arange(n), jnp.broadcast_to(f, (n,))]
-                go_left = jnp.where(cat, col == b, col <= b)
-                in_p = node_of_row == p
-                node_of_row2 = jnp.where(
-                    in_p, jnp.where(go_left, nl_id, nr_id), node_of_row
-                )
-                lh = hist_for(sample_mask * (node_of_row2 == nl_id))
-                rh = hists[p] - lh
-                hists2 = hists.at[nl_id].set(lh).at[nr_id].set(rh)
-                tree2 = tree._replace(
-                    feature=tree.feature.at[p].set(f),
-                    threshold_bin=tree.threshold_bin.at[p].set(b),
-                    is_categorical=tree.is_categorical.at[p].set(cat),
-                    left=tree.left.at[p].set(nl_id),
-                    right=tree.right.at[p].set(nr_id),
-                    is_leaf=tree.is_leaf.at[p].set(False).at[nl_id].set(True).at[nr_id].set(True),
-                    gain=tree.gain.at[p].set(best_gain[p]),
-                )
-                depth2 = depth.at[nl_id].set(depth[p] + 1).at[nr_id].set(depth[p] + 1)
-                # refresh cached best splits for the two new leaves
-                ng2, nh2, nc2 = node_totals(hists2)
-                gl_, fl_, bl_ = best_split_of(hists2[nl_id], ng2[nl_id], nh2[nl_id], nc2[nl_id])
-                gr_, fr_, br_ = best_split_of(hists2[nr_id], ng2[nr_id], nh2[nr_id], nc2[nr_id])
-                best_gain2 = best_gain.at[nl_id].set(gl_).at[nr_id].set(gr_).at[p].set(-jnp.inf)
-                best_f2 = best_f.at[nl_id].set(fl_).at[nr_id].set(fr_)
-                best_b2 = best_b.at[nl_id].set(bl_).at[nr_id].set(br_)
-                return (tree2, node_of_row2, hists2, depth2, best_gain2,
-                        best_f2, best_b2, num_nodes + 2)
+            def gated(old, new):
+                return jnp.where(act, new, old)
 
-            def no_op(args):
-                return args
-
-            (tree, node_of_row, hists, depth, best_gain, best_f, best_b,
-             num_nodes) = jax.lax.cond(
-                done,
-                no_op,
-                do_split,
-                (tree, node_of_row, hists, depth, best_gain, best_f, best_b, num_nodes),
+            f, b = best_f[p], best_b[p]
+            cat = is_cat_f[f]
+            # clamp so an inactive step still indexes in bounds; node nl_id
+            # has no rows yet when active, and all writes are gated when not
+            nl_id = jnp.minimum(num_nodes, m - 2)
+            nr_id = nl_id + 1
+            col = bins[jnp.arange(n), jnp.broadcast_to(f, (n,))]
+            go_left = jnp.where(cat, col == b, col <= b)
+            in_p = (node_of_row == p) & act
+            node_of_row = jnp.where(
+                in_p, jnp.where(go_left, nl_id, nr_id), node_of_row
             )
+            lh = hist_for(sample_mask * (node_of_row == nl_id) * act)
+            rh = hists[p] - lh
+            hists = hists.at[nl_id].set(gated(hists[nl_id], lh))
+            hists = hists.at[nr_id].set(gated(hists[nr_id], rh))
+            tree = tree._replace(
+                feature=tree.feature.at[p].set(gated(tree.feature[p], f)),
+                threshold_bin=tree.threshold_bin.at[p].set(gated(tree.threshold_bin[p], b)),
+                is_categorical=tree.is_categorical.at[p].set(gated(tree.is_categorical[p], cat)),
+                left=tree.left.at[p].set(gated(tree.left[p], nl_id)),
+                right=tree.right.at[p].set(gated(tree.right[p], nr_id)),
+                is_leaf=(tree.is_leaf
+                         .at[p].set(gated(tree.is_leaf[p], False))
+                         .at[nl_id].set(gated(tree.is_leaf[nl_id], True))
+                         .at[nr_id].set(gated(tree.is_leaf[nr_id], True))),
+                gain=tree.gain.at[p].set(gated(tree.gain[p], best_gain[p])),
+            )
+            depth = (depth
+                     .at[nl_id].set(gated(depth[nl_id], depth[p] + 1))
+                     .at[nr_id].set(gated(depth[nr_id], depth[p] + 1)))
+            # refresh cached best splits for the two new leaves
+            ng2, nh2, nc2 = node_totals(hists)
+            gl_, fl_, bl_ = best_split_of(hists[nl_id], ng2[nl_id], nh2[nl_id], nc2[nl_id])
+            gr_, fr_, br_ = best_split_of(hists[nr_id], ng2[nr_id], nh2[nr_id], nc2[nr_id])
+            best_gain = (best_gain
+                         .at[nl_id].set(gated(best_gain[nl_id], gl_))
+                         .at[nr_id].set(gated(best_gain[nr_id], gr_))
+                         .at[p].set(gated(best_gain[p], -jnp.inf)))
+            best_f = (best_f.at[nl_id].set(gated(best_f[nl_id], fl_))
+                      .at[nr_id].set(gated(best_f[nr_id], fr_)))
+            best_b = (best_b.at[nl_id].set(gated(best_b[nl_id], bl_))
+                      .at[nr_id].set(gated(best_b[nr_id], br_)))
+            num_nodes = num_nodes + jnp.where(act, 2, 0).astype(num_nodes.dtype)
             return (tree, node_of_row, hists, depth, best_gain, best_f, best_b,
                     num_nodes, done)
 

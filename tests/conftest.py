@@ -39,3 +39,17 @@ def mesh8():
 @pytest.fixture()
 def rng():
     return np.random.default_rng(42)
+
+
+def subprocess_env():
+    """Env for test subprocesses: repo root importable, PYTHONPATH APPENDED —
+    the axon TPU PJRT bootstrap (/root/.axon_site) must stay on the path
+    (overwriting PYTHONPATH silently breaks backend registration)."""
+    import pathlib
+
+    repo = str(pathlib.Path(__file__).parent.parent)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [repo] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
+    )
+    return env

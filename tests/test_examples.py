@@ -10,18 +10,16 @@ import sys
 import pytest
 
 REPO = pathlib.Path(__file__).parent.parent
-EXAMPLES = sorted((REPO / "examples").glob("*.py"))
+EXAMPLES = sorted(p for p in (REPO / "examples").glob("*.py")
+                  if not p.name.startswith("_"))   # _backend.py is a shim
 assert EXAMPLES, "examples/ glob matched nothing — the smoke gate would pass vacuously"
 
 
 @pytest.mark.parametrize("script", EXAMPLES, ids=lambda p: p.name)
 def test_example_runs(script):
-    env = dict(os.environ)
-    # repo root importable; APPEND to PYTHONPATH (the axon site bootstrap
-    # must stay first — see .claude/skills/verify/SKILL.md)
-    env["PYTHONPATH"] = os.pathsep.join(
-        [str(REPO)] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
-    )
+    from tests.conftest import subprocess_env
+
+    env = subprocess_env()
     proc = subprocess.run(
         [sys.executable, str(script)],
         capture_output=True, text=True, timeout=600,

@@ -23,10 +23,9 @@ def _free_port() -> int:
 
 def test_two_process_rendezvous_and_psum():
     port = _free_port()
-    env = dict(os.environ)
-    env["PYTHONPATH"] = os.pathsep.join(
-        [str(REPO)] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
-    )
+    from tests.conftest import subprocess_env
+
+    env = subprocess_env()
     env.pop("JAX_PLATFORMS", None)
     procs = [
         subprocess.Popen(
