@@ -51,6 +51,16 @@ class AssembleFeatures(Estimator):
         _NUM_FEATURES_TREE, "hash buckets for string columns", ptype=int
     )
     one_hot_encode_categoricals = Param(True, "one-hot categorical columns", ptype=bool)
+    # A low-cardinality string column is a categorical in disguise: hashing
+    # it into number_of_features buckets (4096 for trees) explodes the
+    # downstream feature width — for the GBDT engine that is O(leaves x
+    # features x bins) of histogram state. The reference avoids this because
+    # its pipelines attach categorical METADATA first (AssembleFeatures.scala
+    # one-hots on metadata); here the levels are learned at fit time.
+    max_one_hot_cardinality = Param(
+        100, "string columns with <= this many distinct values one-hot "
+             "instead of hash (0 = always hash)", ptype=int,
+    )
     allow_images = Param(False, "kept for API parity (images via ImageFeaturizer)", ptype=bool)
 
     def _fit(self, table: Table) -> "AssembleFeaturesModel":
@@ -73,9 +83,7 @@ class AssembleFeatures(Estimator):
             elif isinstance(col, list) and all(
                 isinstance(v, str) or v is None for v in col
             ):
-                specs.append(
-                    {"col": name, "kind": "hash", "dim": self.get("number_of_features")}
-                )
+                specs.append(self._string_spec(name, col))
             else:
                 raise TypeError(
                     f"AssembleFeatures: cannot featurize column {name!r} "
@@ -85,6 +93,36 @@ class AssembleFeatures(Estimator):
         m.set(features_col=self.get("features_col"))
         m.specs = specs
         return m
+
+    def _string_spec(self, name: str, col: list) -> dict:
+        """Single-token low-cardinality string columns are a categorical in
+        disguise: one-hot them as learned levels (hashing them into
+        `number_of_features` buckets explodes the downstream feature width —
+        O(leaves x features x bins) of GBDT histogram state). Free text
+        (multi-token values) and high-cardinality columns hash as before."""
+        hash_spec = {"col": name, "kind": "hash",
+                     "dim": self.get("number_of_features")}
+        cap = int(self.get("max_one_hot_cardinality") or 0)
+        # levels ARE one-hot encoding, so the explicit opt-outs win
+        if cap <= 0 or not self.get("one_hot_encode_categoricals"):
+            return hash_spec
+        # short-circuit the distinct scan once the cap is exceeded; plain
+        # str, not np.str_ (numpy scalars serialize as unhashable 0-d arrays)
+        distinct: set[str] = set()
+        for v in col:
+            if v is None:
+                continue
+            s = str(v)
+            if len(s.split()) > 1:      # free text -> bag-of-words hashing
+                return hash_spec
+            distinct.add(s)
+            if len(distinct) > cap:
+                return hash_spec
+        if not distinct:
+            return hash_spec
+        levels = sorted(distinct)
+        return {"col": name, "kind": "levels", "dim": len(levels),
+                "levels": levels}
 
 
 @register_stage
@@ -112,6 +150,14 @@ class AssembleFeaturesModel(Model):
                 valid = (idx >= 0) & (idx < dim)
                 arr[np.arange(n)[valid], idx[valid]] = 1.0
                 names.extend(f"{spec['col']}={i}" for i in range(dim))
+            elif kind == "levels":
+                level_of = {str(v): i for i, v in enumerate(spec["levels"])}
+                arr = np.zeros((n, dim), dtype=np.float32)
+                for i, v in enumerate(col):
+                    j = None if v is None else level_of.get(str(v))
+                    if j is not None:   # unseen/None -> all-zeros row
+                        arr[i, j] = 1.0
+                names.extend(f"{spec['col']}={v}" for v in spec["levels"])
             elif kind == "hash":
                 arr = np.zeros((n, dim), dtype=np.float32)
                 for i, v in enumerate(col):
@@ -148,6 +194,9 @@ class Featurize(Estimator):
     )
     number_of_features = Param(_NUM_FEATURES_TREE, "hash buckets", ptype=int)
     one_hot_encode_categoricals = Param(True, "one-hot categoricals", ptype=bool)
+    max_one_hot_cardinality = Param(
+        100, "low-cardinality string columns one-hot instead of hash", ptype=int,
+    )
     allow_images = Param(False, "kept for API parity", ptype=bool)
 
     def _fit(self, table: Table) -> "Model":
@@ -160,6 +209,7 @@ class Featurize(Estimator):
                 features_col=out_col,
                 number_of_features=self.get("number_of_features"),
                 one_hot_encode_categoricals=self.get("one_hot_encode_categoricals"),
+                max_one_hot_cardinality=self.get("max_one_hot_cardinality"),
             )
             models.append(asm.fit(table))
         return PipelineModel(models)

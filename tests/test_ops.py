@@ -329,3 +329,41 @@ class TestReviewRegressions:
         assert (tmp_path / "snap.npz").exists()
         CheckpointData(to_disk=True, path=p, remove_checkpoint=True).transform(t)
         assert (tmp_path / "snap.npz").exists()
+
+
+class TestLowCardinalityLevels:
+    """Low-cardinality single-token string columns one-hot as learned levels
+    instead of exploding into hash buckets (4096-wide histograms made GBDT
+    fits pathologically slow); free text and high-cardinality strings still
+    hash."""
+
+    def test_levels_vs_hash_selection(self):
+        t = Table({
+            "segment": ["a", "b", "c", "a"],            # -> 3 levels
+            "text": ["hello world", "x", "y", "z"],     # multi-token -> hash
+            "ids": [f"id{i}" for i in range(4)],        # 4 distinct, still levels
+        })
+        model = AssembleFeatures(number_of_features=16,
+                                 max_one_hot_cardinality=3).fit(t)
+        out = model.transform(t)
+        # segment: 3 level columns; text: 16 hash; ids: 4 distinct > 3 -> hash
+        assert out["features"].shape == (4, 3 + 16 + 16)
+        names = out.meta("features")["feature_names"]
+        assert "segment=a" in names and "segment=b" in names
+
+    def test_levels_roundtrip_and_unseen(self, tmp_path):
+        t = Table({"segment": ["a", "b", "a"]})
+        model = AssembleFeatures().fit(t)
+        save_stage(model, str(tmp_path / "lv"))
+        loaded = load_stage(str(tmp_path / "lv"))
+        t2 = Table({"segment": ["b", "zzz", None]})     # unseen + null -> zeros
+        f1 = model.transform(t2)["features"]
+        f2 = loaded.transform(t2)["features"]
+        np.testing.assert_array_equal(f1, f2)
+        np.testing.assert_array_equal(f1, [[0.0, 1.0], [0.0, 0.0], [0.0, 0.0]])
+
+    def test_opt_out(self):
+        t = Table({"segment": ["a", "b"]})
+        model = AssembleFeatures(number_of_features=8,
+                                 max_one_hot_cardinality=0).fit(t)
+        assert model.transform(t)["features"].shape == (2, 8)
