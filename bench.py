@@ -48,35 +48,47 @@ IMG_BATCH = 1024        # large batches amortize per-dispatch latency (tunnel)
 N_IMAGES = 8192         # CIFAR10-scale eval slice
 
 
-def _probe_backend(timeout_s: float = 180.0) -> str:
+def _probe_backend(timeout_s: float = 180.0, attempts: int = 3,
+                   retry_delay_s: float = 45.0) -> str:
     """Try real-device backend init in a subprocess; 'default' if it works,
-    'cpu' if it crashes, hangs, or reports no non-CPU device."""
+    'cpu' if it crashes, hangs, or reports no non-CPU device. Retries ride
+    out TRANSIENT device-tunnel outages (observed mid-session: the tunnel
+    dropped for a stretch and probes timed out) — only consistent failure
+    falls back to CPU."""
     if os.environ.get("MMLSPARK_TPU_BENCH_FORCE_CPU"):
         return "cpu"
     code = (
         "import jax; ds = jax.devices(); "
         "print('PLATFORM=' + ds[0].platform)"
     )
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c", code],
-            capture_output=True, text=True, timeout=timeout_s,
-        )
-    except subprocess.TimeoutExpired:
-        print("bench: device probe timed out; falling back to CPU",
-              file=sys.stderr)
-        return "cpu"
-    if out.returncode != 0:
-        tail = (out.stderr or "").strip().splitlines()[-1:]
-        print(f"bench: device probe failed ({tail}); falling back to CPU",
-              file=sys.stderr)
-        return "cpu"
-    platform = ""
-    for line in out.stdout.splitlines():
-        if line.startswith("PLATFORM="):
-            platform = line.split("=", 1)[1]
-    print(f"bench: probe ok, platform={platform!r}", file=sys.stderr)
-    return "default" if platform not in ("", "cpu") else "cpu"
+    for attempt in range(max(attempts, 1)):
+        if attempt:
+            time.sleep(retry_delay_s)
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True, text=True, timeout=timeout_s,
+            )
+        except subprocess.TimeoutExpired:
+            print(f"bench: device probe timed out "
+                  f"(attempt {attempt + 1}/{attempts})", file=sys.stderr)
+            continue
+        if out.returncode != 0:
+            tail = (out.stderr or "").strip().splitlines()[-1:]
+            print(f"bench: device probe failed ({tail}; "
+                  f"attempt {attempt + 1}/{attempts})", file=sys.stderr)
+            continue
+        platform = ""
+        for line in out.stdout.splitlines():
+            if line.startswith("PLATFORM="):
+                platform = line.split("=", 1)[1]
+        if platform not in ("", "cpu"):
+            print(f"bench: probe ok, platform={platform!r}", file=sys.stderr)
+            return "default"
+        print(f"bench: probe found only {platform!r}", file=sys.stderr)
+    print("bench: no real device after retries; falling back to CPU",
+          file=sys.stderr)
+    return "cpu"
 
 
 def make_dataset(n: int, f: int, seed: int = 7):
