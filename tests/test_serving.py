@@ -150,6 +150,39 @@ class TestServingFleet:
         assert [i["answered"] for i in infos] == [5, 5]
 
 
+class TestConcurrentLoad:
+    def test_parallel_clients_all_answered(self):
+        """8 client threads x 25 requests: every request answered correctly,
+        counters consistent under concurrency (the reference's serving
+        counters are part of its metrics surface,
+        DistributedHTTPSource.scala:98-107)."""
+        srv = ServingServer(_echo_handler, max_batch_size=16,
+                            max_latency_ms=2.0).start()
+        errors = []
+
+        def client(tid):
+            try:
+                for i in range(25):
+                    v = float(tid * 1000 + i)
+                    out = _post(srv.url, {"x": v})
+                    assert out == {"doubled": 2 * v}, out
+            except Exception as e:  # noqa: BLE001 — collected for the assert
+                errors.append((tid, repr(e)))
+
+        threads = [threading.Thread(target=client, args=(t,)) for t in range(8)]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            assert not errors, errors
+            assert srv.requests_seen == 200
+            assert srv.requests_answered == 200
+            assert srv.latency_stats()["n"] == 200
+        finally:
+            srv.stop()
+
+
 class TestServeModelLatency:
     def test_model_serving_latency(self):
         """End-to-end: a fitted GBDT behind serve_model answers warm requests
