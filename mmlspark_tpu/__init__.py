@@ -13,7 +13,8 @@ from . import core, parallel
 def __getattr__(name):
     # heavy subsystems import lazily so `import mmlspark_tpu` stays fast
     if name in ("nn", "image", "gbdt", "ops", "automl", "text",
-                "recommendation", "io_http", "utils"):
+                "recommendation", "io_http", "utils", "plot", "native",
+                "parallel", "core"):
         import importlib
 
         return importlib.import_module(f".{name}", __name__)
