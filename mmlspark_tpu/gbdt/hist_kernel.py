@@ -124,20 +124,66 @@ def _hist_kernel(num_features, num_bins, chunk, bins_ref, stats_ref, out_ref):
         out_ref[:, f * num_bins : (f + 1) * num_bins] += h
 
 
+def _hist_kernel_fused(num_features, num_bins, chunk, bins_ref, stats_ref, out_ref):
+    """Fused variant: ONE (chunk, F·B) one-hot mask in VMEM (bfloat16 — the
+    0/1 values are exact) and ONE dot per grid step, instead of F small dots.
+    Small matmuls leave the MXU idle between issues; the fused dot amortizes
+    that fixed cost over the whole F·B lane axis."""
+    import jax.experimental.pallas as pl
+
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    stats = stats_ref[:]                                        # (ch, C)
+    col = bins_ref[:]                                           # (ch, F)
+    iota = jax.lax.broadcasted_iota(
+        jnp.int32, (chunk, num_features, num_bins), 2
+    )
+    mask = (col[:, :, None] == iota).astype(jnp.bfloat16)
+    mask = mask.reshape(chunk, num_features * num_bins)         # VMEM-only
+    h = jax.lax.dot_general(
+        stats, mask, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )                                                           # (C, F·B)
+    out_ref[:] += h
+
+
+# Budget for the fused kernel's VMEM-resident mask (chunk × F·B bf16). VMEM
+# is ~16 MB less double-buffered inputs/outputs; 4 MB leaves ample room.
+_FUSED_MASK_VMEM_BYTES = 4 * 2**20
+
+
+def _fused_chunk(f: int, num_bins: int) -> int:
+    """Largest power-of-two chunk whose mask fits the VMEM budget."""
+    limit = _FUSED_MASK_VMEM_BYTES // (f * num_bins * 2)
+    chunk = 1 << max(int(limit).bit_length() - 1, 0)
+    return min(chunk, 2048)
+
+
 def _histogram_pallas(bins, stats, num_bins, interpret):
     import jax.experimental.pallas as pl
 
     n, f = bins.shape
     c = stats.shape[1]
-    chunk = min(_PALLAS_CHUNK, n)
+    # fused needs the lane axis (F·B) 128-aligned and a sublane-aligned chunk
+    fused_chunk = _fused_chunk(f, num_bins)
+    use_fused = (f * num_bins) % 128 == 0 and fused_chunk >= 32
+    # rows pad up to a whole chunk (zero stats land in bin 0 with weight 0),
+    # so tiny n still runs the tile-aligned chunk shape
+    chunk = fused_chunk if use_fused else min(_PALLAS_CHUNK, max(n, 8))
     pad = (-n) % chunk
     if pad:
         bins = jnp.concatenate([bins, jnp.zeros((pad, f), bins.dtype)])
         stats = jnp.concatenate([stats, jnp.zeros((pad, c), stats.dtype)])
     nc = (n + pad) // chunk
 
+    kernel = _hist_kernel_fused if use_fused else _hist_kernel
     out = pl.pallas_call(
-        functools.partial(_hist_kernel, f, num_bins, chunk),
+        functools.partial(kernel, f, num_bins, chunk),
         grid=(nc,),
         in_specs=[
             pl.BlockSpec((chunk, f), lambda i: (i, 0)),

@@ -27,6 +27,13 @@ from .ring_attention import (
     make_ulysses_attention,
 )
 from .tensor_parallel import column_parallel, row_parallel, make_tp_mlp
+from .pipeline_parallel import (
+    PIPE_AXIS,
+    make_pipe_mesh,
+    pipeline_apply,
+    pipeline_forward,
+)
+from .moe import EXPERT_AXIS, MoEParams, init_moe, moe_ffn_local, moe_ffn_sharded
 
 __all__ = [
     "DATA_AXIS",
@@ -51,4 +58,13 @@ __all__ = [
     "column_parallel",
     "row_parallel",
     "make_tp_mlp",
+    "PIPE_AXIS",
+    "make_pipe_mesh",
+    "pipeline_apply",
+    "pipeline_forward",
+    "EXPERT_AXIS",
+    "MoEParams",
+    "init_moe",
+    "moe_ffn_local",
+    "moe_ffn_sharded",
 ]

@@ -1,0 +1,115 @@
+"""Expert parallelism: a mixture-of-experts feed-forward layer with
+capacity-based top-1 routing and `all_to_all` dispatch over a mesh axis.
+
+Like pipeline parallelism, MoE is beyond the reference's capability set
+(SURVEY.md §2.2 lists EP as absent there) — it is part of the TPU build's
+first-class distributed story. The design is the canonical TPU SPMD one
+(Switch-Transformer-style): tokens are sharded over the SAME axis that
+shards experts, routing builds a fixed-capacity (tokens, experts, capacity)
+dispatch tensor (static shapes — XLA-friendly; overflow tokens drop, the
+standard capacity_factor trade), and two `lax.all_to_all` collectives move
+token slabs to their experts' devices and back over ICI.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["MoEParams", "init_moe", "moe_ffn_local", "moe_ffn_sharded"]
+
+EXPERT_AXIS = "expert"
+
+
+class MoEParams(NamedTuple):
+    w_gate: jnp.ndarray   # (d, E)
+    w1: jnp.ndarray       # (E, d, h)
+    b1: jnp.ndarray       # (E, h)
+    w2: jnp.ndarray       # (E, h, d)
+    b2: jnp.ndarray       # (E, d)
+
+
+def init_moe(rng, d: int, h: int, n_experts: int, dtype=jnp.float32) -> MoEParams:
+    k1, k2, k3 = jax.random.split(rng, 3)
+    s1, s2 = (2.0 / d) ** 0.5, (2.0 / h) ** 0.5
+    return MoEParams(
+        w_gate=jax.random.normal(k1, (d, n_experts), dtype) * s1,
+        w1=jax.random.normal(k2, (n_experts, d, h), dtype) * s1,
+        b1=jnp.zeros((n_experts, h), dtype),
+        w2=jax.random.normal(k3, (n_experts, h, d), dtype) * s2,
+        b2=jnp.zeros((n_experts, d), dtype),
+    )
+
+
+def _route(x, w_gate, n_experts: int, capacity: int):
+    """Top-1 routing -> (dispatch (T,E,C) 0/1, combine (T,E,C) gate-weighted).
+
+    Position of a token within its expert's capacity is its rank among
+    same-expert tokens (cumsum of the one-hot); ranks >= capacity drop.
+    """
+    scores = jax.nn.softmax(x @ w_gate, axis=-1)            # (T, E)
+    expert = jnp.argmax(scores, axis=-1)                    # (T,)
+    onehot = jax.nn.one_hot(expert, n_experts, dtype=x.dtype)   # (T, E)
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0         # rank within expert
+    keep = (pos >= 0) & (pos < capacity)
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=x.dtype)
+    dispatch = onehot[:, :, None] * pos_oh * keep.astype(x.dtype)[:, :, None]
+    gate = jnp.sum(scores * onehot, axis=-1)                # (T,) top-1 prob
+    combine = dispatch * gate[:, None, None]
+    return dispatch, combine
+
+
+def _expert_ffn(params: MoEParams, slabs):
+    """slabs: (E_local, C, d) -> (E_local, C, d); params hold LOCAL experts."""
+    hid = jax.nn.gelu(
+        jnp.einsum("ecd,edh->ech", slabs, params.w1) + params.b1[:, None, :]
+    )
+    return jnp.einsum("ech,ehd->ecd", hid, params.w2) + params.b2[:, None, :]
+
+
+def moe_ffn_local(params: MoEParams, x, capacity_factor: float = 1.25):
+    """Single-device reference: full expert set, no collectives."""
+    t, _ = x.shape
+    e = params.w_gate.shape[1]
+    cap = max(int(capacity_factor * t / e), 1)
+    dispatch, combine = _route(x, params.w_gate, e, cap)
+    slabs = jnp.einsum("tec,td->ecd", dispatch, x)          # (E, C, d)
+    out = _expert_ffn(params, slabs)
+    return jnp.einsum("tec,ecd->td", combine, out)
+
+
+def moe_ffn_sharded(params: MoEParams, x, axis_name: str = EXPERT_AXIS,
+                    capacity_factor: float = 1.25):
+    """SPMD body (call inside shard_map over `axis_name`).
+
+    x: (T_local, d) — this shard's tokens. params: LOCAL slice — w1/b1/w2/b2
+    leading dim E_local = E / axis_size; w_gate REPLICATED (scores need all
+    experts). Routing is computed on local tokens against all E experts;
+    `all_to_all` #1 regroups the (E, C, d) dispatch slabs so each device
+    holds its E_local experts' tokens from EVERY shard; `all_to_all` #2
+    sends expert outputs back to the owning token shards.
+    """
+    n_shards = lax.axis_size(axis_name)
+    t_local, d = x.shape
+    e_local = params.w1.shape[0]
+    e = e_local * n_shards
+    cap = max(int(capacity_factor * t_local / e), 1)
+
+    dispatch, combine = _route(x, params.w_gate, e, cap)    # (T_l, E, C)
+    slabs = jnp.einsum("tec,td->ecd", dispatch, x)          # (E, C, d)
+    # regroup: split the E dim across shards, concat the shard dim -> each
+    # device ends with (E_local * n_shards slabs) = its experts' tokens from
+    # every shard, stacked on the capacity-ish axis
+    slabs = slabs.reshape(n_shards, e_local, cap, d)
+    inbound = lax.all_to_all(slabs, axis_name, split_axis=0, concat_axis=0,
+                             tiled=False)                   # (S, E_l, C, d)
+    inbound = inbound.transpose(1, 0, 2, 3).reshape(e_local, n_shards * cap, d)
+    out = _expert_ffn(params, inbound)                      # (E_l, S*C, d)
+    out = out.reshape(e_local, n_shards, cap, d).transpose(1, 0, 2, 3)
+    outbound = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
+                              tiled=False)                  # (S, E_l, C, d)
+    outbound = outbound.reshape(e, cap, d)
+    return jnp.einsum("tec,ecd->td", combine, outbound)

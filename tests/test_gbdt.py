@@ -472,6 +472,27 @@ class TestHistKernel:
             np.add.at(ref[j], bn[:, j], st)
         np.testing.assert_allclose(hx, ref, rtol=1e-4, atol=1e-4)
 
+    def test_fused_variant_agrees(self):
+        # F*B 128-aligned -> the FUSED single-dot pallas kernel (the variant
+        # auto-selected on the real TPU workload, F=14 B=256) must be the one
+        # under test, not the per-feature fallback
+        from mmlspark_tpu.gbdt import hist_kernel as hk
+
+        rng = np.random.default_rng(1)
+        n, f, b, c = 700, 4, 32, 3            # F*B = 128
+        assert (f * b) % 128 == 0 and hk._fused_chunk(f, b) >= 32
+        bins = jnp.asarray(rng.integers(0, b, size=(n, f)), jnp.int32)
+        stats = jnp.asarray(rng.normal(size=(n, c)), jnp.float32)
+        hx = np.asarray(hk.histogram_xla(bins, stats, b))
+        hp = np.asarray(hk.histogram_pallas_interpret(bins, stats, b))
+        np.testing.assert_allclose(hx, hp, rtol=1e-5, atol=1e-5)
+        # and at the bench shape's bin count (B=256, chunk budget kicks in)
+        f2, b2 = 14, 256
+        bins2 = jnp.asarray(rng.integers(0, b2, size=(n, f2)), jnp.int32)
+        hx2 = np.asarray(hk.histogram_xla(bins2, stats, b2))
+        hp2 = np.asarray(hk.histogram_pallas_interpret(bins2, stats, b2))
+        np.testing.assert_allclose(hx2, hp2, rtol=1e-5, atol=1e-5)
+
     def test_registry_resolution(self):
         from mmlspark_tpu.core import kernels
 

@@ -82,6 +82,43 @@ class TestDeepModelTransformer:
             rtol=1e-5,
         )
 
+    def test_fused_dispatch_matches_per_batch_loop(self):
+        # the single-dispatch scan path must equal the batch-by-batch path,
+        # including tail padding and intermediate-layer fetches (the layer
+        # path exercises capture_intermediates inside the fused lax.scan)
+        b = ModelBundle.init("mlp", (12,), num_outputs=3)
+        x = np.random.default_rng(5).normal(size=(53, 12)).astype(np.float32)
+        tbl = Table({"features": x})
+        layer = b.layer_names()[0]
+        fetch = {"out": "logits", "prob": "probability", "feat": layer}
+        fused = DeepModelTransformer(
+            input_col="features", mini_batch_size=8, fetch_dict=fetch
+        ).set_model(b).transform(tbl)
+        looped = DeepModelTransformer(
+            input_col="features", mini_batch_size=8, fetch_dict=fetch,
+            fused_dispatch=False,
+        ).set_model(b).transform(tbl)
+        for c in fetch:
+            np.testing.assert_allclose(
+                np.asarray(fused[c]), np.asarray(looped[c]), rtol=1e-5
+            )
+
+    def test_fused_dispatch_budget_falls_back(self):
+        # over-budget tables must stream batch-by-batch (and still be right)
+        b = ModelBundle.init("mlp", (12,), num_outputs=2)
+        x = np.random.default_rng(6).normal(size=(40, 12)).astype(np.float32)
+        tbl = Table({"features": x})
+        t = DeepModelTransformer(
+            input_col="features", mini_batch_size=8, fused_dispatch_budget_mb=0
+        ).set_model(b)
+        ref = DeepModelTransformer(
+            input_col="features", mini_batch_size=8, fused_dispatch=False
+        ).set_model(b)
+        np.testing.assert_allclose(
+            np.asarray(t.transform(tbl)["output"]),
+            np.asarray(ref.transform(tbl)["output"]), rtol=1e-5,
+        )
+
     def test_probability_fetch(self):
         b = ModelBundle.init("mlp", (6,), num_outputs=4)
         t = DeepModelTransformer(
