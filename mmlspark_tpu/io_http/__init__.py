@@ -35,6 +35,7 @@ from .cognitive import (
     NER,
     OCR,
     RecognizeText,
+    RecognizeDomainSpecificContent,
     GenerateThumbnails,
     TagImage,
     DescribeImage,
@@ -75,6 +76,7 @@ __all__ = [
     "NER",
     "OCR",
     "RecognizeText",
+    "RecognizeDomainSpecificContent",
     "GenerateThumbnails",
     "TagImage",
     "DescribeImage",

@@ -309,6 +309,25 @@ class GenerateThumbnails(_VisionBase):
 
 
 @register_stage
+class RecognizeDomainSpecificContent(_VisionBase):
+    """Domain-model analysis — celebrities/landmarks (reference: DSIR,
+    RecognizeDomainSpecificContent, ComputerVision.scala:362-378). The
+    domain model is a URL path segment; output: the `result` payload."""
+
+    model = Param("celebrities", "domain model (celebrities | landmarks)",
+                  ptype=str)
+
+    def _row_request(self, row_vals, i):
+        url = f"{self.get('url').rstrip('/')}/models/{self.get('model')}/analyze"
+        return HTTPRequestData.from_json(
+            url, self._row_body(row_vals, i), headers=self._headers()
+        )
+
+    def _parse(self, resp):
+        return (resp.json() or {}).get("result")
+
+
+@register_stage
 class TagImage(_VisionBase):
     """Image tagging (ComputerVision.scala:380-420). Output: `tags` list."""
 

@@ -165,10 +165,22 @@ class DeepModelTransformer(Model):
         pad = (-n) % bs
         if pad:
             x = np.concatenate([x, np.repeat(x[-1:], pad, axis=0)])
-        fused = (
-            bool(self.get("fused_dispatch"))
-            and x.nbytes <= int(self.get("fused_dispatch_budget_mb")) * 2**20
-        )
+        fused = bool(self.get("fused_dispatch"))
+        if fused:
+            # the fused scan holds the inputs AND every fetched output for
+            # the WHOLE table on device at once — a narrow input with a wide
+            # intermediate fetch can dwarf x.nbytes, so budget both sides
+            # (shapes only: eval_shape runs no compute)
+            out_abs = jax.eval_shape(
+                self._forward_fn(fetches),
+                self.bundle.variables,
+                jax.ShapeDtypeStruct((bs, *x.shape[1:]), x.dtype),
+            )
+            per_batch = sum(
+                int(np.prod(o.shape)) * o.dtype.itemsize for o in out_abs
+            )
+            total = x.nbytes + per_batch * (len(x) // bs)
+            fused = total <= int(self.get("fused_dispatch_budget_mb")) * 2**20
 
         if self._apply_cache is None:
             self._apply_cache = {}

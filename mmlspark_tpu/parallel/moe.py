@@ -52,10 +52,13 @@ def _route(x, w_gate, n_experts: int, capacity: int):
     """
     scores = jax.nn.softmax(x @ w_gate, axis=-1)            # (T, E)
     expert = jnp.argmax(scores, axis=-1)                    # (T,)
-    onehot = jax.nn.one_hot(expert, n_experts, dtype=x.dtype)   # (T, E)
-    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0         # rank within expert
+    # ranks in int32, NOT x.dtype: a bf16 cumsum cannot represent counts
+    # past 256, which would silently merge two tokens into one capacity slot
+    onehot_i = jax.nn.one_hot(expert, n_experts, dtype=jnp.int32)   # (T, E)
+    pos = jnp.cumsum(onehot_i, axis=0) * onehot_i - 1       # rank within expert
     keep = (pos >= 0) & (pos < capacity)
-    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=x.dtype)
+    onehot = onehot_i.astype(x.dtype)
+    pos_oh = jax.nn.one_hot(pos, capacity, dtype=x.dtype)
     dispatch = onehot[:, :, None] * pos_oh * keep.astype(x.dtype)[:, :, None]
     gate = jnp.sum(scores * onehot, axis=-1)                # (T,) top-1 prob
     combine = dispatch * gate[:, None, None]

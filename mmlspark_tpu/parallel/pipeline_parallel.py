@@ -123,11 +123,13 @@ def pipeline_forward(stage_fn, params_stacked, x, n_micro: int,
     return out.reshape(b, *out.shape[2:])
 
 
-@functools.lru_cache(maxsize=64)
+@functools.lru_cache(maxsize=8)
 def _compiled_pipeline(stage_fn, mesh: Mesh, n_stages: int):
-    """Cache the jitted shard_map per (stage_fn, mesh) so repeated
+    """Cache the jitted shard_map per (stage_fn identity, mesh) so repeated
     pipeline_forward calls hit jax.jit's own shape cache instead of
-    retracing a fresh closure every time."""
+    retracing a fresh closure every time. Identity keying means stage_fn
+    should be a STABLE function (module-level, not a per-call lambda) for
+    the cache to help — per-call closures retrace, they are never wrong."""
     body = pipeline_apply(stage_fn, n_stages)
 
     def run(params, xm):

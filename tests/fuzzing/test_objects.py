@@ -616,6 +616,7 @@ def _io_http_objects(ctx) -> dict[str, list[TestObject]]:
         CustomInputParser,
         CustomOutputParser,
         DescribeImage,
+        RecognizeDomainSpecificContent,
         DetectFace,
         EntityDetector,
         FindSimilarFace,
@@ -725,6 +726,8 @@ def _io_http_objects(ctx) -> dict[str, list[TestObject]]:
         "mmlspark_tpu.io_http.cognitive.DetectFace": [_make_vision(DetectFace)],
         "mmlspark_tpu.io_http.cognitive.TagImage": [_make_vision(TagImage)],
         "mmlspark_tpu.io_http.cognitive.DescribeImage": [_make_vision(DescribeImage)],
+        "mmlspark_tpu.io_http.cognitive.RecognizeDomainSpecificContent": [
+            _make_vision(RecognizeDomainSpecificContent, model="landmarks")],
         "mmlspark_tpu.io_http.cognitive.GenerateThumbnails": [_make_vision(GenerateThumbnails)],
         "mmlspark_tpu.io_http.cognitive.RecognizeText": [_recognize_text_to(ctx)],
         "mmlspark_tpu.io_http.cognitive.FindSimilarFace": [_face_to(

@@ -115,6 +115,12 @@ def cog_server():
                 }})
             if path.startswith("/vision/analyze"):
                 return self._json({"categories": [{"name": "abstract_"}]})
+            m = re.match(r"/vision/models/(\w+)/analyze", path)
+            if m:
+                state["calls"][-1]["model"] = m.group(1)
+                return self._json({"result": {
+                    m.group(1): [{"name": "Fake Celebrity", "confidence": 0.95}]
+                }})
             if path.startswith("/face/detect"):
                 return self._json([{"faceId": "f-1"}])
             if path.startswith("/face/findsimilars"):
@@ -262,6 +268,19 @@ class TestVisionStagesOverSocket:
         stage.set(image_url="http://x/a.png")
         out = stage.transform(Table({"dummy": [1.0]}))
         assert out["o"][0]["categories"][0]["name"] == "abstract_"
+
+    def test_domain_specific_content(self, cog_server):
+        from mmlspark_tpu.io_http import RecognizeDomainSpecificContent
+
+        url, state = cog_server
+        stage = RecognizeDomainSpecificContent(
+            url=url + "/vision", model="celebrities", output_col="o"
+        )
+        stage.set(image_url="http://x/a.png")
+        out = stage.transform(Table({"dummy": [1.0]}))
+        assert out["o"][0]["celebrities"][0]["name"] == "Fake Celebrity"
+        sent = [c for c in state["calls"] if c.get("model")][-1]
+        assert sent["model"] == "celebrities"
 
 
 class TestFaceSuiteOverSocket:

@@ -131,6 +131,27 @@ class TestExpertParallel:
         dropped = np.all(arr == 0.0, axis=1)
         assert dropped.sum() >= 1
 
+    def test_bf16_routing_ranks_exact_past_256(self, rng):
+        # regression: capacity ranks must be int32 — a bf16 cumsum cannot
+        # count past 256, silently merging two tokens into one slot
+        d, h, e, t = 4, 8, 2, 600
+        params = init_moe(jax.random.PRNGKey(3), d, h, e)
+        # steer everything to expert 0 so one expert sees >256 tokens
+        params = params._replace(
+            w_gate=jnp.zeros_like(params.w_gate).at[:, 0].set(1.0)
+        )
+        xf = rng.normal(size=(t, d)).astype(np.float32)
+        out32 = np.asarray(moe_ffn_local(params, jnp.asarray(xf),
+                                         capacity_factor=float(e)))
+        p16 = jax.tree.map(lambda a: a.astype(jnp.bfloat16), params)
+        out16 = np.asarray(moe_ffn_local(
+            p16, jnp.asarray(xf, jnp.bfloat16), capacity_factor=float(e)
+        )).astype(np.float32)
+        # bf16 arithmetic is coarse but every token must keep ITS OWN
+        # expert output; slot merging produces O(1) errors and zero rows
+        assert not np.any(np.all(out16 == 0.0, axis=1))
+        np.testing.assert_allclose(out16, out32, rtol=0.15, atol=0.05)
+
     def test_gradients_flow(self, rng):
         d, h, e, t = 4, 8, 4, 16
         params = init_moe(jax.random.PRNGKey(2), d, h, e)
