@@ -71,10 +71,12 @@ class DeepModelTransformer(Model):
 
     bundle: ModelBundle | None = None
     _apply_cache: dict | None = None
+    _outbytes_cache: dict | None = None
 
     def set_model(self, bundle: ModelBundle) -> "DeepModelTransformer":
         self.bundle = bundle
         self._apply_cache = {}
+        self._outbytes_cache = {}
         return self
 
     # ------------------------------------------------------------------ #
@@ -169,16 +171,23 @@ class DeepModelTransformer(Model):
         if fused:
             # the fused scan holds the inputs AND every fetched output for
             # the WHOLE table on device at once — a narrow input with a wide
-            # intermediate fetch can dwarf x.nbytes, so budget both sides
-            # (shapes only: eval_shape runs no compute)
-            out_abs = jax.eval_shape(
-                self._forward_fn(fetches),
-                self.bundle.variables,
-                jax.ShapeDtypeStruct((bs, *x.shape[1:]), x.dtype),
-            )
-            per_batch = sum(
-                int(np.prod(o.shape)) * o.dtype.itemsize for o in out_abs
-            )
+            # intermediate fetch can dwarf x.nbytes, so budget both sides.
+            # The per-batch output size is an eval_shape (abstract trace);
+            # cache it so per-request transforms (serving) don't re-trace
+            # the model just to size its outputs.
+            if self._outbytes_cache is None:
+                self._outbytes_cache = {}
+            okey = (fetches, bs, x.shape[1:], str(x.dtype), id(self.bundle))
+            if okey not in self._outbytes_cache:
+                out_abs = jax.eval_shape(
+                    self._forward_fn(fetches),
+                    self.bundle.variables,
+                    jax.ShapeDtypeStruct((bs, *x.shape[1:]), x.dtype),
+                )
+                self._outbytes_cache[okey] = sum(
+                    int(np.prod(o.shape)) * o.dtype.itemsize for o in out_abs
+                )
+            per_batch = self._outbytes_cache[okey]
             total = x.nbytes + per_batch * (len(x) // bs)
             fused = total <= int(self.get("fused_dispatch_budget_mb")) * 2**20
 

@@ -63,6 +63,14 @@ class DNNLearner(HasFeaturesCol, HasLabelCol, Estimator):
 
     # optional: transfer learning — freeze all but these param path prefixes
     trainable_prefixes = Param(None, "list of param path prefixes to train (None=all)")
+    # One dispatch per EPOCH (jitted lax.scan over minibatches on
+    # device-resident data) instead of one per step — per-dispatch latency
+    # dominates small-table training when the device is remote. Gated by a
+    # memory budget; over-budget tables stream batch-by-batch.
+    fused_epochs = Param(True, "scan a whole epoch in one dispatch", ptype=bool)
+    fused_epoch_budget_mb = Param(
+        512, "max table MB resident on device for the fused epoch path", ptype=int
+    )
 
     init_bundle: ModelBundle | None = None  # programmatic warm start
 
@@ -142,21 +150,72 @@ class DNNLearner(HasFeaturesCol, HasLabelCol, Estimator):
             params, batch_stats, opt_state
         )
 
+        steps = (n - bs) // bs + 1 if n >= bs else 0
+        fused = (
+            bool(self.get("fused_epochs"))
+            and steps > 1
+            and x.nbytes + y.nbytes
+            <= int(self.get("fused_epoch_budget_mb")) * 2**20
+        )
+        epoch_fn = None
+        if fused:
+            # whole table resident on device (replicated under a mesh so the
+            # per-step gather by shuffled global index stays local); batches
+            # re-shard onto the data axis inside the scan
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                repl = NamedSharding(mesh, P())
+                xd = jax.device_put(x, repl)
+                yd = jax.device_put(y, repl)
+                data_spec = NamedSharding(mesh, P(DATA_AXIS))
+            else:
+                xd, yd = jnp.asarray(x), jnp.asarray(y)
+                data_spec = None
+
+            def epoch_body(carry, idx):
+                p, bst, os_ = carry
+                bx, by = xd[idx], yd[idx]
+                if data_spec is not None:
+                    bx = jax.lax.with_sharding_constraint(bx, data_spec)
+                    by = jax.lax.with_sharding_constraint(by, data_spec)
+                p, bst, os_, loss = train_step(p, bst, os_, bx, by)
+                return (p, bst, os_), loss
+
+            def run_epoch(params, batch_stats, opt_state, order):
+                (p, bst, os_), losses = jax.lax.scan(
+                    epoch_body, (params, batch_stats, opt_state), order
+                )
+                return p, bst, os_, losses.mean()
+
+            epoch_fn = jax.jit(run_epoch, donate_argnums=(0, 1, 2))
+
         log = self._log()
         for epoch in range(start_epoch, int(self.get("epochs"))):
             order = rng.permutation(n)
             # drop the ragged tail (shuffled: all rows seen across epochs);
             # XLA compiles one batch shape
-            losses = []
-            for i in range(0, n - bs + 1, bs):
-                idx = order[i : i + bs]
-                params, batch_stats, opt_state, loss = step(
-                    params, batch_stats, opt_state,
-                    jnp.asarray(x[idx]), jnp.asarray(y[idx]),
+            if fused:
+                idx = jnp.asarray(
+                    order[: steps * bs].reshape(steps, bs), jnp.int32
                 )
-                losses.append(loss)
+                params, batch_stats, opt_state, mean_loss = epoch_fn(
+                    params, batch_stats, opt_state, idx
+                )
+                mean_loss = float(mean_loss)
+            else:
+                losses = []
+                for i in range(0, n - bs + 1, bs):
+                    idx = order[i : i + bs]
+                    params, batch_stats, opt_state, loss = step(
+                        params, batch_stats, opt_state,
+                        jnp.asarray(x[idx]), jnp.asarray(y[idx]),
+                    )
+                    losses.append(loss)
+                mean_loss = (
+                    float(jnp.mean(jnp.stack(losses))) if losses else float("nan")
+                )
             if log:
-                mean_loss = float(jnp.mean(jnp.stack(losses))) if losses else float("nan")
                 log(f"epoch {epoch + 1}/{self.get('epochs')}: loss={mean_loss:.4f}")
             self._maybe_checkpoint(epoch, params, batch_stats, opt_state)
 
@@ -178,8 +237,12 @@ class DNNLearner(HasFeaturesCol, HasLabelCol, Estimator):
         if self.init_bundle is not None:
             import dataclasses
 
-            # copy: fit must not overwrite the caller's bundle variables
-            return dataclasses.replace(self.init_bundle)
+            # DEEP copy of the variable arrays: the train step donates its
+            # param buffers, and a shallow copy would let that donation
+            # delete the caller's bundle arrays ("Array has been deleted"
+            # on any later use of the warm-start bundle)
+            fresh = jax.tree.map(jnp.array, self.init_bundle.variables)
+            return dataclasses.replace(self.init_bundle, variables=fresh)
         if path:
             return ModelBundle.load(path)
         cfg = dict(self.get("model_config"))

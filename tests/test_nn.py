@@ -201,6 +201,25 @@ class TestDNNLearner:
             set_default_mesh(None)
         assert (out["prediction"] == tbl["label"]).mean() > 0.85
 
+    def test_fused_epochs_match_per_step_loop(self):
+        # one-dispatch-per-epoch scan must train identically to the
+        # batch-by-batch loop (same shuffle seed -> same batch sequence)
+        rng = np.random.default_rng(9)
+        x = rng.normal(size=(96, 10)).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.float64)
+        tbl = Table({"features": x, "label": y})
+
+        def fit(fused):
+            from mmlspark_tpu.nn.trainer import DNNLearner
+
+            m = DNNLearner(
+                architecture="mlp", epochs=2, batch_size=32, seed=3,
+                use_mesh=False, bfloat16=False, fused_epochs=fused,
+            ).fit(tbl)
+            return np.asarray(m.transform(tbl)["probability"])
+
+        np.testing.assert_allclose(fit(True), fit(False), rtol=1e-4, atol=1e-5)
+
     def test_checkpoint_resume(self, tmp_path):
         tbl = vector_table(n=256)
         ck = str(tmp_path / "ckpts")

@@ -11,7 +11,6 @@ Usage: python tools/sweep_hist.py            # real device
 
 from __future__ import annotations
 
-import functools
 import time
 
 import jax
@@ -122,7 +121,6 @@ def main():
     from mmlspark_tpu.gbdt.hist_kernel import histogram_xla
 
     ref = None
-    results = {}
     variants = [
         ("xla one-hot scan (fallback)",
          lambda b, s, nb: histogram_xla(b, s, nb)),
@@ -140,7 +138,7 @@ def main():
             if ref is None:
                 ref = h
             err = float(np.abs(h - ref).max())
-            results[name] = run(name, fn, bins, stats)
+            run(name, fn, bins, stats)
             if err > 1e-3:
                 print(f"    WARNING {name}: max abs err vs xla = {err:.2e}")
         except Exception as e:  # noqa: BLE001
