@@ -1,0 +1,240 @@
+"""Tabular ingestion/egress for `Table` — csv, parquet, pandas.
+
+The reference reads its benchmark datasets through Spark's JVM readers
+(`spark.read.csv` in every sample notebook; `DatasetUtils`,
+core/test/benchmarks/.../Benchmarks.scala:114-125). Here ingestion is
+framework-native:
+
+- `read_csv`: a multithreaded C++ cell parser (native/kernels.cpp
+  `mmlspark_csv_parse`) does the numeric heavy lifting; columns where any
+  cell fails numeric parse come back as string columns. Quoted files route
+  to the csv-module slow path (full quoting semantics, correctness first).
+  Pure-Python fallback when no toolchain is available.
+- `read_parquet`/`write_parquet`: pyarrow, gated (clear error if absent).
+- `from_pandas`/`to_pandas`: direct column interop.
+
+Paths go through `utils.storage`, so file:// and remote schemes work
+anywhere a local path does.
+"""
+
+from __future__ import annotations
+
+import io as _io
+from typing import Sequence
+
+import numpy as np
+
+from ..utils import storage
+from .schema import Table
+
+__all__ = [
+    "read_csv",
+    "write_csv",
+    "read_parquet",
+    "write_parquet",
+    "from_pandas",
+    "to_pandas",
+]
+
+
+def read_csv(
+    path: str,
+    header: bool = True,
+    delimiter: str = ",",
+    column_names: Sequence[str] | None = None,
+    encoding: str = "utf-8",
+) -> Table:
+    """Read a CSV file into a Table (numeric columns as float64 arrays,
+    text columns as python-string lists)."""
+    data = storage.read_bytes(path)
+    return _parse_csv_bytes(data, header, delimiter, column_names, encoding)
+
+
+_FAST_PATH_ENCODINGS = {"utf-8", "ascii", "iso8859-1", "cp1252"}
+
+
+def _parse_csv_bytes(data, header, delimiter, column_names, encoding) -> Table:
+    import codecs
+
+    if len(delimiter) != 1:
+        raise ValueError(f"delimiter must be one character, got {delimiter!r}")
+    if not data.strip():
+        return Table({})
+    enc_name = codecs.lookup(encoding).name
+    if b'"' in data or enc_name not in _FAST_PATH_ENCODINGS:
+        # quoted cells (embedded delimiters/newlines) or a non-ASCII-
+        # compatible encoding (utf-16 etc, where byte-level newline
+        # indexing is wrong): full csv-module semantics
+        return _read_csv_slow(data, header, delimiter, column_names, encoding)
+
+    if not data.endswith(b"\n"):
+        data += b"\n"
+    buf = np.frombuffer(data, np.uint8)
+    row_starts = np.flatnonzero(buf == ord("\n")) + 1
+    offsets = np.concatenate([[0], row_starts]).astype(np.int64)
+    # drop blank rows anywhere: bare "\n" (len 1) and bare "\r\n" (len 2)
+    lens = np.diff(offsets)
+    blank = (lens == 1) | ((lens == 2) & (buf[offsets[:-1]] == ord("\r")))
+    offsets = np.concatenate([offsets[:-1][~blank], offsets[-1:]])
+
+    first_line = data[offsets[0]:offsets[1]].decode(encoding).rstrip("\r\n")
+    cols_in_file = first_line.split(delimiter)
+    n_cols = len(cols_in_file)
+    if header:
+        names = column_names or [c.strip() for c in cols_in_file]
+        offsets = offsets[1:]
+    else:
+        names = list(column_names or [f"c{i}" for i in range(n_cols)])
+    if len(names) != n_cols:
+        raise ValueError(f"{len(names)} names for {n_cols} columns")
+    n_rows = len(offsets) - 1
+    if n_rows <= 0:
+        return Table({n: np.asarray([], np.float64) for n in names})
+
+    from .. import native
+
+    parsed = native.csv_parse(data, offsets, n_cols, delimiter)
+    if parsed is None:
+        return _read_csv_slow(data, header, delimiter, column_names, encoding)
+    values, ok = parsed
+
+    cols: dict[str, object] = {}
+    text_cols = [j for j in range(n_cols) if not ok[:, j].all()]
+    text_data: dict[int, list[str]] = {j: [] for j in text_cols}
+    if text_cols:
+        # decode only the columns that failed numeric parse, slicing by the
+        # SAME row offsets the C parser used (splitlines would desync on
+        # interior blank rows, which the offsets filter dropped)
+        for i in range(n_rows):
+            line = data[offsets[i]:offsets[i + 1]].decode(encoding)
+            parts = line.rstrip("\r\n").split(delimiter)
+            for j in text_cols:
+                cell = parts[j].strip() if j < len(parts) else ""
+                text_data[j].append(cell)
+    for j, name in enumerate(names):
+        cols[name] = text_data[j] if j in text_cols else values[:, j]
+    return Table(cols)
+
+
+def _read_csv_slow(data, header, delimiter, column_names, encoding) -> Table:
+    """csv-module path: full quoting semantics / no-toolchain fallback."""
+    import csv
+
+    rows = list(csv.reader(_io.StringIO(data.decode(encoding)),
+                           delimiter=delimiter))
+    rows = [r for r in rows if r]
+    if not rows:
+        return Table({})
+    if header:
+        names = column_names or [c.strip() for c in rows[0]]
+        rows = rows[1:]
+    else:
+        names = list(column_names or [f"c{i}" for i in range(len(rows[0]))])
+    cols: dict[str, object] = {}
+    for j, name in enumerate(names):
+        raw = [(r[j].strip() if j < len(r) else "") for r in rows]
+        numeric: list[float] = []
+        is_num = True
+        for cell in raw:
+            if cell == "":
+                numeric.append(float("nan"))
+                continue
+            try:
+                numeric.append(float(cell))
+            except ValueError:
+                is_num = False
+                break
+        cols[name] = np.asarray(numeric, np.float64) if is_num else raw
+    return Table(cols)
+
+
+def write_csv(table: Table, path: str, delimiter: str = ",",
+              header: bool = True, encoding: str = "utf-8") -> None:
+    import csv
+
+    buf = _io.StringIO()
+    w = csv.writer(buf, delimiter=delimiter, lineterminator="\n")
+    names = table.columns
+    if header:
+        w.writerow(names)
+    cols = [table[n] for n in names]
+    for i in range(len(table)):
+        w.writerow([c[i] for c in cols])
+    storage.write_bytes(path, buf.getvalue().encode(encoding))
+
+
+def _require_pyarrow():
+    try:
+        import pyarrow  # noqa: F401
+        import pyarrow.parquet  # noqa: F401
+
+        return pyarrow
+    except ImportError as e:  # pragma: no cover - environment-dependent
+        raise ImportError(
+            "parquet support needs pyarrow; install it or use read_csv"
+        ) from e
+
+
+def read_parquet(path: str) -> Table:
+    pa = _require_pyarrow()
+    import pyarrow.parquet as pq
+
+    with storage.open_read(path) as fh:
+        tbl = pq.read_table(pa.BufferReader(fh.read()))
+    import pyarrow.types as pat
+
+    cols: dict[str, object] = {}
+    for name in tbl.column_names:
+        ca = tbl[name].combine_chunks()
+        t = ca.type
+        if pat.is_floating(t):
+            cols[name] = ca.to_numpy(zero_copy_only=False)
+        elif pat.is_integer(t) or pat.is_boolean(t):
+            if ca.null_count:
+                # nullable ints have no numpy dtype: floats + NaN (documented
+                # lossy past 2^53); null-free ints keep their exact dtype
+                cols[name] = ca.cast(pa.float64()).to_numpy(
+                    zero_copy_only=False)
+            else:
+                cols[name] = ca.to_numpy(zero_copy_only=False)
+        else:
+            cols[name] = ca.to_pylist()
+    return Table(cols)
+
+
+def write_parquet(table: Table, path: str) -> None:
+    pa = _require_pyarrow()
+    import pyarrow.parquet as pq
+
+    arrays, names = [], []
+    for name in table.columns:
+        col = table[name]
+        names.append(name)
+        if isinstance(col, np.ndarray):
+            arrays.append(pa.array(col))
+        else:
+            arrays.append(pa.array(list(col)))
+    sink = pa.BufferOutputStream()
+    pq.write_table(pa.table(dict(zip(names, arrays))), sink)
+    storage.write_bytes(path, sink.getvalue().to_pybytes())
+
+
+def from_pandas(df) -> Table:
+    """pandas.DataFrame -> Table (float columns as float64 arrays, the rest
+    as python lists)."""
+    cols: dict[str, object] = {}
+    for name in df.columns:
+        s = df[name]
+        if s.dtype.kind in "fiub":
+            cols[str(name)] = s.to_numpy(np.float64, na_value=np.nan) \
+                if s.dtype.kind == "f" else s.to_numpy()
+        else:
+            cols[str(name)] = s.tolist()
+    return Table(cols)
+
+
+def to_pandas(table: Table):
+    import pandas as pd
+
+    return pd.DataFrame({n: np.asarray(table[n]) if isinstance(table[n], np.ndarray)
+                         else list(table[n]) for n in table.columns})

@@ -18,7 +18,7 @@ import threading
 
 import numpy as np
 
-__all__ = ["available", "get_lib", "bin_numeric", "predict_trees"]
+__all__ = ["available", "get_lib", "bin_numeric", "predict_trees", "csv_parse"]
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "kernels.cpp")
@@ -51,7 +51,8 @@ def _compile() -> str | None:
         os.close(fd)
     except OSError:
         return None  # read-only install dir, missing kernels.cpp, ...
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", tmp]
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           _SRC, "-o", tmp]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
         if proc.returncode != 0:
@@ -92,6 +93,11 @@ def get_lib() -> "ctypes.CDLL | None":
             ctypes.c_int32, ctypes.c_int32, ctypes.c_float, _F32,
         ]
         lib.mmlspark_predict_trees.restype = None
+        lib.mmlspark_csv_parse.argtypes = [
+            ctypes.c_char_p, np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            _I64, _I64, ctypes.c_char, _F64, _U8, ctypes.c_int32,
+        ]
+        lib.mmlspark_csv_parse.restype = None
         _LIB = lib
         return lib
 
@@ -116,6 +122,28 @@ def bin_numeric(x: np.ndarray, upper_bounds: np.ndarray, num_bins: np.ndarray,
         out,
     )
     return True
+
+
+def csv_parse(data: bytes, offsets: np.ndarray, n_cols: int,
+              delimiter: str = ",", n_threads: int = 0
+              ) -> "tuple[np.ndarray, np.ndarray] | None":
+    """Parse pre-indexed CSV rows into a (rows, cols) float64 matrix plus a
+    per-cell numeric-ok bitmap; None when the native lib is unavailable.
+    n_threads=0 picks the host's CPU count."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    offs = np.ascontiguousarray(offsets, np.int64)
+    n_rows = len(offs) - 1
+    out = np.empty((n_rows, n_cols), np.float64)
+    ok = np.empty((n_rows, n_cols), np.uint8)
+    if n_threads <= 0:
+        n_threads = min(os.cpu_count() or 1, 16)
+    lib.mmlspark_csv_parse(
+        data, offs, n_rows, n_cols,
+        delimiter.encode()[0:1] or b",", out, ok, n_threads,
+    )
+    return out, ok
 
 
 def predict_trees(bins: np.ndarray, feature: np.ndarray, threshold: np.ndarray,

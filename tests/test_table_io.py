@@ -1,0 +1,175 @@
+"""Tabular IO: native-C++ csv parse, slow-path parity, parquet + pandas
+interop (reference ingestion is Spark's JVM readers; here it is
+framework-native — core/table_io.py)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import (
+    from_pandas,
+    read_csv,
+    read_parquet,
+    to_pandas,
+    write_csv,
+    write_parquet,
+)
+from mmlspark_tpu.core.schema import Table
+from mmlspark_tpu.core.table_io import _parse_csv_bytes, _read_csv_slow
+
+
+CSV = (
+    "age,income,city,score\n"
+    "25,50000,Seattle,1.5\n"
+    "31,,Boston,2.25\n"
+    "47,82000,New York,-3.5\n"
+)
+
+
+class TestReadCSV:
+    def test_mixed_types(self, tmp_path):
+        p = tmp_path / "t.csv"
+        p.write_text(CSV)
+        t = read_csv(str(p))
+        assert t.columns == ["age", "income", "city", "score"]
+        np.testing.assert_allclose(np.asarray(t["age"]), [25, 31, 47])
+        income = np.asarray(t["income"])
+        assert np.isnan(income[1]) and income[2] == 82000
+        assert list(t["city"]) == ["Seattle", "Boston", "New York"]
+        np.testing.assert_allclose(np.asarray(t["score"]), [1.5, 2.25, -3.5])
+
+    def test_native_and_slow_paths_agree(self):
+        data = CSV.encode()
+        fast = _parse_csv_bytes(data, True, ",", None, "utf-8")
+        slow = _read_csv_slow(data, True, ",", None, "utf-8")
+        for c in fast.columns:
+            a, b = fast[c], slow[c]
+            if isinstance(a, np.ndarray):
+                np.testing.assert_allclose(a, np.asarray(b), equal_nan=True)
+            else:
+                assert list(a) == list(b)
+
+    def test_quoted_fields_route_to_slow_path(self, tmp_path):
+        p = tmp_path / "q.csv"
+        p.write_text('name,val\n"Smith, John",3\nPlain,4\n')
+        t = read_csv(str(p))
+        assert list(t["name"]) == ["Smith, John", "Plain"]
+        np.testing.assert_allclose(np.asarray(t["val"]), [3, 4])
+
+    def test_no_header_and_names(self, tmp_path):
+        p = tmp_path / "n.csv"
+        p.write_text("1,2\n3,4\n")
+        t = read_csv(str(p), header=False)
+        assert t.columns == ["c0", "c1"]
+        t2 = read_csv(str(p), header=False, column_names=["a", "b"])
+        np.testing.assert_allclose(np.asarray(t2["b"]), [2, 4])
+
+    def test_short_rows_pad_with_nan(self, tmp_path):
+        p = tmp_path / "s.csv"
+        p.write_text("a,b\n1,2\n3\n")
+        t = read_csv(str(p))
+        b = np.asarray(t["b"])
+        assert b[0] == 2 and np.isnan(b[1])
+
+    def test_interior_blank_lines(self, tmp_path):
+        # blank LF and CRLF rows must vanish identically on both paths,
+        # including alignment of text columns with numeric rows
+        p = tmp_path / "blank.csv"
+        p.write_bytes(b"a,b\r\n1,x\r\n\r\n2,y\r\n\n3,z\r\n")
+        t = read_csv(str(p))
+        np.testing.assert_allclose(np.asarray(t["a"]), [1, 2, 3])
+        assert list(t["b"]) == ["x", "y", "z"]
+
+    def test_multichar_delimiter_rejected(self, tmp_path):
+        p = tmp_path / "d.csv"
+        p.write_text("a::b\n1::2\n")
+        with pytest.raises(ValueError, match="one character"):
+            read_csv(str(p), delimiter="::")
+
+    def test_utf16_routes_to_slow_path(self, tmp_path):
+        p = tmp_path / "u16.csv"
+        p.write_bytes("a,b\n1,héllo\n".encode("utf-16"))
+        t = read_csv(str(p), encoding="utf-16")
+        np.testing.assert_allclose(np.asarray(t["a"]), [1])
+        assert list(t["b"]) == ["héllo"]
+
+    def test_hex_cells_stay_text(self, tmp_path):
+        # strtod would parse 0x1A as 26.0; Python float() rejects it — both
+        # paths must agree the column is text
+        p = tmp_path / "hex.csv"
+        p.write_text("a,b\n0x1A,2\n0x2B,3\n")
+        t = read_csv(str(p))
+        assert list(t["a"]) == ["0x1A", "0x2B"]
+        np.testing.assert_allclose(np.asarray(t["b"]), [2, 3])
+
+    def test_roundtrip_write_read(self, tmp_path):
+        t = Table({"x": np.asarray([1.5, 2.5]), "name": ["ab", "cd"]})
+        p = str(tmp_path / "rt.csv")
+        write_csv(t, p)
+        back = read_csv(p)
+        np.testing.assert_allclose(np.asarray(back["x"]), [1.5, 2.5])
+        assert list(back["name"]) == ["ab", "cd"]
+
+    def test_large_numeric_parse_correct(self, tmp_path):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(5000, 6))
+        lines = ["\n".join(",".join(f"{v:.10g}" for v in row) for row in x)]
+        p = tmp_path / "big.csv"
+        p.write_text("a,b,c,d,e,f\n" + lines[0] + "\n")
+        t = read_csv(str(p))
+        got = np.stack([np.asarray(t[c]) for c in t.columns], axis=1)
+        np.testing.assert_allclose(got, x, rtol=1e-9)
+
+
+class TestParquetAndPandas:
+    def test_parquet_roundtrip(self, tmp_path):
+        t = Table({"x": np.asarray([1.0, np.nan, 3.0]), "s": ["u", "v", "w"]})
+        p = str(tmp_path / "t.parquet")
+        write_parquet(t, p)
+        back = read_parquet(p)
+        x = np.asarray(back["x"])
+        assert x[0] == 1.0 and np.isnan(x[1]) and x[2] == 3.0
+        assert list(back["s"]) == ["u", "v", "w"]
+
+    def test_parquet_preserves_large_ints(self, tmp_path):
+        big = 2**60 + 1   # not representable in float64
+        t = Table({"id": np.asarray([big, 7], np.int64)})
+        p = str(tmp_path / "ids.parquet")
+        write_parquet(t, p)
+        back = read_parquet(p)
+        ids = np.asarray(back["id"])
+        assert ids.dtype == np.int64 and int(ids[0]) == big
+
+    def test_pandas_roundtrip(self):
+        pd = pytest.importorskip("pandas")
+        df = pd.DataFrame({"a": [1.0, 2.0], "b": ["x", "y"]})
+        t = from_pandas(df)
+        np.testing.assert_allclose(np.asarray(t["a"]), [1.0, 2.0])
+        assert list(t["b"]) == ["x", "y"]
+        df2 = to_pandas(t)
+        assert list(df2["b"]) == ["x", "y"]
+
+
+class TestEndToEnd:
+    def test_csv_to_gbdt_fit(self, tmp_path):
+        # the Adult-Census-style flow: read_csv -> TrainClassifier
+        rng = np.random.default_rng(1)
+        n = 400
+        age = rng.integers(18, 80, n)
+        wage = rng.normal(40000, 12000, n)
+        label = (0.03 * age + wage / 20000 + rng.normal(0, 0.5, n) > 3.2)
+        p = tmp_path / "census.csv"
+        rows = "\n".join(f"{a},{w:.2f},{int(l)}" for a, w, l in zip(age, wage, label))
+        p.write_text("age,wage,income\n" + rows + "\n")
+
+        from mmlspark_tpu.automl import TrainClassifier
+        from mmlspark_tpu.gbdt import GBDTClassifier
+
+        t = read_csv(str(p))
+        model = TrainClassifier(
+            model=GBDTClassifier(num_iterations=20, num_leaves=15),
+            label_col="income",
+        ).fit(t)
+        scored = model.transform(t)
+        acc = float((np.asarray(scored["prediction"]) ==
+                     np.asarray(t["income"])).mean())
+        assert acc > 0.8, acc
