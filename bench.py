@@ -236,7 +236,7 @@ def bench_serving() -> dict:
     """Continuous-mode serving latency (p50/p99 ms) on a warm jitted model —
     the measured counterpart of the reference's ~1 ms claim
     (docs/mmlspark-serving.md:10-11)."""
-    import urllib.request
+    import http.client
 
     from mmlspark_tpu.core.schema import Table
     from mmlspark_tpu.gbdt.estimators import GBDTClassifier
@@ -251,13 +251,16 @@ def bench_serving() -> dict:
     try:
         row = {f"f{j}": float(x[0, j]) for j in range(8)}
         body = json.dumps(row).encode()
+        # persistent HTTP/1.1 connection: the server keeps one thread per
+        # connection, so steady-state latency excludes TCP/thread setup
+        conn = http.client.HTTPConnection(srv.host, srv.port, timeout=30)
 
         def post():
-            req = urllib.request.Request(
-                srv.url, data=body, headers={"Content-Type": "application/json"}
-            )
-            with urllib.request.urlopen(req, timeout=30) as r:
-                r.read()
+            conn.request("POST", srv.api_path, body=body,
+                         headers={"Content-Type": "application/json"})
+            r = conn.getresponse()
+            r.read()
+            assert r.status == 200, f"serving returned {r.status}"
 
         for _ in range(20):          # warm-up: compile the scoring step
             post()
@@ -265,6 +268,7 @@ def bench_serving() -> dict:
         for _ in range(200):
             post()
         stats = srv.latency_stats()
+        conn.close()
     finally:
         srv.stop()
     return {"p50_ms": stats["p50_ms"], "p99_ms": stats["p99_ms"]}

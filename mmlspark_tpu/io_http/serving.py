@@ -100,9 +100,30 @@ class ServingServer:
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1 keep-alive: one connection (and one server thread)
+            # serves a client's whole request stream instead of paying TCP
+            # setup + thread spawn per request — the tail-latency source on
+            # the continuous path. Requires exact Content-Length on every
+            # response (sent below).
+            protocol_version = "HTTP/1.1"
+            # idle keep-alive connections time out so stop() quiesces:
+            # handle_one_request treats a socket timeout as end-of-stream
+            # and the per-connection thread exits
+            timeout = 5.0
+
             def do_POST(self):  # noqa: N802 — http.server API
                 with outer._counter_lock:
                     outer.requests_seen += 1
+                if self.headers.get("Transfer-Encoding"):
+                    # chunked bodies aren't framed by Content-Length; reading
+                    # them wrong would desync the keep-alive stream — refuse
+                    # and drop the connection (411 Length Required)
+                    self.send_response(411)
+                    self.send_header("Content-Length", "0")
+                    self.send_header("Connection", "close")
+                    self.end_headers()
+                    self.close_connection = True
+                    return
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length) if length else b""
                 ex = _Exchange(HTTPRequestData(
@@ -122,15 +143,22 @@ class ServingServer:
                         with outer._counter_lock:
                             outer._pending.pop(ex_id, None)
                     self.send_response(504)
+                    self.send_header("Content-Length", "0")
                     self.end_headers()
                     return
                 resp = ex.response or HTTPResponseData(500, "no response")
                 self.send_response(resp.status_code or 500)
+                entity = resp.entity or b""
                 for k, v in resp.headers.items():
-                    self.send_header(k, v)
+                    # handler-supplied lengths can be stale (forwarded
+                    # upstream responses); the ACTUAL entity length is the
+                    # only value that keeps the keep-alive stream framed
+                    if k.lower() != "content-length":
+                        self.send_header(k, v)
+                self.send_header("Content-Length", str(len(entity)))
                 self.end_headers()
-                if resp.entity:
-                    self.wfile.write(resp.entity)
+                if entity:
+                    self.wfile.write(entity)
                 with outer._counter_lock:
                     outer.requests_answered += 1
                     outer._latencies.append(time.perf_counter() - ex.enqueued_at)
@@ -146,6 +174,7 @@ class ServingServer:
                 }).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(info)))
                 self.end_headers()
                 self.wfile.write(info)
 
