@@ -150,10 +150,12 @@ class ServingServer:
                 self.send_response(resp.status_code or 500)
                 entity = resp.entity or b""
                 for k, v in resp.headers.items():
-                    # handler-supplied lengths can be stale (forwarded
-                    # upstream responses); the ACTUAL entity length is the
-                    # only value that keeps the keep-alive stream framed
-                    if k.lower() != "content-length":
+                    # forwarded upstream responses can carry stale framing /
+                    # hop-by-hop headers (clients.py de-chunks entities but
+                    # keeps the original header dict); only the ACTUAL
+                    # entity length keeps the keep-alive stream framed
+                    if k.lower() not in ("content-length", "transfer-encoding",
+                                         "connection", "keep-alive"):
                         self.send_header(k, v)
                 self.send_header("Content-Length", str(len(entity)))
                 self.end_headers()

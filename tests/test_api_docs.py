@@ -1,0 +1,18 @@
+"""docs/api.md must stay in sync with the stage registry (the reference
+regenerates its wrapper/doc surface on every build, CodeGen.scala:44-97 —
+here the equivalent staleness gate is a test)."""
+
+import os
+import sys
+
+
+def test_api_reference_up_to_date():
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    import gen_api_docs
+
+    path = os.path.join(os.path.dirname(__file__), "..", "docs", "api.md")
+    with open(path) as fh:
+        on_disk = fh.read()
+    assert on_disk == gen_api_docs.generate(), (
+        "docs/api.md is stale — run: python tools/gen_api_docs.py"
+    )
