@@ -236,5 +236,15 @@ def from_pandas(df) -> Table:
 def to_pandas(table: Table):
     import pandas as pd
 
-    return pd.DataFrame({n: np.asarray(table[n]) if isinstance(table[n], np.ndarray)
-                         else list(table[n]) for n in table.columns})
+    cols: dict[str, object] = {}
+    for n in table.columns:
+        col = table[n]
+        if isinstance(col, np.ndarray) and col.ndim > 1:
+            # pandas columns are 1-D: vector/matrix columns (probability,
+            # features, ...) become object columns of per-row lists
+            cols[n] = col.tolist()
+        elif isinstance(col, np.ndarray):
+            cols[n] = col
+        else:
+            cols[n] = list(col)
+    return pd.DataFrame(cols)

@@ -148,6 +148,13 @@ class TestParquetAndPandas:
         df2 = to_pandas(t)
         assert list(df2["b"]) == ["x", "y"]
 
+    def test_to_pandas_vector_columns(self):
+        # 2-D columns (probability, features) become per-row lists
+        t = Table({"p": np.asarray([[0.2, 0.8], [0.6, 0.4]]),
+                   "y": np.asarray([1.0, 0.0])})
+        df = to_pandas(t)
+        assert df["p"][0] == [0.2, 0.8] and df["y"][1] == 0.0
+
 
 class TestEndToEnd:
     def test_csv_to_gbdt_fit(self, tmp_path):
