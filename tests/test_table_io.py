@@ -156,6 +156,49 @@ class TestParquetAndPandas:
         assert df["p"][0] == [0.2, 0.8] and df["y"][1] == 0.0
 
 
+class TestDatagenRoundtrips:
+    """Property-style: random constrained tables (utils.datagen — the
+    GenerateDataset analogue) must survive csv and parquet roundtrips."""
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_csv_roundtrip_random_tables(self, seed, tmp_path):
+        from mmlspark_tpu.utils.datagen import ColumnSpec, generate_table
+
+        specs = [
+            ColumnSpec("d", "double", low=-5, high=5,
+                       null_fraction=0.2 if seed else 0.0),
+            ColumnSpec("i", "int", low=0, high=50),
+            ColumnSpec("s", "string", length=6),
+            ColumnSpec("c", "category", cardinality=3),
+        ]
+        t = generate_table(specs, n_rows=64, seed=seed)
+        p = str(tmp_path / f"rt{seed}.csv")
+        write_csv(t, p)
+        back = read_csv(p)
+        np.testing.assert_allclose(np.asarray(back["d"]),
+                                   np.asarray(t["d"]), equal_nan=True,
+                                   rtol=1e-12)
+        np.testing.assert_allclose(np.asarray(back["i"]), np.asarray(t["i"]))
+        assert list(back["s"]) == list(t["s"])
+        assert list(back["c"]) == list(t["c"])
+
+    @pytest.mark.parametrize("seed", [3, 4])
+    def test_parquet_roundtrip_random_tables(self, seed, tmp_path):
+        from mmlspark_tpu.utils.datagen import ColumnSpec, generate_table
+
+        specs = [
+            ColumnSpec("d", "double", null_fraction=0.3),
+            ColumnSpec("s", "string", length=4),
+        ]
+        t = generate_table(specs, n_rows=48, seed=seed)
+        p = str(tmp_path / f"rt{seed}.parquet")
+        write_parquet(t, p)
+        back = read_parquet(p)
+        np.testing.assert_allclose(np.asarray(back["d"]),
+                                   np.asarray(t["d"]), equal_nan=True)
+        assert list(back["s"]) == list(t["s"])
+
+
 class TestEndToEnd:
     def test_csv_to_gbdt_fit(self, tmp_path):
         # the Adult-Census-style flow: read_csv -> TrainClassifier
