@@ -52,7 +52,7 @@ def main():
         label_col="income", scored_labels_col="prediction"
     ).transform(scored)
     metrics = {k: float(np.asarray(stats[k])[0])
-               for k in ("accuracy", "precision", "recall")
+               for k in ("accuracy", "AUC", "precision", "recall")
                if k in stats.columns}
     print("test metrics:", {k: round(v, 4) for k, v in metrics.items()})
     assert metrics.get("accuracy", 0) > 0.8

@@ -177,6 +177,23 @@ class ComputeModelStatistics(Transformer):
             row[MetricConstants.PRECISION] = float(prec_c[1])
             row[MetricConstants.RECALL] = float(rec_c[1])
         scores_col = self.get("scores_col")
+        if not scores_col and num_classes == 2:
+            # schema sniffing (reference MetricUtils): an explicit scores_col
+            # is unnecessary when the table carries a SCORE_KIND-tagged
+            # probability column. Only binary-shaped columns qualify — a
+            # K>2 multiclass probability matrix on a batch that happens to
+            # contain two label values would otherwise feed P(class K-1)
+            # into a 0-vs-1 AUC.
+            def _binary_shaped(c):
+                arr = table[c]
+                return isinstance(arr, np.ndarray) and (
+                    arr.ndim == 1 or (arr.ndim == 2 and arr.shape[1] == 2)
+                )
+
+            scores_col = next(
+                (c for c in table.columns
+                 if table.meta(c).get(SCORE_KIND) == "probability"
+                 and _binary_shaped(c)), None)
         if scores_col and scores_col in table and num_classes == 2:
             scores = np.asarray(table[scores_col], np.float64)
             if scores.ndim == 2:

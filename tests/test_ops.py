@@ -239,6 +239,38 @@ class TestMetrics:
         assert row[MetricConstants.AUC] == 1.0  # scores perfectly separate
         assert cms.confusion_matrix.tolist() == [[1.0, 1.0], [0.0, 2.0]]
 
+    def test_auc_sniffed_from_probability_meta(self):
+        # no scores_col set: a SCORE_KIND=probability column is auto-used
+        from mmlspark_tpu.core.schema import SCORE_KIND
+
+        t = Table({
+            "label": np.array([0, 0, 1, 1]),
+            "scored_labels": np.array([0, 1, 1, 1]),
+        }).with_column(
+            "probability",
+            np.array([[0.9, 0.1], [0.4, 0.6], [0.3, 0.7], [0.1, 0.9]]),
+            meta={SCORE_KIND: "probability"},
+        )
+        row = next(ComputeModelStatistics().transform(t).rows())
+        assert row[MetricConstants.AUC] == 1.0
+
+    def test_auc_not_sniffed_from_multiclass_probabilities(self):
+        # a (n, K>2) probability matrix must NOT feed a binary AUC even when
+        # the batch happens to contain only two label values
+        from mmlspark_tpu.core.schema import SCORE_KIND
+
+        t = Table({
+            "label": np.array([0, 0, 1, 1]),
+            "scored_labels": np.array([0, 1, 1, 1]),
+        }).with_column(
+            "probability",
+            np.array([[0.8, 0.1, 0.1], [0.3, 0.6, 0.1],
+                      [0.2, 0.7, 0.1], [0.1, 0.8, 0.1]]),
+            meta={SCORE_KIND: "probability"},
+        )
+        row = next(ComputeModelStatistics().transform(t).rows())
+        assert MetricConstants.AUC not in row
+
     def test_auc_random(self):
         rng = np.random.default_rng(0)
         labels = rng.integers(0, 2, 2000)
