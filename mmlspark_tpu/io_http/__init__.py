@@ -23,7 +23,7 @@ from .transformer import (
     CustomInputParser,
     CustomOutputParser,
 )
-from .serving import ServingFleet, ServingServer, serve_model
+from .serving import MicroBatchQuery, ServingFleet, ServingServer, serve_model
 from .consolidator import PartitionConsolidator
 from .powerbi import PowerBIWriter
 from .cognitive import (
@@ -63,6 +63,7 @@ __all__ = [
     "StringOutputParser",
     "CustomInputParser",
     "CustomOutputParser",
+    "MicroBatchQuery",
     "ServingFleet",
     "ServingServer",
     "serve_model",

@@ -34,7 +34,17 @@ import numpy as np
 from ..core.schema import Table
 from .schema import HTTPRequestData, HTTPResponseData, make_reply, parse_request
 
-__all__ = ["ServingServer", "ServingFleet", "serve_model"]
+__all__ = ["ServingServer", "ServingFleet", "MicroBatchQuery", "serve_model"]
+
+
+def _handler_error_response(e: Exception) -> "HTTPResponseData":
+    """Uniform 500 payload for a failed scoring batch (continuous and
+    micro-batch paths share the error contract)."""
+    return HTTPResponseData(
+        500, "handler error",
+        headers={"Content-Type": "application/json"},
+        entity=json.dumps({"error": str(e)}).encode(),
+    )
 
 
 @dataclass
@@ -290,15 +300,82 @@ class ServingServer:
                         "preserve row count and order"
                     )
             except Exception as e:  # noqa: BLE001 — per-batch failure -> 500s
-                err = HTTPResponseData(
-                    500, "handler error",
-                    headers={"Content-Type": "application/json"},
-                    entity=json.dumps({"error": str(e)}).encode(),
-                )
-                replies = [err] * len(batch)
+                replies = [_handler_error_response(e)] * len(batch)
             for ex, resp in zip(batch, replies):
                 ex.response = resp
                 ex.event.set()
+
+
+class MicroBatchQuery:
+    """Streaming micro-batch engine for a batch-mode ServingServer — the
+    role of Spark's streaming query over `readStream.server()` (the
+    reference's HTTPSource getOffset/getBatch/commit tick loop,
+    HTTPSource.scala:46-225; query lifecycle = start/stop/awaitTermination).
+
+    Each tick drains pending requests (`get_batch`), runs `handler`
+    (Table{id, request} -> Table{id, reply}), and completes the exchanges
+    (`reply_table`). Handler errors 500 the affected batch instead of
+    killing the query; `exception` records the last one.
+    """
+
+    def __init__(self, server: "ServingServer",
+                 handler: Callable[[Table], Table],
+                 trigger_interval_s: float = 0.05,
+                 max_rows_per_batch: int | None = None):
+        if server.mode != "batch":
+            raise ValueError("MicroBatchQuery drives a mode='batch' server")
+        self.server = server
+        self.handler = handler
+        self.trigger_interval_s = trigger_interval_s
+        self.max_rows_per_batch = max_rows_per_batch
+        self.batches_processed = 0
+        self.rows_processed = 0
+        self.exception: Exception | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "MicroBatchQuery":
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            batch = self.server.get_batch(self.max_rows_per_batch)
+            if len(batch) == 0:
+                self._stop.wait(self.trigger_interval_s)
+                continue
+            ids = list(batch["id"])
+            try:
+                out = self.handler(batch)
+                out_ids = [str(i) for i in out["id"]]
+                if sorted(out_ids) != sorted(str(i) for i in ids):
+                    # a partial/mismatched answer would leave requests
+                    # parked and re-served every tick (same contract as the
+                    # continuous loop's replies-per-batch guard)
+                    raise ValueError(
+                        f"handler answered {len(out_ids)} of {len(ids)} "
+                        "drained requests — it must reply to every id"
+                    )
+                self.server.reply(out_ids, list(out["reply"]))
+            except Exception as e:  # noqa: BLE001 — batch fails, query lives
+                self.exception = e
+                self.server.reply(ids, [_handler_error_response(e)] * len(ids))
+            self.batches_processed += 1
+            self.rows_processed += len(ids)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def await_termination(self, timeout_s: float | None = None) -> bool:
+        """Block until stop() (or timeout). Mirrors the reference query's
+        awaitTermination; returns True if the query terminated."""
+        if self._thread is None:
+            return True
+        self._thread.join(timeout_s)
+        return not self._thread.is_alive()
 
 
 def serve_model(

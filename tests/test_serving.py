@@ -10,6 +10,7 @@ continuous direct-reply path.
 
 import json
 import threading
+import time
 import urllib.request
 
 import numpy as np
@@ -86,6 +87,95 @@ class TestContinuousLatency:
 
 
 class TestBatchMode:
+    def test_micro_batch_query_lifecycle(self):
+        """Streaming query over a batch-mode server: ticks drain + score +
+        reply without a caller-driven loop; handler errors 500 their batch
+        but the query keeps serving."""
+        import json as _json
+        import urllib.request
+
+        from mmlspark_tpu.io_http import MicroBatchQuery
+
+        srv = ServingServer(mode="batch").start()
+        calls = {"n": 0}
+
+        def handler(batch):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                raise RuntimeError("boom")
+            replies = [
+                HTTPResponseData(
+                    200, "ok", {"Content-Type": "application/json"},
+                    _json.dumps(
+                        {"doubled": _json.loads(r.entity)["x"] * 2}
+                    ).encode(),
+                )
+                for r in batch["request"]
+            ]
+            return Table({"id": list(batch["id"]), "reply": replies})
+
+        q = MicroBatchQuery(srv, handler, trigger_interval_s=0.01).start()
+        try:
+            def post(x):
+                req = urllib.request.Request(
+                    srv.url, data=_json.dumps({"x": x}).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                try:
+                    with urllib.request.urlopen(req, timeout=10) as r:
+                        return r.status, _json.loads(r.read())
+                except urllib.error.HTTPError as e:
+                    return e.code, _json.loads(e.read())
+
+            status, body = post(21)
+            assert (status, body["doubled"]) == (200, 42)
+            status2, body2 = post(1)          # second batch: handler raises
+            assert status2 == 500 and "boom" in body2["error"]
+            status3, body3 = post(5)          # query survived the error
+            assert (status3, body3["doubled"]) == (200, 10)
+            # counters increment AFTER the client unblocks — poll briefly
+            deadline = time.monotonic() + 5.0
+            while q.batches_processed < 3 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert q.batches_processed >= 3 and q.rows_processed >= 3
+            assert isinstance(q.exception, RuntimeError)
+        finally:
+            q.stop()
+            srv.stop()
+        assert q.await_termination(1.0)
+
+    def test_micro_batch_partial_answer_500s(self):
+        """A handler that silently drops rows must 500 the whole batch
+        (otherwise the dropped requests would park and re-serve forever)."""
+        import json as _json
+        import urllib.request
+
+        from mmlspark_tpu.io_http import MicroBatchQuery
+
+        srv = ServingServer(mode="batch").start()
+
+        def partial_handler(batch):
+            return Table({"id": [], "reply": []})   # answers nothing
+
+        q = MicroBatchQuery(srv, partial_handler, trigger_interval_s=0.01).start()
+        try:
+            req = urllib.request.Request(
+                srv.url, data=b"{}",
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    status = r.status
+            except urllib.error.HTTPError as e:
+                status = e.code
+                body = _json.loads(e.read())
+                assert "must reply to every id" in body["error"]
+            assert status == 500
+            assert isinstance(q.exception, ValueError)
+        finally:
+            q.stop()
+            srv.stop()
+
     def test_get_batch_reply_roundtrip(self):
         """Caller-driven micro-batch: requests park until get_batch drains
         them and reply() completes each exchange (HTTPSource semantics)."""

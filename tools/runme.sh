@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Developer entry point (the reference's `./runme` analogue, L8 tooling).
+#
+#   tools/runme.sh test      full suite on the 8-virtual-device CPU mesh
+#   tools/runme.sh quick     fast subset (core + gbdt + ops)
+#   tools/runme.sh dryrun    multi-chip sharding dryrun (8 virtual devices)
+#   tools/runme.sh bench     headline benchmark (real chip; falls back to CPU)
+#   tools/runme.sh bench-cpu headline benchmark pinned to CPU
+#   tools/runme.sh docs      regenerate docs/api.md from the stage registry
+#   tools/runme.sh ci        everything the CI gate runs (tools/ci.sh)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+case "${1:-help}" in
+  test)      python -m pytest tests/ -q ;;
+  quick)     python -m pytest tests/test_core.py tests/test_gbdt.py tests/test_ops.py -q ;;
+  dryrun)    python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')" ;;
+  bench)     python bench.py ;;
+  bench-cpu) MMLSPARK_TPU_BENCH_FORCE_CPU=1 python bench.py ;;
+  docs)      python tools/gen_api_docs.py ;;
+  ci)        bash tools/ci.sh ;;
+  *)         grep '^#   ' "$0" | sed 's/^#   //' ;;
+esac
