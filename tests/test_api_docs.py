@@ -1,18 +1,29 @@
 """docs/api.md must stay in sync with the stage registry (the reference
 regenerates its wrapper/doc surface on every build, CodeGen.scala:44-97 —
-here the equivalent staleness gate is a test)."""
+here the equivalent staleness gate is a test).
 
-import os
+Runs the generator in a CLEAN subprocess: inside the pytest process other
+suites may have registered test-only stages (the fuzzing harness does),
+which would make an in-process regeneration disagree with the committed
+doc in a test-ordering-dependent way.
+"""
+
+import pathlib
+import subprocess
 import sys
+
+REPO = pathlib.Path(__file__).parent.parent
 
 
 def test_api_reference_up_to_date():
-    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
-    import gen_api_docs
+    from tests.conftest import subprocess_env
 
-    path = os.path.join(os.path.dirname(__file__), "..", "docs", "api.md")
-    with open(path) as fh:
-        on_disk = fh.read()
-    assert on_disk == gen_api_docs.generate(), (
-        "docs/api.md is stale — run: python tools/gen_api_docs.py"
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "gen_api_docs.py"), "--check"],
+        capture_output=True, text=True, timeout=300,
+        cwd=str(REPO), env=subprocess_env(),
+    )
+    assert proc.returncode == 0, (
+        f"docs/api.md is stale — run: python tools/gen_api_docs.py\n"
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr[-1500:]}"
     )

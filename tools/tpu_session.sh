@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# One-shot TPU measurement session: run everything that needs the real chip
+# while a tunnel window is open. Outputs land in tpu_session_out/.
+#
+#   tools/tpu_session.sh           # probe, then sweep + bench
+set -uo pipefail
+cd "$(dirname "$0")/.."
+OUT=tpu_session_out
+mkdir -p "$OUT"
+
+echo "== probe =="
+if ! timeout 120 python -c "import jax; d=jax.devices()[0]; print(d.platform, d.device_kind)" \
+    > "$OUT/probe.txt" 2>&1; then
+  echo "probe failed/hung — tunnel down"; cat "$OUT/probe.txt" | tail -2; exit 1
+fi
+cat "$OUT/probe.txt"
+
+echo "== kernel sweep =="
+timeout 1200 python -u tools/sweep_hist.py > "$OUT/sweep.txt" 2>&1
+tail -12 "$OUT/sweep.txt"
+
+echo "== bench =="
+timeout 2400 python bench.py > "$OUT/bench.json" 2> "$OUT/bench.err"
+tail -1 "$OUT/bench.json"
+
+echo "== done — outputs in $OUT/ =="
