@@ -618,18 +618,8 @@ class Booster:
             return res
         out = (np.zeros((n, k), np.float32) if k > 1
                else np.full((n,), self.init_score, np.float32))
-        rows = np.arange(n)
         for t in range(self.num_trees):
-            feature, thr = self.feature[t], self.threshold_bin[t]
-            cat, left, right = self.is_categorical[t], self.left[t], self.right[t]
-            node = np.zeros(n, np.int64)
-            for _ in range(max_steps):
-                f = np.maximum(feature[node], 0)
-                col = bins[rows, f]
-                go_left = np.where(cat[node], col == thr[node], col <= thr[node])
-                leaf = feature[node] < 0
-                node = np.where(leaf, node,
-                                np.where(go_left, left[node], right[node]))
+            node = self._walk_tree(t, bins, max_steps)
             val = self.value[t][node].astype(np.float32)
             if k > 1:
                 out[:, int(self.tree_class[t])] += val
@@ -637,13 +627,81 @@ class Booster:
                 out = out + val
         return out
 
-    def predict_raw(self, x: np.ndarray, device: str | None = None) -> np.ndarray:
+    def truncated(self, num_iteration: int) -> "Booster":
+        """A view of the model using only the first `num_iteration` boosting
+        rounds (reference: LightGBM predict's num_iteration / the
+        bestIteration early-stopping slice). One round = one tree, or K
+        trees under multiclass."""
+        import dataclasses
+
+        # LightGBM semantics: num_iteration <= 0 means "all iterations" —
+        # the predict(num_iteration=best_iteration) idiom must not produce
+        # an empty model when no early stopping occurred (best_iteration=-1)
+        if num_iteration is None or int(num_iteration) <= 0:
+            return self
+        key = ("truncated", int(num_iteration))
+        if key in self._predict_cache:
+            return self._predict_cache[key]
+        per_round = self.num_class if self.objective == "multiclass" else 1
+        t = min(int(num_iteration) * per_round, self.num_trees)
+        view = dataclasses.replace(
+            self,
+            feature=self.feature[:t], threshold_bin=self.threshold_bin[:t],
+            threshold_value=self.threshold_value[:t],
+            is_categorical=self.is_categorical[:t],
+            left=self.left[:t], right=self.right[:t],
+            value=self.value[:t], gain=self.gain[:t],
+            tree_class=self.tree_class[:t],
+            best_iteration=-1,
+            _predict_cache={},
+        )
+        self._predict_cache[key] = view
+        return view
+
+    def _walk_tree(self, t: int, bins: np.ndarray, max_steps: int) -> np.ndarray:
+        """Leaf node index of every row in tree t — the single numpy
+        traversal shared by host scoring and pred_leaf (semantics changes
+        happen in ONE place)."""
+        n = bins.shape[0]
+        rows = np.arange(n)
+        feature, thr = self.feature[t], self.threshold_bin[t]
+        cat, left, right = self.is_categorical[t], self.left[t], self.right[t]
+        node = np.zeros(n, np.int64)
+        for _ in range(max_steps):
+            f = np.maximum(feature[node], 0)
+            col = bins[rows, f]
+            go_left = np.where(cat[node], col == thr[node], col <= thr[node])
+            leaf = feature[node] < 0
+            node = np.where(leaf, node,
+                            np.where(go_left, left[node], right[node]))
+        return node
+
+    def predict_leaf(self, x: np.ndarray) -> np.ndarray:
+        """Per-row leaf NODE index for every tree -> (n, T) int32
+        (reference: LightGBM predict(pred_leaf=True); useful for
+        tree-embedding features)."""
+        from .sparse import as_features
+
+        x = as_features(x)
+        bins = self.bin_mapper.transform(x).astype(np.int32)
+        n = bins.shape[0]
+        max_steps = int(self.feature.shape[1] // 2 + 1)
+        out = np.zeros((n, self.num_trees), np.int32)
+        for t in range(self.num_trees):
+            out[:, t] = self._walk_tree(t, bins, max_steps)
+        return out
+
+    def predict_raw(self, x: np.ndarray, device: str | None = None,
+                    num_iteration: int | None = None) -> np.ndarray:
         """Raw margin scores: (n,) or (n, K) for multiclass.
 
         device: None = auto (host walk for small batches, jitted device
-        traversal otherwise), or explicitly "host" / "device"."""
+        traversal otherwise), or explicitly "host" / "device".
+        num_iteration: score with only the first N boosting rounds."""
         from .sparse import as_features
 
+        if num_iteration is not None:
+            return self.truncated(num_iteration).predict_raw(x, device=device)
         x = as_features(x)
         if self.num_trees == 0:
             shape = (len(x), self.num_class) if self.num_class > 1 else (len(x),)
@@ -655,10 +713,14 @@ class Booster:
             return self._predict_raw_host(binned)
         return np.asarray(self._traverse_fn()(jnp.asarray(binned)))
 
-    def predict(self, x: np.ndarray, device: str | None = None) -> np.ndarray:
+    def predict(self, x: np.ndarray, device: str | None = None,
+                num_iteration: int | None = None) -> np.ndarray:
         """Probability / transformed prediction (reference
         LightGBMBooster.score semantics)."""
-        raw = np.asarray(self.predict_raw(x, device=device), np.float64)
+        raw = np.asarray(
+            self.predict_raw(x, device=device, num_iteration=num_iteration),
+            np.float64,
+        )
         if self.objective == "binary":
             return 1.0 / (1.0 + np.exp(-raw))
         if self.objective == "multiclass":

@@ -444,6 +444,74 @@ class TestReviewRegressions:
         assert b.num_trees > 0
 
 
+class TestPredictExtensions:
+    """num_iteration-limited predict + pred_leaf (LightGBM predict-API
+    parity: predict(num_iteration=...), predict(pred_leaf=True))."""
+
+    def _data(self, n=600, f=6, seed=4):
+        rng = np.random.default_rng(seed)
+        x = rng.normal(size=(n, f))
+        y = (x[:, 0] - 0.6 * x[:, 1] + 0.2 * rng.normal(size=n) > 0).astype(float)
+        return x, y
+
+    def test_truncated_equals_shorter_training(self):
+        from mmlspark_tpu.gbdt.booster import Booster, TrainOptions
+
+        x, y = self._data()
+        full = Booster.train(x, y, TrainOptions(
+            objective="binary", num_iterations=20, num_leaves=15))
+        short = Booster.train(x, y, TrainOptions(
+            objective="binary", num_iterations=8, num_leaves=15))
+        # boosting is sequential: the first 8 trees of the 20-round model
+        # ARE the 8-round model
+        np.testing.assert_allclose(
+            full.predict(x, num_iteration=8), short.predict(x),
+            rtol=1e-5, atol=1e-6,
+        )
+        assert full.truncated(8).num_trees == 8
+        # out-of-range request clamps to the full model
+        np.testing.assert_allclose(
+            full.predict(x, num_iteration=999), full.predict(x), rtol=1e-6)
+
+    def test_predict_leaf(self):
+        from mmlspark_tpu.gbdt.booster import Booster, TrainOptions
+
+        x, y = self._data(n=300)
+        b = Booster.train(x, y, TrainOptions(
+            objective="binary", num_iterations=5, num_leaves=7))
+        leaves = b.predict_leaf(x)
+        assert leaves.shape == (300, b.num_trees)
+        # every reported node is a leaf of its tree
+        for t in range(b.num_trees):
+            assert (b.feature[t][leaves[:, t]] < 0).all()
+        # summing the leaf values reproduces the raw margin exactly
+        vals = np.stack([b.value[t][leaves[:, t]] for t in range(b.num_trees)])
+        recon = b.init_score + vals.astype(np.float32).sum(axis=0)
+        np.testing.assert_allclose(
+            recon, b.predict_raw(x, device="host"), rtol=1e-5, atol=1e-6)
+
+    def test_truncated_multiclass_rounds(self):
+        from mmlspark_tpu.gbdt.booster import Booster, TrainOptions
+
+        rng = np.random.default_rng(5)
+        x = rng.normal(size=(400, 5))
+        y = rng.integers(0, 3, 400).astype(float)
+        opts = dict(objective="multiclass", num_class=3, num_leaves=7)
+        b = Booster.train(x, y, TrainOptions(num_iterations=6, **opts))
+        tr = b.truncated(2)
+        assert tr.num_trees == 6       # 2 rounds x 3 classes
+        assert b.num_trees == 18
+        # the real slicing contract: first 2 rounds of the 6-round model
+        # ARE the 2-round model (catches wrong round-vs-class ordering)
+        short = Booster.train(x, y, TrainOptions(num_iterations=2, **opts))
+        np.testing.assert_allclose(tr.predict(x), short.predict(x),
+                                   rtol=1e-5, atol=1e-6)
+        # <=0 means all iterations (LightGBM semantics; the
+        # num_iteration=best_iteration idiom with no early stopping)
+        np.testing.assert_allclose(b.predict(x, num_iteration=-1),
+                                   b.predict(x), rtol=1e-6)
+
+
 class TestHistKernel:
     """Kernel registry (core/kernels.py, NativeLoader analogue) + the Pallas
     histogram kernel vs the XLA one-hot-matmul fallback."""

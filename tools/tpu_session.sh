@@ -15,12 +15,27 @@ if ! timeout 120 python -c "import jax; d=jax.devices()[0]; print(d.platform, d.
 fi
 cat "$OUT/probe.txt"
 
+rc=0
+
 echo "== kernel sweep =="
-timeout 1200 python -u tools/sweep_hist.py > "$OUT/sweep.txt" 2>&1
-tail -12 "$OUT/sweep.txt"
+if timeout 1200 python -u tools/sweep_hist.py > "$OUT/sweep.txt" 2>&1; then
+  tail -12 "$OUT/sweep.txt"
+else
+  echo "SWEEP FAILED (rc=$?) — tail of $OUT/sweep.txt:"; tail -5 "$OUT/sweep.txt"
+  rc=1
+fi
 
 echo "== bench =="
-timeout 2400 python bench.py > "$OUT/bench.json" 2> "$OUT/bench.err"
-tail -1 "$OUT/bench.json"
+if timeout 2400 python bench.py > "$OUT/bench.json" 2> "$OUT/bench.err"; then
+  tail -1 "$OUT/bench.json"
+else
+  echo "BENCH FAILED (rc=$?) — tail of $OUT/bench.err:"; tail -5 "$OUT/bench.err"
+  rc=1
+fi
 
-echo "== done — outputs in $OUT/ =="
+if [ "$rc" -eq 0 ]; then
+  echo "== done — outputs in $OUT/ =="
+else
+  echo "== FINISHED WITH FAILURES — outputs in $OUT/ =="
+fi
+exit "$rc"
