@@ -134,29 +134,55 @@ void mmlspark_bin_numeric(
     const uint8_t* is_cat,      // (f,)
     int32_t* out)               // (n, f) row-major, pre-zeroed
 {
-    for (int64_t j = 0; j < f; ++j) {
-        const int32_t nb = num_bins[j];
-        if (is_cat[j] || nb <= 1) continue;
-        const double* ub = upper_bounds + j * ub_stride + 1;  // skip bin 0
-        const int64_t m = nb - 1;  // number of real boundaries
-        for (int64_t i = 0; i < n; ++i) {
-            const double v = x[i * f + j];
-            if (!std::isfinite(v)) {
-                out[i * f + j] = 0;  // MISSING_BIN
-                continue;
+    auto bin_rows = [&](int64_t r0, int64_t r1) {
+        // row-outer loop: x and out are row-major, so cells stream
+        // sequentially through cache; the small per-feature boundary
+        // tables stay hot in L1/L2
+        for (int64_t i = r0; i < r1; ++i) {
+            const double* row = x + i * f;
+            int32_t* orow = out + i * f;
+            for (int64_t j = 0; j < f; ++j) {
+                const int32_t nb = num_bins[j];
+                if (is_cat[j] || nb <= 1) continue;
+                const double v = row[j];
+                if (!std::isfinite(v)) {
+                    orow[j] = 0;  // MISSING_BIN
+                    continue;
+                }
+                const double* ub = upper_bounds + j * ub_stride + 1;  // skip bin 0
+                const int64_t m = nb - 1;  // number of real boundaries
+                // lower_bound == searchsorted(side='left')
+                int64_t lo = 0, hi = m;
+                while (lo < hi) {
+                    const int64_t mid = (lo + hi) >> 1;
+                    if (ub[mid] < v) lo = mid + 1; else hi = mid;
+                }
+                int64_t b = lo + 1;
+                if (b < 1) b = 1;
+                if (b > nb - 1) b = nb - 1;
+                orow[j] = static_cast<int32_t>(b);
             }
-            // lower_bound == searchsorted(side='left')
-            int64_t lo = 0, hi = m;
-            while (lo < hi) {
-                const int64_t mid = (lo + hi) >> 1;
-                if (ub[mid] < v) lo = mid + 1; else hi = mid;
-            }
-            int64_t b = lo + 1;
-            if (b < 1) b = 1;
-            if (b > nb - 1) b = nb - 1;
-            out[i * f + j] = static_cast<int32_t>(b);
         }
+    };
+    // thread over row ranges (disjoint writes) once the work is large
+    // enough to amortize thread spawn
+    const int64_t kMinRowsPerThread = 16384;
+    int64_t nt = static_cast<int64_t>(std::thread::hardware_concurrency());
+    if (nt > 16) nt = 16;
+    if (nt <= 1 || n < 2 * kMinRowsPerThread) {
+        bin_rows(0, n);
+        return;
     }
+    if (nt > n / kMinRowsPerThread) nt = n / kMinRowsPerThread;
+    std::vector<std::thread> workers;
+    const int64_t chunk = (n + nt - 1) / nt;
+    for (int64_t t = 0; t < nt; ++t) {
+        const int64_t r0 = t * chunk;
+        const int64_t r1 = r0 + chunk < n ? r0 + chunk : n;
+        if (r0 >= r1) break;
+        workers.emplace_back(bin_rows, r0, r1);
+    }
+    for (auto& w : workers) w.join();
 }
 
 // Array-of-trees SoA traversal over binned rows: replicates the jitted
