@@ -26,6 +26,7 @@ __all__ = [
     "MLP",
     "SimpleCNN",
     "ResNet",
+    "TransformerEncoder",
     "resnet20_cifar",
     "resnet50",
     "ARCHITECTURES",
@@ -163,6 +164,67 @@ class ResNet(nn.Module):
         return nn.Dense(self.num_outputs, dtype=jnp.float32, name="head")(x)
 
 
+class TransformerEncoder(nn.Module):
+    """Sequence classifier/regressor: pre-LN transformer encoder blocks
+    over (batch, seq, feat) inputs — the sequence-model family the
+    reference lacks entirely (SURVEY.md §5.7). Token-id inputs embed via
+    `vocab_size`; continuous inputs project via a Dense stem. Attention is
+    standard dense MHA here; the sharded ring/Ulysses variants in
+    `parallel.ring_attention` drop into the same block shape for long
+    sequences (they implement identical math)."""
+
+    num_layers: int = 2
+    d_model: int = 64
+    num_heads: int = 4
+    d_ff: int = 128
+    num_outputs: int = 2
+    vocab_size: int = 0             # >0: int token inputs, embed; 0: project
+    max_len: int = 512
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.vocab_size > 0:
+            h = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
+                         name="embed")(x.astype(jnp.int32))
+        else:
+            if x.ndim == 2:          # (batch, seq) scalars -> (batch, seq, 1)
+                x = x[:, :, None]
+            h = nn.Dense(self.d_model, dtype=self.dtype, name="stem")(
+                x.astype(self.dtype))
+        if h.shape[1] > self.max_len:
+            raise ValueError(
+                f"sequence length {h.shape[1]} exceeds max_len={self.max_len}; "
+                "raise max_len in the model config"
+            )
+        # param stays float32 (the mixed-precision recipe: f32 params, cast
+        # at use) — creating it in bf16 would also optimize it in bf16 and
+        # tiny position updates would round to zero
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (self.max_len, self.d_model), jnp.float32,
+        )
+        h = h + pos[: h.shape[1]][None, :, :].astype(self.dtype)
+        for i in range(self.num_layers):
+            y = nn.LayerNorm(dtype=self.dtype, name=f"ln_attn_{i}")(h)
+            y = nn.MultiHeadDotProductAttention(
+                num_heads=self.num_heads, dtype=self.dtype,
+                dropout_rate=self.dropout_rate, deterministic=not train,
+                name=f"attn_{i}",
+            )(y)
+            h = h + y
+            y = nn.LayerNorm(dtype=self.dtype, name=f"ln_mlp_{i}")(h)
+            y = nn.Dense(self.d_ff, dtype=self.dtype, name=f"mlp_up_{i}")(y)
+            y = nn.gelu(y)
+            y = nn.Dense(self.d_model, dtype=self.dtype, name=f"mlp_down_{i}")(y)
+            h = h + y
+        h = nn.LayerNorm(dtype=self.dtype, name="ln_final")(h)
+        pooled = h.mean(axis=1)
+        self.sow("intermediates", "pooled_features", pooled)
+        return nn.Dense(self.num_outputs, dtype=jnp.float32, name="head")(pooled)
+
+
 def resnet20_cifar(num_outputs: int = 10, dtype=jnp.float32) -> ResNet:
     return ResNet(stage_sizes=(3, 3, 3), num_filters=16,
                   num_outputs=num_outputs, dtype=dtype)
@@ -182,6 +244,7 @@ ARCHITECTURES: dict[str, Callable[..., nn.Module]] = {
     "resnet20_cifar": lambda **kw: resnet20_cifar(**kw),
     "resnet50": lambda **kw: resnet50(**kw),
     "resnet": lambda **kw: ResNet(**kw),
+    "transformer": lambda **kw: TransformerEncoder(**kw),
 }
 
 

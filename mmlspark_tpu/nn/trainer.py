@@ -99,16 +99,21 @@ class DNNLearner(HasFeaturesCol, HasLabelCol, Estimator):
         loss_kind = self.get("loss")
         has_bn = bool(batch_stats)
 
-        def loss_fn(params, batch_stats, bx, by):
+        def loss_fn(params, batch_stats, bx, by, step_rng):
             variables = {"params": params}
+            # a dropout rng is always supplied (flax ignores unused rngs),
+            # so stochastic-regularization models train without special
+            # casing; deterministic models are unaffected
+            rngs = {"dropout": step_rng}
             if has_bn:
                 variables["batch_stats"] = batch_stats
                 logits, updates = module.apply(
-                    variables, bx, train=True, mutable=["batch_stats"]
+                    variables, bx, train=True, mutable=["batch_stats"],
+                    rngs=rngs,
                 )
                 new_stats = updates["batch_stats"]
             else:
-                logits = module.apply(variables, bx, train=True)
+                logits = module.apply(variables, bx, train=True, rngs=rngs)
                 new_stats = batch_stats
             if loss_kind == "softmax_ce":
                 loss = optax.softmax_cross_entropy_with_integer_labels(
@@ -118,9 +123,9 @@ class DNNLearner(HasFeaturesCol, HasLabelCol, Estimator):
                 loss = jnp.mean((logits.squeeze(-1) - by.astype(jnp.float32)) ** 2)
             return loss, new_stats
 
-        def train_step(params, batch_stats, opt_state, bx, by):
+        def train_step(params, batch_stats, opt_state, bx, by, step_rng):
             (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                params, batch_stats, bx, by
+                params, batch_stats, bx, by, step_rng
             )
             updates, opt_state = tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
@@ -133,12 +138,13 @@ class DNNLearner(HasFeaturesCol, HasLabelCol, Estimator):
             data = NamedSharding(mesh, P(DATA_AXIS))
             step = jax.jit(
                 train_step,
-                in_shardings=(repl, repl, repl, data, data),
+                in_shardings=(repl, repl, repl, data, data, repl),
                 out_shardings=(repl, repl, repl, repl),
                 donate_argnums=(0, 1, 2),
             )
         else:
             step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+        base_rng = jax.random.PRNGKey(int(self.get("seed")) + 1)
 
         bs = int(self.get("batch_size"))
         bs = min(bs, n)  # small tables: never a zero-step epoch
@@ -173,18 +179,24 @@ class DNNLearner(HasFeaturesCol, HasLabelCol, Estimator):
                 xd, yd = jnp.asarray(x), jnp.asarray(y)
                 data_spec = None
 
-            def epoch_body(carry, idx):
+            def epoch_body(carry, xs):
                 p, bst, os_ = carry
+                idx, step_rng = xs
                 bx, by = xd[idx], yd[idx]
                 if data_spec is not None:
                     bx = jax.lax.with_sharding_constraint(bx, data_spec)
                     by = jax.lax.with_sharding_constraint(by, data_spec)
-                p, bst, os_, loss = train_step(p, bst, os_, bx, by)
+                p, bst, os_, loss = train_step(p, bst, os_, bx, by, step_rng)
                 return (p, bst, os_), loss
 
-            def run_epoch(params, batch_stats, opt_state, order):
+            def run_epoch(params, batch_stats, opt_state, order, epoch_rng):
+                # fold_in(k) matches the per-step loop path exactly, so a
+                # dropout model trains identically fused or streamed
+                keys = jax.vmap(
+                    lambda i: jax.random.fold_in(epoch_rng, i)
+                )(jnp.arange(order.shape[0]))
                 (p, bst, os_), losses = jax.lax.scan(
-                    epoch_body, (params, batch_stats, opt_state), order
+                    epoch_body, (params, batch_stats, opt_state), (order, keys)
                 )
                 return p, bst, os_, losses.mean()
 
@@ -195,21 +207,23 @@ class DNNLearner(HasFeaturesCol, HasLabelCol, Estimator):
             order = rng.permutation(n)
             # drop the ragged tail (shuffled: all rows seen across epochs);
             # XLA compiles one batch shape
+            epoch_rng = jax.random.fold_in(base_rng, epoch)
             if fused:
                 idx = jnp.asarray(
                     order[: steps * bs].reshape(steps, bs), jnp.int32
                 )
                 params, batch_stats, opt_state, mean_loss = epoch_fn(
-                    params, batch_stats, opt_state, idx
+                    params, batch_stats, opt_state, idx, epoch_rng
                 )
                 mean_loss = float(mean_loss)
             else:
                 losses = []
-                for i in range(0, n - bs + 1, bs):
+                for k, i in enumerate(range(0, n - bs + 1, bs)):
                     idx = order[i : i + bs]
                     params, batch_stats, opt_state, loss = step(
                         params, batch_stats, opt_state,
                         jnp.asarray(x[idx]), jnp.asarray(y[idx]),
+                        jax.random.fold_in(epoch_rng, k),
                     )
                     losses.append(loss)
                 mean_loss = (

@@ -239,6 +239,57 @@ class TestDNNLearner:
         out = model.transform(tbl)
         assert "prediction" in out.columns
 
+    def test_transformer_sequence_task(self):
+        # sequence family (absent in the reference): continuous inputs
+        # through the Dense stem; label = sign of the first channel's mean
+        from mmlspark_tpu.nn.trainer import DNNLearner
+
+        rng = np.random.default_rng(7)
+        x = rng.normal(size=(192, 12, 3)).astype(np.float32)
+        y = (x[:, :, 0].mean(axis=1) > 0).astype(np.float64)
+        m = DNNLearner(
+            architecture="transformer", epochs=8, batch_size=64,
+            model_config={"num_layers": 1, "d_model": 32, "num_heads": 2,
+                          "d_ff": 64},
+            use_mesh=False, bfloat16=False, learning_rate=3e-3,
+        ).fit(Table({"features": x, "label": y}))
+        acc = float((np.asarray(m.transform(Table({"features": x}))
+                                ["prediction"]) == y).mean())
+        assert acc > 0.8, acc
+
+    def test_transformer_dropout_and_bf16_params(self):
+        # dropout_rate > 0 requires the trainer to thread a dropout rng;
+        # pos_embed must stay float32 under the default bf16 compute path
+        import jax.numpy as jnp
+
+        from mmlspark_tpu.nn.trainer import DNNLearner
+
+        rng = np.random.default_rng(8)
+        x = rng.normal(size=(96, 8, 2)).astype(np.float32)
+        y = (x[:, :, 0].mean(axis=1) > 0).astype(np.float64)
+        m = DNNLearner(
+            architecture="transformer", epochs=2, batch_size=32,
+            model_config={"num_layers": 1, "d_model": 16, "num_heads": 2,
+                          "d_ff": 32, "dropout_rate": 0.1},
+        ).fit(Table({"features": x, "label": y}))
+        assert m.bundle.variables["params"]["pos_embed"].dtype == jnp.float32
+
+    def test_transformer_token_inputs(self):
+        # vocab_size > 0: integer token inputs embed; pooled features are
+        # addressable for transfer learning like every other family
+        b = ModelBundle.init(
+            "transformer", (10,), num_outputs=3, seed=0,
+            vocab_size=50, num_layers=1, d_model=16, num_heads=2, d_ff=32,
+        )
+        toks = np.random.default_rng(0).integers(0, 50, size=(6, 10))
+        t = DeepModelTransformer(
+            input_col="tokens",
+            fetch_dict={"out": "logits", "feat": "pooled_features"},
+        ).set_model(b)
+        out = t.transform(Table({"tokens": toks}))
+        assert np.asarray(out["out"]).shape == (6, 3)
+        assert np.asarray(out["feat"]).shape == (6, 16)
+
     def test_bn_model_trains(self):
         tbl = image_table(n=64, hw=8, classes=4)
         model = DNNLearner(
