@@ -51,8 +51,8 @@ IMG_BATCH = 1024        # large batches amortize per-dispatch latency (tunnel)
 N_IMAGES = 8192         # CIFAR10-scale eval slice
 
 
-def _probe_backend(timeout_s: float = 180.0, attempts: int = 3,
-                   retry_delay_s: float = 45.0) -> str:
+def _probe_backend(timeout_s: float = 180.0, attempts: int = 5,
+                   retry_delay_s: float = 90.0) -> str:
     """Try real-device backend init in a subprocess; 'default' if it works,
     'cpu' if it crashes, hangs, or reports no non-CPU device. Retries ride
     out TRANSIENT device-tunnel outages (observed mid-session: the tunnel
@@ -60,6 +60,7 @@ def _probe_backend(timeout_s: float = 180.0, attempts: int = 3,
     falls back to CPU."""
     if os.environ.get("MMLSPARK_TPU_BENCH_FORCE_CPU"):
         return "cpu"
+    attempts = int(os.environ.get("MMLSPARK_TPU_BENCH_PROBE_ATTEMPTS", attempts))
     code = (
         "import jax; ds = jax.devices(); "
         "print('PLATFORM=' + ds[0].platform)"
