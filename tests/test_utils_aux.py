@@ -196,3 +196,52 @@ class TestFluentAPI:
         )
         assert out.columns == ["c", "idx"]
         assert list(np.asarray(out["idx"])) == [0.0, 1.0, 0.0]
+
+
+class TestProfiling:
+    """Tracing utilities (SURVEY.md §5.1: jax.profiler integration)."""
+
+    def test_device_trace_writes_xplane(self, tmp_path):
+        import jax.numpy as jnp
+
+        from mmlspark_tpu.utils.profiling import device_trace
+
+        target = str(tmp_path / "trace")
+        with device_trace(target):
+            jnp.arange(16.0).sum().block_until_ready()
+        files = list((tmp_path / "trace").rglob("*"))
+        assert any(f.suffix == ".pb" or "xplane" in f.name for f in files), files
+
+    def test_device_trace_noop_without_target(self, monkeypatch):
+        from mmlspark_tpu.utils.profiling import device_trace
+
+        monkeypatch.delenv("MMLSPARK_TPU_TRACE_DIR", raising=False)
+        with device_trace(None) as t:
+            assert t is None
+
+    def test_device_trace_env_var(self, tmp_path, monkeypatch):
+        import jax.numpy as jnp
+
+        from mmlspark_tpu.utils.profiling import device_trace
+
+        monkeypatch.setenv("MMLSPARK_TPU_TRACE_DIR", str(tmp_path / "envtrace"))
+        with device_trace(None) as t:
+            assert t is not None
+            jnp.ones(4).sum().block_until_ready()
+        assert (tmp_path / "envtrace").exists()
+
+    def test_profile_fn_and_annotate(self):
+        import jax
+        import jax.numpy as jnp
+
+        from mmlspark_tpu.utils.profiling import annotate, profile_fn
+
+        @jax.jit
+        def f(v):
+            with annotate("square"):
+                return (v * v).sum()
+
+        stats = profile_fn(f, jnp.arange(64.0), iters=2)
+        assert stats["steady_s"] > 0
+        assert stats["first_call_s"] >= stats["steady_s"] * 0.5
+        assert float(stats["out"]) == float((np.arange(64.0) ** 2).sum())
