@@ -206,12 +206,22 @@ def bench_trainer() -> dict:
     """DNN training throughput (images/sec) on a CIFAR10-scale ResNet
     fine-tune — BASELINE config #4 (the reference trains out-of-band via
     mpirun+CNTK, CNTKLearner.scala:169-183; here it is one jitted epoch scan
-    per dispatch). Timed as fit(epochs=4) - fit(epochs=1): the compile cost
-    appears in both and cancels, leaving 3 steady-state epochs."""
+    per dispatch). Timed as fit(1+k) - fit(1): the compile cost appears in
+    both and cancels, leaving k steady-state epochs. Sizes are
+    backend-dependent — the real measurement (4096 images, k=3) runs on
+    the device; the CPU fallback is a small smoke run (256 images, k=1),
+    not a meaningful throughput number."""
+    import jax
+
     from mmlspark_tpu.core.schema import Table
     from mmlspark_tpu.nn.trainer import DNNLearner
 
-    n, classes = 4096, 10
+    # CPU fallback is a smoke run, not a measurement: a ResNet epoch over
+    # 4096 CIFAR images takes ~10 min/epoch on one CPU core
+    on_cpu = jax.default_backend() == "cpu"
+    n, classes = (256 if on_cpu else 4096), 10
+    bs = 128 if on_cpu else 512
+    extra_epochs = 1 if on_cpu else 3
     rng = np.random.default_rng(5)
     x = rng.normal(size=(n, 32, 32, 3)).astype(np.float32)
     y = rng.integers(0, classes, size=n).astype(np.float64)
@@ -219,7 +229,7 @@ def bench_trainer() -> dict:
 
     def fit(epochs):
         learner = DNNLearner(
-            architecture="resnet20_cifar", epochs=epochs, batch_size=512,
+            architecture="resnet20_cifar", epochs=epochs, batch_size=bs,
             use_mesh=False, seed=0,
         )
         t0 = time.perf_counter()
@@ -227,10 +237,10 @@ def bench_trainer() -> dict:
         return time.perf_counter() - t0
 
     t1 = fit(1)
-    t4 = fit(4)
-    steady = max(t4 - t1, 1e-9)
-    return {"train_images_per_sec": n * 3 / steady,
-            "epoch1_seconds": t1, "steady_3epoch_seconds": steady}
+    tn = fit(1 + extra_epochs)
+    steady = max(tn - t1, 1e-9)
+    return {"train_images_per_sec": n * extra_epochs / steady,
+            "epoch1_seconds": t1, "steady_epochs_seconds": steady}
 
 
 def bench_serving() -> dict:
