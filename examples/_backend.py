@@ -11,6 +11,12 @@ direct ``python examples/...`` run still uses the real device.
 import os
 
 if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+    # multi-device examples need virtual devices BEFORE backend init; a
+    # single shared bootstrap keeps the flag logic in one place
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
     import jax
 
     jax.config.update("jax_platforms", "cpu")
