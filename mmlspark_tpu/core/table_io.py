@@ -61,10 +61,12 @@ def _parse_csv_bytes(data, header, delimiter, column_names, encoding) -> Table:
     if not data.strip():
         return Table({})
     enc_name = codecs.lookup(encoding).name
-    if b'"' in data or enc_name not in _FAST_PATH_ENCODINGS:
-        # quoted cells (embedded delimiters/newlines) or a non-ASCII-
+    if b'"' in data or enc_name not in _FAST_PATH_ENCODINGS or ord(delimiter) > 127:
+        # quoted cells (embedded delimiters/newlines), a non-ASCII-
         # compatible encoding (utf-16 etc, where byte-level newline
-        # indexing is wrong): full csv-module semantics
+        # indexing is wrong), or a non-ASCII delimiter (the C parser splits
+        # on a single byte; a multi-byte UTF-8 delimiter would split rows on
+        # its first byte only): full csv-module semantics
         return _read_csv_slow(data, header, delimiter, column_names, encoding)
 
     if not data.endswith(b"\n"):
@@ -139,6 +141,13 @@ def _read_csv_slow(data, header, delimiter, column_names, encoding) -> Table:
             if cell == "":
                 numeric.append(float("nan"))
                 continue
+            if "_" in cell or not cell.isascii():
+                # Python float() accepts "1_000" and non-ASCII Unicode
+                # digits ("١٢٣") but the native path's strtod does not;
+                # treat both as text so the schema is path-independent
+                # (hex is already aligned via looks_hex in kernels.cpp)
+                is_num = False
+                break
             try:
                 numeric.append(float(cell))
             except ValueError:

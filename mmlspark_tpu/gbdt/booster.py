@@ -74,6 +74,9 @@ class TrainOptions:
     boost_from_average: bool = True
     is_unbalance: bool = False
     early_stopping_round: int = 0
+    # LightGBM's `deterministic` flag: bit-exact histogram merge under any
+    # reduction order / device permutation (parallel/collectives.py)
+    deterministic: bool = False
     categorical_indexes: tuple[int, ...] = ()
     init_model: "Booster | None" = None   # warm start (reference modelString)
     seed: int = 0
@@ -180,6 +183,7 @@ class Booster:
             voting_top_k=(
                 opts.top_k if str(opts.tree_learner).startswith("voting") else 0
             ),
+            deterministic=opts.deterministic,
         )
         cat_mask = np.zeros(f, bool)
         for ci in opts.categorical_indexes:
@@ -641,7 +645,11 @@ class Booster:
             return self
         key = ("truncated", int(num_iteration))
         if key in self._predict_cache:
-            return self._predict_cache[key]
+            # move-to-end: the bound below evicts least-RECENTLY-used views,
+            # so a repeated 1..N sweep (N>8) doesn't evict next sweep's keys
+            view = self._predict_cache.pop(key)
+            self._predict_cache[key] = view
+            return view
         per_round = self.num_class if self.objective == "multiclass" else 1
         t = min(int(num_iteration) * per_round, self.num_trees)
         view = dataclasses.replace(
@@ -656,6 +664,13 @@ class Booster:
             _predict_cache={},
         )
         self._predict_cache[key] = view
+        # bound the view cache: a per-iteration eval sweep over a large model
+        # would otherwise cache one view (each with its own jitted traversal)
+        # per distinct num_iteration for the booster's lifetime
+        trunc_keys = [k for k in self._predict_cache
+                      if isinstance(k, tuple) and k and k[0] == "truncated"]
+        for stale in trunc_keys[:-8]:
+            del self._predict_cache[stale]
         return view
 
     def _walk_tree(self, t: int, bins: np.ndarray, max_steps: int) -> np.ndarray:

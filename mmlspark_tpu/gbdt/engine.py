@@ -37,6 +37,7 @@ from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..parallel.mesh import DATA_AXIS
+from ..parallel.collectives import psum_exact_fixedpoint
 
 __all__ = ["TreeArrays", "GrowConfig", "make_grow_fn", "pad_rows"]
 
@@ -69,6 +70,13 @@ class GrowConfig(NamedTuple):
     # and only the globally top-2k voted features' histograms are merged —
     # the top_k/all_gather mapping from SURVEY.md §2.2. 0 = full data-parallel.
     voting_top_k: int = 0
+    # LightGBM's `deterministic` param, TPU-style: route the histogram
+    # all-reduce through the bit-exact fixed-point psum
+    # (parallel/collectives.py) so the merged histogram — and therefore the
+    # grown tree — is identical bits under any reduction order or device
+    # permutation. Off by default: plain psum is faster and the replicated
+    # model is still self-consistent within one compiled program.
+    deterministic: bool = False
 
 
 def pad_rows(n: int, shards: int) -> int:
@@ -148,6 +156,14 @@ def make_grow_fn(
 
     def grow(bins, grad, hess, sample_mask, feature_mask, axis_name=None):
         n = bins.shape[0]
+
+        def hist_psum(h, axis):
+            """The one histogram-merge collective. deterministic=True pins
+            the result to identical bits under any reduction order/device
+            permutation (LightGBM's `deterministic`; SURVEY.md §7)."""
+            if cfg.deterministic:
+                return psum_exact_fixedpoint(h, axis)
+            return jax.lax.psum(h, axis)
 
         def local_hist(mask):
             # channels: [grad, hess, row count] — count is unweighted so
@@ -231,15 +247,15 @@ def make_grow_fn(
                 # floats instead of F*B*3), scattered back to full shape.
                 # fresh zeros (not zeros_like) keep the result axis-invariant
                 # under shard_map — h itself is device-varying.
-                h_sel = jax.lax.psum(h[sel_ids], axis_name)    # (k2, B, 3)
+                h_sel = hist_psum(h[sel_ids], axis_name)       # (k2, B, 3)
                 h = jnp.zeros(h.shape, h.dtype).at[sel_ids].set(h_sel)
             elif axis_name is not None:
-                h = jax.lax.psum(h, axis_name)
+                h = hist_psum(h, axis_name)
             return h  # (F, B, 3)
 
         if sel_ids is not None:
             root_h0 = jnp.zeros(h_local.shape, h_local.dtype).at[sel_ids].set(
-                jax.lax.psum(h_local[sel_ids], axis_name)
+                hist_psum(h_local[sel_ids], axis_name)
             )
 
         valid_bin = valid_base & (feature_mask[:, None] > 0)

@@ -84,6 +84,12 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
         ptype=str,
     )
     top_k = Param(20, "voting-parallel local candidate count", ptype=int)
+    deterministic = Param(
+        False,
+        "bit-exact histogram merge under any reduction order / device "
+        "permutation (LightGBM's deterministic flag; parallel/collectives.py)",
+        ptype=bool,
+    )
     verbosity = Param(1, "logging verbosity", ptype=int)
     seed = Param(0, "master rng seed", ptype=int)
 
@@ -112,6 +118,7 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
             categorical_indexes=tuple(self.get("categorical_slot_indexes") or ()),
             tree_learner=self.get("tree_learner"),
             top_k=self.get("top_k"),
+            deterministic=self.get("deterministic"),
             num_class=num_class,
             boost_from_average=self.get("boost_from_average"),
             init_model=init_model,

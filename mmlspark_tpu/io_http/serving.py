@@ -118,10 +118,23 @@ class ServingServer:
             protocol_version = "HTTP/1.1"
             # idle keep-alive connections time out so stop() quiesces:
             # handle_one_request treats a socket timeout as end-of-stream
-            # and the per-connection thread exits
+            # and the per-connection thread exits. This short window applies
+            # only BETWEEN requests — do_POST widens it while a request body
+            # is in flight, so a slow sender isn't dropped mid-upload.
             timeout = 5.0
+            body_timeout = 60.0
 
             def do_POST(self):  # noqa: N802 — http.server API
+                # the idle timeout covered the wait for the request line;
+                # reading the body gets the slow-sender grace window, and
+                # the finally below restores the idle window for keep-alive
+                self.connection.settimeout(self.body_timeout)
+                try:
+                    self._handle_post()
+                finally:
+                    self.connection.settimeout(self.timeout)
+
+            def _handle_post(self):
                 with outer._counter_lock:
                     outer.requests_seen += 1
                 if self.headers.get("Transfer-Encoding"):

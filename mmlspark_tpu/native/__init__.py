@@ -133,6 +133,11 @@ def csv_parse(data: bytes, offsets: np.ndarray, n_cols: int,
     lib = get_lib()
     if lib is None:
         return None
+    if len(delimiter) != 1 or ord(delimiter) > 127:
+        # the C parser splits on ONE byte; a multi-byte UTF-8 delimiter
+        # would split rows on its first byte only — callers must route
+        # non-ASCII delimiters to the csv-module slow path
+        return None
     offs = np.ascontiguousarray(offsets, np.int64)
     n_rows = len(offs) - 1
     out = np.empty((n_rows, n_cols), np.float64)
