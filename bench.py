@@ -1,4 +1,4 @@
-"""Headline benchmarks for the two north-star paths (BASELINE.md):
+"""Headline benchmarks for the north-star paths (BASELINE.md):
 
 1. GBDT fit throughput (rows/sec) on an Adult-Census-scale binary
    classification workload — the reference's `LightGBMClassifier.fit`
@@ -6,17 +6,31 @@
 2. Deep-model-runner inference throughput (images/sec) on a CIFAR10-scale
    ResNet forward — the reference's `CNTKModel.transform`
    (CNTKModel.scala:497-503) on the CIFAR10 notebook.
+3. DNN training throughput (images/sec) on a ResNet-50 fine-tune —
+   BASELINE config #4, the reference's `CNTKLearner.fit` via mpirun+CNTK
+   (CNTKLearner.scala:169-183, CommandBuilders.scala:241-243).
+4. Continuous-serving latency p50/p99 — the reference's ~1 ms claim
+   (docs/mmlspark-serving.md:10-11).
 
-Backend selection is fail-soft: the real TPU backend is probed in a
-SUBPROCESS with a hard timeout first (round-1 postmortem: the driver's run
-died inside `jax.devices()` backend init, BENCH_r01.json rc=1, and probes
-can also hang rather than raise), and on any probe failure the benchmark
-falls back to the CPU backend instead of crashing.
+Utilization is first-class: every compute-bound family reports achieved
+TFLOP/s and MFU (model FLOPs utilization = achieved / chip peak bf16), and
+the memory-bound GBDT fit reports a modeled HBM traffic figure against the
+chip's bandwidth. FLOPs come from XLA's own cost analysis of the exact
+compiled program where available, with analytic fallbacks.
+
+Backend selection is fail-soft twice over:
+  * the real-device backend is probed in a SUBPROCESS with a hard timeout
+    (probes can hang rather than raise — round-1 postmortem), retrying
+    through transient tunnel outages, falling back to CPU;
+  * the MEASURED REGION is guarded too: if the backend is lost mid-run
+    (round-2 postmortem: probe succeeded, tunnel dropped, a later
+    device_put raised and the bench died rc=1), the whole bench re-executes
+    itself on the CPU backend and still emits its JSON line with rc=0.
 
 Prints ONE JSON line on stdout:
   {"metric", "value", "unit", "vs_baseline", "extra": {...}}
-The headline metric is GBDT fit throughput; the model-runner number, the
-backend actually used, and per-metric baselines ride in "extra".
+The headline metric is GBDT fit throughput; every other family, the MFU
+fields, and the backend actually used ride in "extra".
 """
 
 from __future__ import annotations
@@ -26,6 +40,7 @@ import os
 import subprocess
 import sys
 import time
+import traceback
 
 import numpy as np
 
@@ -38,17 +53,74 @@ BASELINE_ROWS_PER_SEC = 1.0e6
 # a representative notebook-scale figure is ~2k images/sec (BASELINE.md
 # publishes no absolute number either).
 BASELINE_IMAGES_PER_SEC = 2.0e3
-# Proxy for the reference's CNTKLearner ResNet CIFAR10 fine-tune: CNTK-era
-# single-GPU ResNet-20 CIFAR10 training sustained ~1.5k images/sec.
-BASELINE_TRAIN_IMAGES_PER_SEC = 1.5e3
+# Proxy for the reference's CNTKLearner ResNet-50 fine-tune (BASELINE
+# config #4): CNTK-era single-GPU ResNet-50 ImageNet-size training
+# sustained ~200 images/sec on a K80-class device.
+BASELINE_TRAIN_IMAGES_PER_SEC = 2.0e2
 
-N_ROWS = 32768          # Adult Census scale (32561 rounded to a TPU-friendly size)
+N_ROWS = int(os.environ.get("MMLSPARK_TPU_BENCH_ROWS", 32768))
 N_FEATURES = 14
 NUM_ITERATIONS = 100
 NUM_LEAVES = 31
 
-IMG_BATCH = 1024        # large batches amortize per-dispatch latency (tunnel)
+IMG_BATCH = int(os.environ.get("MMLSPARK_TPU_BENCH_IMG_BATCH", 1024))
 N_IMAGES = 8192         # CIFAR10-scale eval slice
+
+_FORCE_CPU_ENV = "MMLSPARK_TPU_BENCH_FORCE_CPU"
+
+
+# --------------------------------------------------------------------- #
+# chip model: peak numbers + XLA cost analysis                          #
+# --------------------------------------------------------------------- #
+
+# (substring of device_kind lower) -> (peak bf16 TFLOP/s, HBM GB/s) per chip.
+# Public TPU spec-sheet numbers; "lite" matches v5e ("TPU v5 lite") and
+# v6e ("TPU v6 lite") via the more specific keys first.
+_CHIP_PEAKS = [
+    ("v6 lite", (918.0, 1640.0)),
+    ("v6e", (918.0, 1640.0)),
+    ("v5 lite", (197.0, 819.0)),
+    ("v5e", (197.0, 819.0)),
+    ("v5p", (459.0, 2765.0)),
+    ("v5", (459.0, 2765.0)),
+    ("v4", (275.0, 1228.0)),
+    ("v3", (123.0, 900.0)),
+    ("v2", (45.0, 700.0)),
+]
+
+
+def chip_peaks() -> "tuple[str, float | None, float | None]":
+    """(device_kind, peak bf16 TFLOP/s, HBM GB/s); Nones off-TPU."""
+    import jax
+
+    dev = jax.devices()[0]
+    kind = str(getattr(dev, "device_kind", dev.platform))
+    if dev.platform == "cpu":
+        return kind, None, None
+    low = kind.lower()
+    for key, peaks in _CHIP_PEAKS:
+        if key in low:
+            return kind, peaks[0], peaks[1]
+    return kind, None, None
+
+
+def flops_of(jitted, *args) -> "float | None":
+    """XLA's own FLOP count for the exact compiled program (None when the
+    backend doesn't report cost analysis)."""
+    try:
+        cost = jitted.lower(*args).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        f = float(cost.get("flops", 0.0))
+        return f if f > 0 else None
+    except Exception:  # noqa: BLE001 — cost analysis is best-effort
+        return None
+
+
+def _mfu(tflops_achieved: "float | None", peak: "float | None") -> "float | None":
+    if not tflops_achieved or not peak:
+        return None
+    return round(tflops_achieved / peak, 4)
 
 
 def _probe_backend(timeout_s: float = 180.0, attempts: int = 5,
@@ -58,7 +130,7 @@ def _probe_backend(timeout_s: float = 180.0, attempts: int = 5,
     out TRANSIENT device-tunnel outages (observed mid-session: the tunnel
     dropped for a stretch and probes timed out) — only consistent failure
     falls back to CPU."""
-    if os.environ.get("MMLSPARK_TPU_BENCH_FORCE_CPU"):
+    if os.environ.get(_FORCE_CPU_ENV):
         return "cpu"
     attempts = int(os.environ.get("MMLSPARK_TPU_BENCH_PROBE_ATTEMPTS", attempts))
     code = (
@@ -109,7 +181,12 @@ def make_dataset(n: int, f: int, seed: int = 7):
     return x, y
 
 
-def bench_gbdt() -> dict:
+# --------------------------------------------------------------------- #
+# families                                                              #
+# --------------------------------------------------------------------- #
+
+
+def bench_gbdt(hbm_peak_gbps: "float | None") -> dict:
     from mmlspark_tpu.gbdt.booster import Booster, TrainOptions
 
     x, y = make_dataset(N_ROWS, N_FEATURES)
@@ -138,11 +215,75 @@ def bench_gbdt() -> dict:
     acc = float(((pred > 0.5) == (y > 0.5)).mean())
     assert acc > 0.7, f"model failed to learn (acc={acc:.3f})"
 
+    # The algorithm's irreducible traffic is re-reading the (n, F) binned
+    # matrix (int32) + grad/hess for the histogram build of each split step
+    # ((num_leaves-1) masked full passes per tree). Reporting that modeled
+    # traffic against the chip's bandwidth shows where this config sits:
+    # at Adult-Census scale the whole matrix is ~2 MB, so the fit is
+    # dispatch/serialization-bound, NOT bandwidth-bound — the large-config
+    # fit below is where the bandwidth story (and rows/sec) scales up.
+    bins_bytes = N_ROWS * N_FEATURES * 4
+    per_pass = bins_bytes + N_ROWS * 4 * 2           # bins + grad + hess
+    modeled_gb = NUM_ITERATIONS * (NUM_LEAVES - 1) * per_pass / 1e9
+    gbps = modeled_gb / elapsed
     rows_per_sec = N_ROWS * NUM_ITERATIONS / elapsed
-    return {"rows_per_sec": rows_per_sec, "fit_seconds": elapsed, "acc": acc}
+    return {
+        "rows_per_sec": rows_per_sec,
+        "fit_seconds": elapsed,
+        "acc": acc,
+        "modeled_hbm_gbps": gbps,
+        "modeled_hbm_frac_of_peak": (
+            round(gbps / hbm_peak_gbps, 4) if hbm_peak_gbps else None
+        ),
+    }
 
 
-def bench_model_runner() -> dict:
+def bench_gbdt_large(hbm_peak_gbps: "float | None") -> "dict | None":
+    """Higgs-scale fit (1M rows x 28 features, the reference's
+    docs/lightgbm.md:17-21 workload shape): rows/sec at a size where the
+    per-split fixed costs amortize and HBM traffic is the real limiter.
+    Device-only — the CPU fallback would take minutes for no insight."""
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return None
+    from mmlspark_tpu.gbdt.booster import Booster, TrainOptions
+
+    n, f, iters, leaves = 1 << 20, 28, 50, 63
+    x, y = make_dataset_wide(n, f)
+    opts = TrainOptions(objective="binary", num_iterations=iters,
+                        num_leaves=leaves, learning_rate=0.1)
+    Booster.train(x, y, opts)                        # compile warm-up
+    t0 = time.perf_counter()
+    booster = Booster.train(x, y, opts)
+    elapsed = time.perf_counter() - t0
+    pred = booster.predict(x[:65536])
+    acc = float(((pred > 0.5) == (y[:65536] > 0.5)).mean())
+    per_pass = n * f * 4 + n * 4 * 2
+    gbps = iters * (leaves - 1) * per_pass / 1e9 / elapsed
+    return {
+        "rows_per_sec": n * iters / elapsed,
+        "fit_seconds": elapsed,
+        "acc": acc,
+        "modeled_hbm_gbps": gbps,
+        "modeled_hbm_frac_of_peak": (
+            round(gbps / hbm_peak_gbps, 4) if hbm_peak_gbps else None
+        ),
+    }
+
+
+def make_dataset_wide(n: int, f: int, seed: int = 9):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, f)).astype(np.float32)
+    logits = x[:, 0] - 0.6 * x[:, 1] + 0.3 * x[:, 2] * x[:, 3] + 0.2 * x[:, 4]
+    y = (logits + rng.normal(scale=0.9, size=n) > 0).astype(np.float64)
+    return x.astype(np.float64), y
+
+
+def bench_model_runner(peak_tflops: "float | None") -> dict:
+    import jax
+    import jax.numpy as jnp
+
     from mmlspark_tpu.core.schema import Table
     from mmlspark_tpu.nn.models import ModelBundle
     from mmlspark_tpu.nn.runner import DeepModelTransformer
@@ -151,14 +292,16 @@ def bench_model_runner() -> dict:
         "resnet20_cifar", input_shape=(32, 32, 3), seed=0,
         preprocess={"mean": 127.5, "std": 63.75},
     )
+    # bfloat16 forward: MXU-native (the reference's CNTK evaluator runs
+    # f32 on GPU; bf16 is the TPU-idiomatic inference dtype)
     runner = DeepModelTransformer(
-        input_col="image", mini_batch_size=IMG_BATCH,
+        input_col="image", mini_batch_size=IMG_BATCH, bfloat16=True,
     ).set_model(bundle)
 
     # images ship as uint8 (what decode produces) and are normalized ON
     # DEVICE via bundle.preprocess — 4x fewer host->device bytes, which is
     # the dominant cost of a batched transform (HBM/transfer-bound, not
-    # MXU-bound: the resident forward runs at >100k img/s on this chip)
+    # MXU-bound: see the resident_* ceiling fields)
     rng = np.random.default_rng(3)
     images = rng.integers(0, 256, size=(N_IMAGES, 32, 32, 3), dtype=np.uint8)
     table = Table({"image": images})
@@ -175,62 +318,76 @@ def bench_model_runner() -> dict:
     elapsed = time.perf_counter() - t0
     assert probs.shape[0] == N_IMAGES and np.isfinite(probs).all()
 
-    # compute ceiling: the same forward on device-RESIDENT data — the gap to
-    # the end-to-end number is host<->device transfer, not MXU time
-    import jax
-    import jax.numpy as jnp
+    # compute ceiling: the same bf16 forward on device-RESIDENT data — the
+    # gap to the end-to-end number is host<->device transfer, not MXU time
+    bf16_vars = jax.tree.map(
+        lambda a: a.astype(jnp.bfloat16)
+        if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a,
+        bundle.variables,
+    )
 
     @jax.jit
     def fwd(v, xb):
         xf = (xb.astype(jnp.float32) - 127.5) / 63.75
-        return bundle.module.apply(v, xf, train=False)
+        return bundle.module.apply(v, xf.astype(jnp.bfloat16), train=False)
 
     xd = jax.device_put(images)
-    jax.block_until_ready(fwd(bundle.variables, xd[:IMG_BATCH]))
+    jax.block_until_ready(fwd(bf16_vars, xd[:IMG_BATCH]))
     t0 = time.perf_counter()
-    outs = [fwd(bundle.variables, xd[i:i + IMG_BATCH])
+    outs = [fwd(bf16_vars, xd[i:i + IMG_BATCH])
             for i in range(0, N_IMAGES, IMG_BATCH)]
     np.asarray(jnp.concatenate(outs))
     resident = N_IMAGES / (time.perf_counter() - t0)
-    # ResNet-20 CIFAR forward ~= 8.2e7 FLOPs/img (2 * ~41M MACs)
-    tflops = resident * 8.2e7 / 1e12
+
+    # FLOPs from XLA's cost model of the exact compiled forward; analytic
+    # fallback: ResNet-20 CIFAR forward ~= 8.2e7 FLOPs/img (2 * ~41M MACs)
+    step_flops = flops_of(fwd, bf16_vars, xd[:IMG_BATCH])
+    per_img = (step_flops / IMG_BATCH) if step_flops else 8.2e7
+    tflops = resident * per_img / 1e12
     return {
         "images_per_sec": N_IMAGES / elapsed,
         "transform_seconds": elapsed,
         "resident_images_per_sec": resident,
         "resident_tflops": tflops,
+        "resident_mfu": _mfu(tflops, peak_tflops),
+        "flops_per_image": per_img,
     }
 
 
-def bench_trainer() -> dict:
-    """DNN training throughput (images/sec) on a CIFAR10-scale ResNet
-    fine-tune — BASELINE config #4 (the reference trains out-of-band via
-    mpirun+CNTK, CNTKLearner.scala:169-183; here it is one jitted epoch scan
-    per dispatch). Timed as fit(1+k) - fit(1): the compile cost appears in
-    both and cancels, leaving k steady-state epochs. Sizes are
-    backend-dependent — the real measurement (4096 images, k=3) runs on
-    the device; the CPU fallback is a small smoke run (256 images, k=1),
+def bench_trainer(peak_tflops: "float | None") -> dict:
+    """ResNet-50 fine-tune throughput (images/sec) — BASELINE config #4
+    (the reference trains out-of-band via mpirun+CNTK,
+    CNTKLearner.scala:169-183; here it is one jitted epoch scan per
+    dispatch, bf16 compute / f32 params). Timed as fit(1+k) - fit(1): the
+    compile cost appears in both and cancels, leaving k steady-state
+    epochs. The real measurement (224x224 inputs, CIFAR-style 10-class
+    head) runs on the device; the CPU fallback is a small 32x32 smoke run,
     not a meaningful throughput number."""
     import jax
+    import jax.numpy as jnp
+    import optax
 
     from mmlspark_tpu.core.schema import Table
     from mmlspark_tpu.nn.trainer import DNNLearner
 
-    # CPU fallback is a smoke run, not a measurement: a ResNet epoch over
-    # 4096 CIFAR images takes ~10 min/epoch on one CPU core
     on_cpu = jax.default_backend() == "cpu"
-    n, classes = (256 if on_cpu else 4096), 10
-    bs = 128 if on_cpu else 512
-    extra_epochs = 1 if on_cpu else 3
+    side = 32 if on_cpu else 224
+    n = 64 if on_cpu else 1024
+    bs = 32 if on_cpu else 128
+    extra_epochs = 1 if on_cpu else 2
+    classes = 10
     rng = np.random.default_rng(5)
-    x = rng.normal(size=(n, 32, 32, 3)).astype(np.float32)
+    # uint8 images: 4x smaller host table (fits the fused-epoch on-device
+    # budget at 224x224), cast to compute dtype inside the model
+    x = rng.integers(0, 256, size=(n, side, side, 3), dtype=np.uint8)
     y = rng.integers(0, classes, size=n).astype(np.float64)
     tbl = Table({"features": x, "label": y})
 
     def fit(epochs):
         learner = DNNLearner(
-            architecture="resnet20_cifar", epochs=epochs, batch_size=bs,
-            use_mesh=False, seed=0,
+            architecture="resnet50", epochs=epochs, batch_size=bs,
+            model_config={"num_outputs": classes},
+            use_mesh=False, seed=0, bfloat16=True,
         )
         t0 = time.perf_counter()
         learner.fit(tbl)
@@ -239,8 +396,42 @@ def bench_trainer() -> dict:
     t1 = fit(1)
     tn = fit(1 + extra_epochs)
     steady = max(tn - t1, 1e-9)
-    return {"train_images_per_sec": n * extra_epochs / steady,
-            "epoch1_seconds": t1, "steady_epochs_seconds": steady}
+    img_per_sec = n * extra_epochs / steady
+
+    # train-step FLOPs: XLA cost analysis of a same-shape value_and_grad
+    # step on the same module (the learner's internal step is identical
+    # math); analytic fallback ~3x the 4.1 GFLOP fwd at 224 (scaled by
+    # side^2) per image.
+    from mmlspark_tpu.nn.models import make_model
+
+    module = make_model("resnet50", num_outputs=classes, dtype=jnp.bfloat16)
+    xb = jnp.asarray(x[:bs])
+    variables = module.init(jax.random.PRNGKey(0), xb.astype(jnp.float32))
+    params, batch_stats = variables["params"], variables.get("batch_stats", {})
+    yb = jnp.asarray(y[:bs], jnp.int32)
+
+    def loss_fn(p):
+        logits, _ = module.apply(
+            {"params": p, "batch_stats": batch_stats},
+            xb.astype(jnp.float32), train=True, mutable=["batch_stats"],
+        )
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), yb
+        ).mean()
+
+    step = jax.jit(jax.value_and_grad(loss_fn))
+    step_flops = flops_of(step, params)
+    per_img = (step_flops / bs) if step_flops else 3 * 4.1e9 * (side / 224) ** 2
+    tflops = img_per_sec * per_img / 1e12
+    return {
+        "train_images_per_sec": img_per_sec,
+        "epoch1_seconds": t1,
+        "steady_epochs_seconds": steady,
+        "train_tflops": tflops,
+        "train_mfu": _mfu(tflops, peak_tflops),
+        "image_side": side,
+        "smoke_only": on_cpu,
+    }
 
 
 def bench_serving() -> dict:
@@ -291,6 +482,121 @@ def _resolve_kernel_name() -> str:
     return resolve("gbdt_histogram").__name__
 
 
+# --------------------------------------------------------------------- #
+# orchestration                                                         #
+# --------------------------------------------------------------------- #
+
+
+def _run_suite(platform: str) -> dict:
+    chip, peak_tflops, peak_gbps = chip_peaks()
+
+    try:
+        gbdt = bench_gbdt(peak_gbps)
+    except Exception as e:  # noqa: BLE001 — kernel-mode insurance
+        # the Pallas histogram kernel is selected automatically on TPU; if
+        # it fails to compile/run on this chip, fall back to the XLA kernel
+        # rather than losing the benchmark. (A DEAD backend will fail again
+        # below and trip the whole-suite CPU fallback in main().)
+        print(f"bench: gbdt failed under auto kernel mode ({e!r}); "
+              "retrying with kernel mode 'xla'", file=sys.stderr)
+        from mmlspark_tpu.core.kernels import set_kernel_mode
+
+        set_kernel_mode("xla")
+        gbdt = bench_gbdt(peak_gbps)
+    try:
+        gbdt_large = bench_gbdt_large(peak_gbps)
+    except Exception as e:  # noqa: BLE001 — scale config is auxiliary
+        print(f"bench: large gbdt bench failed ({e!r})", file=sys.stderr)
+        gbdt_large = None
+    try:
+        runner = bench_model_runner(peak_tflops)
+    except Exception as e:  # noqa: BLE001 — never lose the line
+        import jax
+
+        if jax.default_backend() != "cpu":
+            raise  # backend may be lost mid-run; main() re-execs on CPU
+        print(f"bench: model-runner bench failed ({e!r})", file=sys.stderr)
+        traceback.print_exc()
+        runner = {"images_per_sec": 0.0, "transform_seconds": 0.0,
+                  "resident_images_per_sec": 0.0, "resident_tflops": 0.0,
+                  "resident_mfu": None, "flops_per_image": 0.0}
+    try:
+        trainer = bench_trainer(peak_tflops)
+    except Exception as e:  # noqa: BLE001 — auxiliary; never lose the line
+        print(f"bench: trainer bench failed ({e!r})", file=sys.stderr)
+        traceback.print_exc()
+        trainer = None
+    try:
+        serving = bench_serving()
+    except Exception as e:  # noqa: BLE001 — latency is auxiliary
+        print(f"bench: serving latency bench failed ({e!r})", file=sys.stderr)
+        serving = None
+
+    resident = runner.get("resident_images_per_sec", 0.0)
+    mfu_note = (
+        f"runner resident MFU {runner.get('resident_mfu')}"
+        if runner.get("resident_mfu") is not None else "MFU n/a off-TPU"
+    )
+    return {
+        "metric": "gbdt_fit_throughput",
+        "value": round(gbdt["rows_per_sec"], 1),
+        "unit": "rows/sec",
+        "vs_baseline": round(gbdt["rows_per_sec"] / BASELINE_ROWS_PER_SEC, 3),
+        "extra": {
+            "platform": platform,
+            "chip": chip,
+            "chip_peak_bf16_tflops": peak_tflops,
+            "chip_peak_hbm_gbps": peak_gbps,
+            "gbdt_histogram_kernel": _resolve_kernel_name(),
+            "gbdt_fit_seconds": round(gbdt["fit_seconds"], 3),
+            "gbdt_train_acc": round(gbdt["acc"], 4),
+            "gbdt_baseline_rows_per_sec": BASELINE_ROWS_PER_SEC,
+            "gbdt_modeled_hbm_gbps": round(gbdt["modeled_hbm_gbps"], 2),
+            "gbdt_modeled_hbm_frac_of_peak": gbdt["modeled_hbm_frac_of_peak"],
+            "gbdt_large_rows_per_sec": round(
+                gbdt_large["rows_per_sec"], 1) if gbdt_large else None,
+            "gbdt_large_fit_seconds": round(
+                gbdt_large["fit_seconds"], 3) if gbdt_large else None,
+            "gbdt_large_train_acc": round(
+                gbdt_large["acc"], 4) if gbdt_large else None,
+            "gbdt_large_modeled_hbm_gbps": round(
+                gbdt_large["modeled_hbm_gbps"], 2) if gbdt_large else None,
+            "gbdt_large_modeled_hbm_frac_of_peak": (
+                gbdt_large["modeled_hbm_frac_of_peak"] if gbdt_large else None),
+            "model_runner_images_per_sec": round(runner["images_per_sec"], 1),
+            "model_runner_vs_baseline": round(
+                runner["images_per_sec"] / BASELINE_IMAGES_PER_SEC, 3),
+            "model_runner_baseline_images_per_sec": BASELINE_IMAGES_PER_SEC,
+            "model_runner_resident_images_per_sec": round(resident, 1),
+            "model_runner_resident_tflops": round(
+                runner.get("resident_tflops", 0.0), 3),
+            "model_runner_resident_mfu": runner.get("resident_mfu"),
+            "model_runner_flops_per_image": round(
+                runner.get("flops_per_image", 0.0)),
+            "trainer_images_per_sec": round(
+                trainer["train_images_per_sec"], 1) if trainer else None,
+            "trainer_vs_baseline": round(
+                trainer["train_images_per_sec"] / BASELINE_TRAIN_IMAGES_PER_SEC,
+                3) if trainer else None,
+            "trainer_baseline_images_per_sec": BASELINE_TRAIN_IMAGES_PER_SEC,
+            "trainer_tflops": round(
+                trainer.get("train_tflops", 0.0), 3) if trainer else None,
+            "trainer_mfu": trainer.get("train_mfu") if trainer else None,
+            "trainer_image_side": trainer.get("image_side") if trainer else None,
+            "trainer_smoke_only": trainer.get("smoke_only") if trainer else None,
+            "serving_p50_ms": round(serving["p50_ms"], 3) if serving else None,
+            "serving_p99_ms": round(serving["p99_ms"], 3) if serving else None,
+            "headroom_note": (
+                "gbdt fit is HBM-bound (see gbdt_modeled_hbm_* vs chip peak); "
+                "end-to-end runner throughput is host->device transfer bound: "
+                f"the device-resident bf16 forward runs "
+                f"{resident / max(runner['images_per_sec'], 1):.1f}x faster; "
+                f"{mfu_note}"
+            ),
+        },
+    }
+
+
 def main() -> None:
     backend = _probe_backend()
     import jax
@@ -300,71 +606,26 @@ def main() -> None:
         # jax_platforms); the config update below is what wins
         jax.config.update("jax_platforms", "cpu")
 
-    platform = jax.devices()[0].platform
-    print(f"bench: running on {platform} ({len(jax.devices())} device(s))",
-          file=sys.stderr)
-
     try:
-        gbdt = bench_gbdt()
-    except Exception as e:  # noqa: BLE001 — kernel-mode insurance
-        # the Pallas histogram kernel is selected automatically on TPU; if
-        # it fails to compile/run on this chip, fall back to the XLA kernel
-        # rather than losing the benchmark
-        print(f"bench: gbdt failed under auto kernel mode ({e!r}); "
-              "retrying with kernel mode 'xla'", file=sys.stderr)
-        from mmlspark_tpu.core.kernels import set_kernel_mode
-
-        set_kernel_mode("xla")
-        gbdt = bench_gbdt()
-    runner = bench_model_runner()
-    try:
-        trainer = bench_trainer()
-    except Exception as e:  # noqa: BLE001 — auxiliary; never lose the line
-        print(f"bench: trainer bench failed ({e!r})", file=sys.stderr)
-        trainer = None
-    try:
-        serving = bench_serving()
-    except Exception as e:  # noqa: BLE001 — latency is auxiliary; never lose the line
-        print(f"bench: serving latency bench failed ({e!r})", file=sys.stderr)
-        serving = None
-
-    print(json.dumps({
-        "metric": "gbdt_fit_throughput",
-        "value": round(gbdt["rows_per_sec"], 1),
-        "unit": "rows/sec",
-        "vs_baseline": round(gbdt["rows_per_sec"] / BASELINE_ROWS_PER_SEC, 3),
-        "extra": {
-            "platform": platform,
-            "gbdt_histogram_kernel": _resolve_kernel_name(),
-            "gbdt_fit_seconds": round(gbdt["fit_seconds"], 3),
-            "gbdt_train_acc": round(gbdt["acc"], 4),
-            "gbdt_baseline_rows_per_sec": BASELINE_ROWS_PER_SEC,
-            "model_runner_images_per_sec": round(runner["images_per_sec"], 1),
-            "model_runner_vs_baseline": round(
-                runner["images_per_sec"] / BASELINE_IMAGES_PER_SEC, 3),
-            "model_runner_baseline_images_per_sec": BASELINE_IMAGES_PER_SEC,
-            "model_runner_resident_images_per_sec": round(
-                runner.get("resident_images_per_sec", 0.0), 1),
-            "model_runner_resident_tflops": round(
-                runner.get("resident_tflops", 0.0), 3),
-            "trainer_images_per_sec": round(
-                trainer["train_images_per_sec"], 1) if trainer else None,
-            "trainer_vs_baseline": round(
-                trainer["train_images_per_sec"] / BASELINE_TRAIN_IMAGES_PER_SEC,
-                3) if trainer else None,
-            "trainer_baseline_images_per_sec": BASELINE_TRAIN_IMAGES_PER_SEC,
-            "serving_p50_ms": round(serving["p50_ms"], 3) if serving else None,
-            "serving_p99_ms": round(serving["p99_ms"], 3) if serving else None,
-            "headroom_note": (
-                "end-to-end runner throughput is host->device transfer bound: "
-                f"the device-resident forward runs "
-                f"{runner['resident_images_per_sec'] / max(runner['images_per_sec'], 1):.1f}x "
-                "faster (see resident_* fields); gbdt fit is one fused XLA "
-                "program per config — remaining headroom is histogram-kernel "
-                "tiling and multi-chip scaling"
-            ),
-        },
-    }))
+        platform = jax.devices()[0].platform
+        print(f"bench: running on {platform} ({len(jax.devices())} device(s))",
+              file=sys.stderr)
+        line = _run_suite(platform)
+    except Exception:
+        if backend != "cpu" and not os.environ.get(_FORCE_CPU_ENV):
+            # backend lost mid-run (or any non-CPU failure): the process's
+            # jax backend state is poisoned, so re-execute the whole bench
+            # in a fresh process pinned to CPU — the JSON line must land
+            # with rc=0 even through a tunnel outage
+            print("bench: non-CPU run failed; re-executing on CPU fallback",
+                  file=sys.stderr)
+            traceback.print_exc()
+            env = dict(os.environ, **{_FORCE_CPU_ENV: "1"})
+            child = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                                   env=env)
+            sys.exit(child.returncode)
+        raise
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

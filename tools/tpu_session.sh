@@ -25,6 +25,16 @@ else
   rc=1
 fi
 
+echo "== batch sweep (runner fwd + resnet50 trainer step) =="
+if timeout 1800 python -u tools/sweep_batch.py --out "$OUT/batch_sweep.csv" \
+    > "$OUT/batch_sweep.txt" 2>&1; then
+  tail -12 "$OUT/batch_sweep.txt"
+else
+  echo "BATCH SWEEP FAILED (rc=$?) — tail of $OUT/batch_sweep.txt:"
+  tail -5 "$OUT/batch_sweep.txt"
+  rc=1
+fi
+
 echo "== bench =="
 if timeout 2400 python bench.py > "$OUT/bench.json" 2> "$OUT/bench.err"; then
   tail -1 "$OUT/bench.json"
