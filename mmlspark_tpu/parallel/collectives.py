@@ -198,9 +198,14 @@ def psum_exact_fixedpoint(x, axis_name: str, *, n_shards: int | None = None):
         max_abs = max_abs[(None,) * (x.ndim - 1) + (slice(None),)]
     else:
         max_abs = lax.pmax(jnp.max(jnp.abs(x)), axis_name)
-    # worst case |sum of partials| <= n_shards * max_abs -> keep below 2^23
-    denom = jnp.maximum(max_abs * n_shards, jnp.finfo(jnp.float32).tiny)
-    scale = jnp.where(max_abs > 0, (2.0 ** 23) / denom, 1.0)
+    # worst case |sum of partials| <= n_shards * max_abs -> keep below 2^23.
+    # Two-step division (never forming max_abs * n_shards, which overflows
+    # float32 for max_abs > ~4e37) plus a floor on max_abs (keeps scale
+    # finite for denormal-tiny inputs): |x * scale| <= 2^23 / n_shards by
+    # construction, so the quantized partials can never overflow either.
+    per_shard_budget = (2.0 ** 23) / n_shards
+    scale = per_shard_budget / jnp.maximum(max_abs, 2.0 ** -100)
+    scale = jnp.where(max_abs > 0, scale, 1.0)
     q = jnp.round(x * scale)                  # integer-valued float32
     total = lax.psum(q, axis_name)            # exact: all partials < 2^24
     return total / scale
