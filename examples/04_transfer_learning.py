@@ -1,37 +1,124 @@
-"""Transfer learning — the `DeepLearning - Transfer Learning` notebook flow:
-featurize images with a truncated pretrained network (ImageFeaturizer), then
-train a cheap downstream model on the embeddings.
+"""Transfer learning — the `DeepLearning - Transfer Learning` notebook flow,
+off IMPORTED external-format pretrained weights:
+
+1. a torch-layout ResNet-50 checkpoint (`.safetensors` state dict — the
+   de-facto published-weights format) is ingested through the model zoo
+   (`ModelDownloader.import_external`, the reference's remote-repo pull,
+   ModelDownloader.scala:209+),
+2. `ImageFeaturizer` cuts the network at the pooled features
+   (ImageFeaturizer.scala:92-135),
+3. a cheap downstream GBDT trains on the embeddings, and
+4. `DNNLearner` fine-tunes ONLY the head (trainable_prefixes — the
+   cutOutputLayers retrain story).
+
+The checkpoint here is synthetically generated in torchvision's exact
+naming/layout (this environment has no network egress); with real published
+weights the flow is byte-for-byte the same.
 """
 
 import _backend  # noqa: F401 — honors JAX_PLATFORMS=cpu (see _backend.py)
 
+import os
+import tempfile
+
 import numpy as np
 
-from mmlspark_tpu.core.schema import Table
-from mmlspark_tpu.gbdt import GBDTClassifier
-from mmlspark_tpu.nn import ImageFeaturizer, ModelBundle
+
+def synthetic_torchvision_resnet50(seed: int = 0) -> dict:
+    """A state dict in torchvision resnet50's exact naming and layouts
+    (OIHW convs, (out,in) fc, running BN stats)."""
+    rng = np.random.default_rng(seed)
+    sd = {"conv1.weight": (64, 3, 7, 7)}
+    inplanes = 64
+    for li, (blocks, planes) in enumerate(
+        [(3, 64), (4, 128), (6, 256), (3, 512)], start=1
+    ):
+        for b in range(blocks):
+            p = f"layer{li}.{b}"
+            sd[f"{p}.conv1.weight"] = (planes, inplanes, 1, 1)
+            sd[f"{p}.conv2.weight"] = (planes, planes, 3, 3)
+            sd[f"{p}.conv3.weight"] = (planes * 4, planes, 1, 1)
+            for bn, w in (("bn1", planes), ("bn2", planes), ("bn3", planes * 4)):
+                for leaf in ("weight", "bias", "running_mean", "running_var"):
+                    sd[f"{p}.{bn}.{leaf}"] = (w,)
+            if b == 0:
+                sd[f"{p}.downsample.0.weight"] = (planes * 4, inplanes, 1, 1)
+                for leaf in ("weight", "bias", "running_mean", "running_var"):
+                    sd[f"{p}.downsample.1.{leaf}"] = (planes * 4,)
+            inplanes = planes * 4
+    for bn_leaf in ("weight", "bias", "running_mean", "running_var"):
+        sd[f"bn1.{bn_leaf}"] = (64,)
+    sd["fc.weight"] = (1000, 2048)
+    sd["fc.bias"] = (1000,)
+    out = {}
+    for name, shape in sd.items():
+        if name.endswith("running_var"):
+            out[name] = (0.5 + np.abs(rng.standard_normal(shape))).astype(np.float32)
+        elif name.endswith(".weight") and len(shape) == 4:
+            fan_in = int(np.prod(shape[1:]))
+            out[name] = (rng.standard_normal(shape) / np.sqrt(fan_in)).astype(np.float32)
+        else:
+            out[name] = (0.1 * rng.standard_normal(shape)).astype(np.float32)
+    return out
 
 
 def main():
-    rng = np.random.default_rng(5)
-    n, classes = 256, 3
-    y = rng.integers(0, classes, size=n).astype(np.float64)
-    x = rng.normal(size=(n, 16, 16, 3)).astype(np.float32)
-    x[..., 0] += y[:, None, None] * 2.0       # class signal in channel 0
+    from safetensors.numpy import save_file
 
-    base = ModelBundle.init("resnet20_cifar", (16, 16, 3), num_outputs=10)
-    featurizer = ImageFeaturizer(
-        input_col="image", output_col="features", cut_output_layers=1,
-    ).set_model(base)
+    from mmlspark_tpu.core.schema import Table
+    from mmlspark_tpu.gbdt import GBDTClassifier
+    from mmlspark_tpu.nn import DNNLearner, ImageFeaturizer
+    from mmlspark_tpu.nn.zoo import ModelDownloader, ModelSchema
 
-    table = Table({"image": x, "label": y})
-    feats = featurizer.transform(table)
-    model = feats.ml_fit(GBDTClassifier(num_iterations=30, num_leaves=15,
-                                        objective="multiclass"))
-    pred = np.asarray(model.transform(feats)["prediction"], np.float64)
-    acc = float((pred == y).mean())
-    print(f"transfer-learning train accuracy over {classes} classes: {acc:.3f}")
-    assert acc > 0.8
+    with tempfile.TemporaryDirectory() as tmp:
+        # -- 1. ingest the external checkpoint through the zoo ----------
+        ckpt = os.path.join(tmp, "resnet50_imagenet.safetensors")
+        save_file(synthetic_torchvision_resnet50(), ckpt)
+        zoo = ModelDownloader(os.path.join(tmp, "repo"))
+        zoo.import_external(ModelSchema(
+            name="resnet50_pretrained", uri=ckpt, architecture="resnet50",
+            input_shape=(64, 64, 3), num_outputs=1000,
+        ))
+        bundle = zoo.load_bundle("resnet50_pretrained")
+        print(f"imported resnet50: head {bundle.variables['params']['head']['kernel'].shape}, "
+              f"{len(bundle.layer_names())} addressable layers")
+
+        # -- 2. featurize with the truncated network --------------------
+        rng = np.random.default_rng(5)
+        n, classes = 96, 3
+        y = rng.integers(0, classes, size=n).astype(np.float64)
+        x = rng.normal(size=(n, 64, 64, 3)).astype(np.float32) * 40 + 110
+        x[..., 0] += y[:, None, None] * 55        # class signal in channel 0
+        table = Table({"image": x, "label": y})
+        featurizer = ImageFeaturizer(
+            input_col="image", output_col="features",
+            layer_name="pooled_features",
+        ).set_model(bundle)
+        feats = featurizer.transform(table)
+        emb = np.asarray(feats["features"])
+        print(f"embeddings: {emb.shape}")
+
+        # -- 3. downstream GBDT on the embeddings -----------------------
+        model = feats.ml_fit(GBDTClassifier(
+            num_iterations=30, num_leaves=15, objective="multiclass",
+            min_data_in_leaf=5,
+        ))
+        pred = np.asarray(model.transform(feats)["prediction"], np.float64)
+        acc = float((pred == y).mean())
+        print(f"GBDT-on-embeddings train accuracy over {classes} classes: {acc:.3f}")
+        assert acc > 0.8
+
+        # -- 4. fine-tune ONLY the head of the imported network ---------
+        learner = DNNLearner(
+            architecture="resnet50", epochs=2, batch_size=32,
+            trainable_prefixes=["head"], learning_rate=1e-2,
+            use_mesh=False, features_col="image",
+        )
+        learner.init_bundle = bundle
+        tuned = learner.fit(table)
+        tuned_pred = np.asarray(tuned.transform(table)["prediction"], np.float64)
+        tuned_acc = float((tuned_pred == y).mean())
+        print(f"head-only fine-tune train accuracy: {tuned_acc:.3f}")
 
 
 if __name__ == "__main__":

@@ -160,24 +160,22 @@ class ModelDownloader:
                 pass  # raced with a concurrent sweep/writer
         return removed
 
-    def download_model(self, schema: ModelSchema, force: bool = False) -> str:
-        """Fetch + verify + register; returns the local bundle path."""
+    def _fetch_verified(self, schema: ModelSchema, suffix: str = ".tmp") -> str:
+        """Fetch schema.uri into a fresh tmp in the repo and sha256-verify
+        it. Returns the tmp path (caller installs/converts then removes).
+
+        Unique tmp per attempt, and the WORKER never touches the install
+        destination: a timed-out attempt's abandoned thread can only ever
+        finish writing its own orphan tmp (age-swept by sweep_orphan_tmps
+        on later fetches) — it cannot install an unverified file behind a
+        later sha check."""
+        import tempfile
+
         self.sweep_orphan_tmps()
-        dest = self.local_path(schema.name)
-        if os.path.exists(dest) and not force:
-            return dest
-        src = schema.uri
 
         def copy():
-            # unique tmp per attempt, and the WORKER never touches dest: a
-            # timed-out attempt's abandoned thread can only ever finish
-            # writing its own orphan tmp (age-swept by sweep_orphan_tmps on
-            # later downloads) — it cannot install an unverified file at
-            # dest behind a later sha check
-            import tempfile
-
             fd, tmp = tempfile.mkstemp(
-                prefix=f".{schema.name}.", suffix=".tmp",
+                prefix=f".{schema.name}.", suffix=suffix,
                 dir=self.local_repo,
             )
             os.close(fd)
@@ -187,7 +185,7 @@ class ModelDownloader:
                 # HadoopUtils/remote-repo analogue)
                 from ..utils.storage import copy_to_local
 
-                copy_to_local(src, tmp)
+                copy_to_local(schema.uri, tmp)
             except BaseException:
                 if os.path.exists(tmp):
                     os.unlink(tmp)
@@ -203,17 +201,69 @@ class ModelDownloader:
                         f"hash mismatch for {schema.name}: got {got[:12]}…, "
                         f"want {schema.sha256[:12]}…"
                     )
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+        return tmp
+
+    def _register(self, schema: ModelSchema) -> None:
+        schemas = [s for s in self.models() if s.name != schema.name]
+        schemas.append(schema)
+        self._write_index(schemas)
+
+    def download_model(self, schema: ModelSchema, force: bool = False) -> str:
+        """Fetch + verify + register; returns the local bundle path."""
+        dest = self.local_path(schema.name)
+        if os.path.exists(dest) and not force:
+            return dest
+        tmp = self._fetch_verified(schema)
+        try:
             os.replace(tmp, dest)  # verify-then-install, main thread only
         finally:
             if os.path.exists(tmp):
                 os.unlink(tmp)
-        schemas = [s for s in self.models() if s.name != schema.name]
-        schemas.append(schema)
-        self._write_index(schemas)
+        self._register(schema)
         return dest
 
     def load_bundle(self, name: str) -> ModelBundle:
         return ModelBundle.load(self.local_path(name))
+
+    def import_external(self, schema: ModelSchema, force: bool = False) -> str:
+        """Fetch EXTERNAL-format pretrained weights (torch-layout
+        `.safetensors`/`.npz` state dict at `schema.uri`), convert them to a
+        native ModelBundle, and register the model — the reference's
+        remote-repo ingestion of published CNTK models
+        (ModelDownloader.scala:209+, Schema.scala:30-119). The artifact is
+        sha256-verified BEFORE conversion; the converted bundle is what
+        lands in the repo."""
+        dest = self.local_path(schema.name)
+        if os.path.exists(dest) and not force:
+            return dest
+        suffix = os.path.splitext(schema.uri)[1] or ".safetensors"
+        tmp = self._fetch_verified(schema, suffix=suffix + ".tmp")
+        try:
+            # the loader dispatches on extension; the verified tmp carries
+            # "<ext>.tmp", so hand it over under its real extension
+            typed = tmp[: -len(".tmp")]
+            os.replace(tmp, typed)
+            tmp = typed
+            from .import_weights import import_torch_resnet
+
+            bundle = import_torch_resnet(
+                tmp,
+                architecture=schema.architecture or "resnet50",
+                num_outputs=schema.num_outputs,
+                input_shape=tuple(schema.input_shape) or (224, 224, 3),
+                class_labels=schema.class_labels,
+                **schema.extra.get("config", {}),
+            )
+            bundle.save(dest)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        self._register(schema)
+        return dest
 
     # -- publish (the reference's uploader role) ------------------------- #
 

@@ -1,0 +1,220 @@
+"""Pretrained-weight import tests (reference ModelDownloader.scala:209+,
+ImageFeaturizer.scala:92-135 — the transfer-learning ingestion story).
+
+The synthetic checkpoint is generated from the DOCUMENTED torchvision
+ResNet-50 topology (name/shape manifest below, written out from the
+published architecture — bottleneck expansion 4, stride-on-conv2 a.k.a.
+ResNet V1.5, downsample on each stage's first block), NOT from this
+repo's importer, so a naming/transpose bug in the importer cannot be
+self-consistent with the fixture. Expected activations are committed in
+tests/fixtures/imported_resnet50_logits.json (regen:
+MMLSPARK_TPU_REGEN_IMPORT_FIXTURE=1).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                       "imported_resnet50_logits.json")
+
+
+def torchvision_resnet50_manifest() -> "dict[str, tuple[int, ...]]":
+    """name -> shape for every tensor of a torchvision resnet50 state dict."""
+    m: dict[str, tuple[int, ...]] = {
+        "conv1.weight": (64, 3, 7, 7),
+        "bn1.weight": (64,), "bn1.bias": (64,),
+        "bn1.running_mean": (64,), "bn1.running_var": (64,),
+        "bn1.num_batches_tracked": (),
+    }
+    inplanes = 64
+    for li, (blocks, planes) in enumerate(
+        [(3, 64), (4, 128), (6, 256), (3, 512)], start=1
+    ):
+        for b in range(blocks):
+            p = f"layer{li}.{b}"
+            m[f"{p}.conv1.weight"] = (planes, inplanes, 1, 1)
+            m[f"{p}.conv2.weight"] = (planes, planes, 3, 3)
+            m[f"{p}.conv3.weight"] = (planes * 4, planes, 1, 1)
+            for bn, width in (("bn1", planes), ("bn2", planes),
+                              ("bn3", planes * 4)):
+                for leaf, shape in (("weight", (width,)), ("bias", (width,)),
+                                    ("running_mean", (width,)),
+                                    ("running_var", (width,)),
+                                    ("num_batches_tracked", ())):
+                    m[f"{p}.{bn}.{leaf}"] = shape
+            if b == 0:
+                m[f"{p}.downsample.0.weight"] = (planes * 4, inplanes, 1, 1)
+                for leaf, shape in (("weight", (planes * 4,)),
+                                    ("bias", (planes * 4,)),
+                                    ("running_mean", (planes * 4,)),
+                                    ("running_var", (planes * 4,)),
+                                    ("num_batches_tracked", ())):
+                    m[f"{p}.downsample.1.{leaf}"] = shape
+            inplanes = planes * 4
+    m["fc.weight"] = (1000, 2048)
+    m["fc.bias"] = (1000,)
+    return m
+
+
+def synthetic_state_dict(seed: int = 0) -> "dict[str, np.ndarray]":
+    rng = np.random.default_rng(seed)
+    sd: dict[str, np.ndarray] = {}
+    for name, shape in torchvision_resnet50_manifest().items():
+        if name.endswith("num_batches_tracked"):
+            sd[name] = np.asarray(100, np.int64)
+        elif name.endswith("running_var"):
+            sd[name] = (0.5 + np.abs(rng.standard_normal(shape))).astype(np.float32)
+        elif name.endswith(("conv1.weight", "conv2.weight", "conv3.weight",
+                            "downsample.0.weight")) or name == "conv1.weight":
+            fan_in = int(np.prod(shape[1:])) or 1
+            sd[name] = (rng.standard_normal(shape) / np.sqrt(fan_in)).astype(
+                np.float32)
+        else:
+            sd[name] = (0.1 * rng.standard_normal(shape)).astype(np.float32)
+    return sd
+
+
+@pytest.fixture(scope="module")
+def bundle(tmp_path_factory):
+    from mmlspark_tpu.nn.import_weights import import_torch_resnet
+
+    d = tmp_path_factory.mktemp("weights")
+    path = os.path.join(d, "resnet50.npz")
+    np.savez(path, **synthetic_state_dict())
+    # small spatial size keeps the CPU forward cheap; the mapping under
+    # test is shape/naming/transpose logic, which is size-independent
+    return import_torch_resnet(path, input_shape=(64, 64, 3))
+
+
+class TestMapping:
+    def test_all_leaves_mapped_and_shapes_fit(self, bundle):
+        # import_torch_resnet already validates leaf-for-leaf vs module.init;
+        # reaching here means every torchvision tensor found a flax home
+        assert bundle.config["num_outputs"] == 1000
+        p = bundle.variables["params"]
+        assert p["stem_conv"]["kernel"].shape == (7, 7, 3, 64)
+        assert p["stage0_block0"]["proj_conv"]["kernel"].shape == (1, 1, 64, 256)
+        assert p["head"]["kernel"].shape == (2048, 1000)
+        bs = bundle.variables["batch_stats"]
+        assert bs["stage3_block2"]["bn3"]["var"].shape == (2048,)
+
+    def test_conv_transpose_is_oihw_to_hwio(self):
+        from mmlspark_tpu.nn.import_weights import torch_resnet_to_flax
+
+        sd = synthetic_state_dict()
+        v = torch_resnet_to_flax(sd)
+        w = sd["layer2.0.conv2.weight"]            # (128, 128, 3, 3) OIHW
+        k = v["params"]["stage1_block0"]["conv2"]["kernel"]
+        assert k.shape == (3, 3, 128, 128)
+        np.testing.assert_array_equal(k[1, 2, 5, 7], w[7, 5, 1, 2])
+
+    def test_fc_transposed(self):
+        from mmlspark_tpu.nn.import_weights import torch_resnet_to_flax
+
+        sd = synthetic_state_dict()
+        v = torch_resnet_to_flax(sd)
+        np.testing.assert_array_equal(
+            v["params"]["head"]["kernel"], sd["fc.weight"].T
+        )
+
+    def test_unknown_key_raises(self):
+        from mmlspark_tpu.nn.import_weights import torch_resnet_to_flax
+
+        with pytest.raises(ValueError, match="unrecognized"):
+            torch_resnet_to_flax({"classifier.weight": np.zeros((10, 10))})
+
+    def test_missing_block_raises(self, tmp_path):
+        from mmlspark_tpu.nn.import_weights import import_torch_resnet
+
+        sd = synthetic_state_dict()
+        sd.pop("layer3.4.conv2.weight")
+        path = os.path.join(tmp_path, "broken.npz")
+        np.savez(path, **sd)
+        with pytest.raises(ValueError, match="missing"):
+            import_torch_resnet(path, input_shape=(64, 64, 3))
+
+    def test_untransposed_conv_raises(self, tmp_path):
+        """A checkpoint whose convs were written HWIO (already 'converted')
+        must be rejected, not silently double-transposed."""
+        from mmlspark_tpu.nn.import_weights import import_torch_resnet
+
+        sd = synthetic_state_dict()
+        sd["conv1.weight"] = np.transpose(sd["conv1.weight"], (2, 3, 1, 0))
+        path = os.path.join(tmp_path, "hwio.npz")
+        np.savez(path, **sd)
+        with pytest.raises(ValueError, match="shape mismatch"):
+            import_torch_resnet(path, input_shape=(64, 64, 3))
+
+
+class TestActivations:
+    def test_forward_matches_committed_fixture(self, bundle):
+        """The imported model's logits on a fixed input must match the
+        committed expected activations — a transpose/naming regression in
+        the mapper shows up as a numeric diff here."""
+        import jax
+
+        rng = np.random.default_rng(42)
+        x = rng.integers(0, 256, size=(2, 64, 64, 3)).astype(np.float32)
+        mean = np.asarray(bundle.preprocess["mean"], np.float32)
+        std = np.asarray(bundle.preprocess["std"], np.float32)
+        logits = np.asarray(jax.jit(
+            lambda v, xb: bundle.module.apply(v, (xb - mean) / std,
+                                              train=False)
+        )(bundle.variables, x))
+        assert logits.shape == (2, 1000) and np.isfinite(logits).all()
+        got = logits[:, :8].tolist()
+        if os.environ.get("MMLSPARK_TPU_REGEN_IMPORT_FIXTURE"):
+            os.makedirs(os.path.dirname(FIXTURE), exist_ok=True)
+            with open(FIXTURE, "w") as fh:
+                json.dump({"logits_2x8": got}, fh, indent=2)
+            pytest.skip("fixture regenerated")
+        assert os.path.exists(FIXTURE), (
+            "run with MMLSPARK_TPU_REGEN_IMPORT_FIXTURE=1 to create the fixture"
+        )
+        with open(FIXTURE) as fh:
+            want = np.asarray(json.load(fh)["logits_2x8"])
+        np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-4)
+
+
+class TestZooAndFeaturizer:
+    def test_zoo_import_external_roundtrip(self, tmp_path):
+        from safetensors.numpy import save_file
+
+        from mmlspark_tpu.nn.zoo import ModelDownloader, ModelSchema
+
+        src = os.path.join(tmp_path, "src", "resnet50.safetensors")
+        os.makedirs(os.path.dirname(src))
+        save_file(synthetic_state_dict(), src)
+        repo = os.path.join(tmp_path, "repo")
+        dl = ModelDownloader(repo)
+        schema = ModelSchema(
+            name="resnet50_pretrained", uri=src, architecture="resnet50",
+            input_shape=(64, 64, 3), num_outputs=1000,
+        )
+        dest = dl.import_external(schema)
+        assert os.path.exists(dest)
+        loaded = dl.load_bundle("resnet50_pretrained")
+        assert loaded.architecture == "resnet50"
+        assert loaded.variables["params"]["head"]["kernel"].shape == (2048, 1000)
+        # idempotent: second call is a no-op hit on the converted bundle
+        assert dl.import_external(schema) == dest
+
+    def test_featurizer_runs_on_imported_model(self, bundle):
+        """ImageFeaturizer over imported weights — the reference's
+        transfer-learning flow (ImageFeaturizer.scala:92-135) off a real
+        external checkpoint format."""
+        from mmlspark_tpu.core.schema import Table
+        from mmlspark_tpu.nn.featurizer import ImageFeaturizer
+
+        rng = np.random.default_rng(1)
+        imgs = rng.integers(0, 256, size=(4, 64, 64, 3), dtype=np.uint8)
+        feat = ImageFeaturizer(
+            input_col="image", output_col="features",
+            layer_name="pooled_features",
+        ).set_model(bundle)
+        out = feat.transform(Table({"image": imgs}))
+        arr = np.asarray(out["features"])
+        assert arr.shape == (4, 2048) and np.isfinite(arr).all()
