@@ -34,7 +34,8 @@ import numpy as np
 from ..core.schema import Table
 from .schema import HTTPRequestData, HTTPResponseData, make_reply, parse_request
 
-__all__ = ["ServingServer", "ServingFleet", "MicroBatchQuery", "serve_model"]
+__all__ = ["ServingServer", "ServingFleet", "MicroBatchQuery", "serve_model",
+           "ServiceInfo", "FleetRendezvous"]
 
 
 def _handler_error_response(e: Exception) -> "HTTPResponseData":
@@ -419,11 +420,183 @@ def serve_model(
     return ServingServer(handler, host=host, port=port, **server_kw).start()
 
 
-def _fleet_worker(handler_factory, conn, server_kw) -> None:
+@dataclass
+class ServiceInfo:
+    """One serving replica's coordinates — the reference's
+    `ServiceInfo{name, host, port, partitionId, localIp, publicIp}`
+    collected by the driver rendezvous service (HTTPSourceV2.scala:118-165)."""
+
+    name: str
+    host: str
+    port: int
+    partition_id: int
+    pid: int
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "host": self.host, "port": self.port,
+                "partition_id": self.partition_id, "pid": self.pid}
+
+    @staticmethod
+    def from_dict(d: dict) -> "ServiceInfo":
+        return ServiceInfo(name=d["name"], host=d["host"], port=int(d["port"]),
+                           partition_id=int(d["partition_id"]),
+                           pid=int(d.get("pid", 0)))
+
+
+class FleetRendezvous:
+    """Driver-side rendezvous + fleet-state aggregator.
+
+    Reference: continuous mode runs an HTTP service ON THE DRIVER that
+    collects each partition reader's ServiceInfo and exposes the routing
+    table (HTTPSourceV2.scala:118-165). Here:
+
+      POST /register  — a replica announces its ServiceInfo at startup
+      GET  /services  — the raw registry
+      GET  /info      — LIVE aggregate: polls every registered replica's
+                        own info endpoint and merges counters/latency into
+                        fleet totals (replicas that fail to answer are
+                        reported as unreachable, not dropped silently)
+    """
+
+    def __init__(self, name: str = "fleet", host: str = "127.0.0.1",
+                 port: int = 0):
+        self.name = name
+        self.host, self.port = host, port
+        self._services: dict[int, ServiceInfo] = {}
+        self._lock = threading.Lock()
+        self._server: ThreadingHTTPServer | None = None
+
+    # -- aggregate ------------------------------------------------------ #
+
+    def services(self) -> list[ServiceInfo]:
+        with self._lock:
+            return [self._services[k] for k in sorted(self._services)]
+
+    def register(self, info: ServiceInfo) -> None:
+        with self._lock:
+            self._services[info.partition_id] = info
+
+    def info(self) -> dict:
+        """Poll every replica's per-replica GET endpoint, merge fleet state."""
+        import http.client
+
+        replicas = []
+        totals = {"seen": 0, "answered": 0}
+        for svc in self.services():
+            entry: dict[str, Any] = svc.to_dict()
+            conn = None
+            try:
+                conn = http.client.HTTPConnection(svc.host, svc.port, timeout=2)
+                conn.request("GET", "/")
+                r = conn.getresponse()
+                stats = json.loads(r.read())
+                entry.update(seen=stats.get("seen", 0),
+                             answered=stats.get("answered", 0),
+                             latency=stats.get("latency"),
+                             reachable=True)
+                totals["seen"] += int(stats.get("seen", 0))
+                totals["answered"] += int(stats.get("answered", 0))
+            except (OSError, http.client.HTTPException, ValueError):
+                # half-dead replicas fail in more ways than refused
+                # connections: truncated replies (BadStatusLine) and
+                # non-JSON bodies must also degrade to unreachable, never
+                # crash the whole aggregation
+                entry.update(reachable=False)
+            finally:
+                if conn is not None:
+                    conn.close()
+            replicas.append(entry)
+        return {"name": self.name, "replicas": replicas, "totals": totals,
+                "n_replicas": len(replicas)}
+
+    # -- HTTP surface --------------------------------------------------- #
+
+    def start(self) -> "FleetRendezvous":
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _reply(self, status: int, payload: bytes) -> None:
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_POST(self):  # noqa: N802 — http.server API
+                if self.path != "/register":
+                    self._reply(404, b"{}")
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    info = ServiceInfo.from_dict(
+                        json.loads(self.rfile.read(length))
+                    )
+                except (ValueError, KeyError):
+                    self._reply(400, b'{"error": "bad ServiceInfo"}')
+                    return
+                outer.register(info)
+                self._reply(200, b'{"registered": true}')
+
+            def do_GET(self):  # noqa: N802
+                if self.path == "/services":
+                    body = json.dumps(
+                        [s.to_dict() for s in outer.services()]
+                    ).encode()
+                elif self.path == "/info":
+                    body = json.dumps(outer.info()).encode()
+                else:
+                    self._reply(404, b"{}")
+                    return
+                self._reply(200, body)
+
+            def log_message(self, *a):
+                pass
+
+        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._server.server_address[1]
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+
+def _register_with_rendezvous(rendezvous_url: str, info: ServiceInfo) -> None:
+    import http.client
+    import urllib.parse
+
+    u = urllib.parse.urlparse(rendezvous_url)
+    conn = http.client.HTTPConnection(u.hostname, u.port, timeout=10)
+    conn.request("POST", "/register", body=json.dumps(info.to_dict()).encode(),
+                 headers={"Content-Type": "application/json"})
+    r = conn.getresponse()
+    r.read()
+    conn.close()
+    if r.status != 200:
+        raise IOError(f"rendezvous register failed: {r.status}")
+
+
+def _fleet_worker(handler_factory, conn, server_kw, partition_id=0,
+                  rendezvous_url=None) -> None:
     """Child-process entry: build the handler locally (models must not cross
     the process boundary — the reference re-creates per-JVM servers the same
-    way, DistributedHTTPSource.scala:244-291) and serve until terminated."""
+    way, DistributedHTTPSource.scala:244-291), announce ServiceInfo to the
+    driver rendezvous, and serve until terminated."""
+    import os
+
     srv = ServingServer(handler_factory(), **server_kw).start()
+    if rendezvous_url:
+        _register_with_rendezvous(rendezvous_url, ServiceInfo(
+            name="mmlspark_tpu.serving", host=srv.host, port=srv.port,
+            partition_id=partition_id, pid=os.getpid(),
+        ))
     conn.send((srv.host, srv.port))
     srv._stop.wait()
 
@@ -438,25 +611,37 @@ class ServingFleet:
 
     `handler_factory` must be a picklable zero-arg callable returning the
     `handler(Table) -> Table` for that host.
+
+    A `FleetRendezvous` runs on the driver: every replica registers its
+    ServiceInfo at startup (HTTPSourceV2.scala:118-165), and `info()` /
+    the rendezvous `GET /info` endpoint aggregates live per-replica
+    counters into fleet totals.
     """
 
     def __init__(self, handler_factory: Callable[[], Callable[[Table], Table]],
-                 n_hosts: int = 2, start_timeout_s: float = 60.0, **server_kw):
+                 n_hosts: int = 2, start_timeout_s: float = 60.0,
+                 rendezvous: bool = True, **server_kw):
         self.handler_factory = handler_factory
         self.n_hosts = n_hosts
         self.start_timeout_s = start_timeout_s
         self.server_kw = server_kw
         self._procs: list[multiprocessing.Process] = []
         self.urls: list[str] = []
+        self.rendezvous: FleetRendezvous | None = (
+            FleetRendezvous(name="mmlspark_tpu.fleet") if rendezvous else None
+        )
 
     def start(self) -> "ServingFleet":
+        if self.rendezvous is not None:
+            self.rendezvous.start()
         ctx = multiprocessing.get_context("spawn")
         conns = []
-        for _ in range(self.n_hosts):
+        for pid in range(self.n_hosts):
             parent, child = ctx.Pipe()
             p = ctx.Process(
                 target=_fleet_worker,
-                args=(self.handler_factory, child, self.server_kw),
+                args=(self.handler_factory, child, self.server_kw, pid,
+                      self.rendezvous.url if self.rendezvous else None),
                 daemon=True,
             )
             p.start()
@@ -470,6 +655,12 @@ class ServingFleet:
             self.urls.append(f"http://{host}:{port}/")
         return self
 
+    def info(self) -> dict:
+        """Aggregated fleet state (requires rendezvous=True)."""
+        if self.rendezvous is None:
+            raise ValueError("fleet started with rendezvous=False")
+        return self.rendezvous.info()
+
     def stop(self) -> None:
         for p in self._procs:
             p.terminate()
@@ -477,3 +668,5 @@ class ServingFleet:
             p.join(timeout=10)
         self._procs = []
         self.urls = []
+        if self.rendezvous is not None:
+            self.rendezvous.stop()

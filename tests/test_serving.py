@@ -240,6 +240,125 @@ class TestServingFleet:
         assert [i["answered"] for i in infos] == [5, 5]
 
 
+def _consolidator_factory(consolidator_url):
+    """Fleet handler that proxies every request through the fleet-wide
+    ConsolidatorService instead of hitting the 'upstream' directly."""
+    import http.client
+    import urllib.parse
+
+    u = urllib.parse.urlparse(consolidator_url)
+
+    def handler(table):
+        t = parse_request(table)
+        outs = []
+        for x in np.asarray(t["x"], np.float64):
+            conn = http.client.HTTPConnection(u.hostname, u.port, timeout=30)
+            conn.request("POST", "/", body=json.dumps({"x": float(x)}).encode())
+            r = conn.getresponse()
+            outs.append(float(json.loads(r.read())["y"]))
+            conn.close()
+        return make_reply(t.with_column("y", np.asarray(outs)), "y")
+
+    return handler
+
+
+class TestFleetRendezvous:
+    def test_info_aggregates_live_replica_counters(self):
+        """The driver rendezvous collects each replica's ServiceInfo at
+        startup and GET /info merges live per-replica counters into fleet
+        totals (reference HTTPSourceV2.scala:118-165)."""
+        fleet = ServingFleet(_fleet_factory, n_hosts=2).start()
+        try:
+            for i in range(10):
+                _post(fleet.urls[i % 2], {"x": float(i)})
+            agg = fleet.info()
+            # the same aggregate must be reachable over plain HTTP
+            http_agg = _get(fleet.rendezvous.url + "/info")
+            services = _get(fleet.rendezvous.url + "/services")
+        finally:
+            fleet.stop()
+        assert agg["n_replicas"] == 2
+        assert agg["totals"]["answered"] == 10
+        assert sorted(r["partition_id"] for r in agg["replicas"]) == [0, 1]
+        assert all(r["reachable"] for r in agg["replicas"])
+        assert [r["answered"] for r in sorted(
+            agg["replicas"], key=lambda r: r["partition_id"])] == [5, 5]
+        assert http_agg["totals"]["answered"] == 10
+        assert len(services) == 2
+
+    def test_unreachable_replica_reported(self):
+        from mmlspark_tpu.io_http.serving import FleetRendezvous, ServiceInfo
+
+        rv = FleetRendezvous().start()
+        try:
+            rv.register(ServiceInfo(name="dead", host="127.0.0.1",
+                                    port=1, partition_id=0, pid=0))
+            agg = rv.info()
+        finally:
+            rv.stop()
+        assert agg["replicas"][0]["reachable"] is False
+        assert agg["totals"]["answered"] == 0
+
+
+class TestFleetConsolidator:
+    def test_rate_limited_upstream_sees_one_bounded_client(self):
+        """Two replica PROCESSES route upstream calls through one
+        ConsolidatorService: the upstream observes at most num_lanes=1
+        concurrent call across the whole fleet (the cross-process
+        PartitionConsolidator guarantee, PartitionConsolidator.scala:103+)."""
+        import functools
+
+        from mmlspark_tpu.io_http.consolidator import ConsolidatorService
+
+        seen = {"max_concurrent": 0, "current": 0}
+        lock = threading.Lock()
+
+        def upstream(body: bytes) -> bytes:
+            with lock:
+                seen["current"] += 1
+                seen["max_concurrent"] = max(seen["max_concurrent"],
+                                             seen["current"])
+            time.sleep(0.02)
+            x = json.loads(body)["x"]
+            with lock:
+                seen["current"] -= 1
+            return json.dumps({"y": x * 10}).encode()
+
+        svc = ConsolidatorService(upstream, num_lanes=1).start()
+        fleet = ServingFleet(
+            functools.partial(_consolidator_factory, svc.url),
+            n_hosts=2, rendezvous=False,
+        ).start()
+        results, errors = [], []
+
+        def client(i):
+            try:
+                results.append(
+                    (_post(fleet.urls[i % 2], {"x": float(i)}), float(i) * 10)
+                )
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+
+        try:
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+        finally:
+            fleet.stop()
+            svc.stop()
+        assert not errors, errors
+        assert len(results) == 6
+        assert all(out == {"y": want} for out, want in results)
+        assert svc.served == 6
+        assert seen["max_concurrent"] == 1, (
+            "rate-limited upstream saw concurrent fleet calls"
+        )
+        assert svc.max_in_flight <= 1
+
+
 class TestConcurrentLoad:
     def test_parallel_clients_all_answered(self):
         """8 client threads x 25 requests: every request answered correctly,
