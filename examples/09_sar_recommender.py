@@ -1,0 +1,84 @@
+"""SAR recommender — the reference's `SAR` + ranking-evaluation flow
+(SAR.scala:36-205, SARModel.scala:95-130, RankingEvaluator.scala:14-151):
+index raw user/item ids, fit a Smart Adaptive Recommendations model with
+time-decayed affinities and jaccard item-item similarity, produce top-k
+recommendations per user, and score them with ranking metrics.
+"""
+
+import _backend  # noqa: F401 — honors JAX_PLATFORMS=cpu (see _backend.py)
+
+import numpy as np
+
+from mmlspark_tpu.core.schema import Table
+from mmlspark_tpu.recommendation import (
+    RankingEvaluator,
+    RecommendationIndexer,
+    SAR,
+)
+
+
+def synthetic_interactions(n_users=60, n_items=40, seed=0):
+    """Two taste clusters: even users favor even items, odd users odd items —
+    a structure jaccard similarity recovers."""
+    rng = np.random.default_rng(seed)
+    users, items, times = [], [], []
+    for u in range(n_users):
+        liked = [i for i in range(n_items) if i % 2 == u % 2]
+        picks = rng.choice(liked, size=8, replace=False)
+        noise = rng.choice(n_items, size=2, replace=False)
+        for i in list(picks) + list(noise):
+            users.append(f"user_{u}")
+            items.append(f"item_{i}")
+            times.append(f"2019-07-0{rng.integers(1, 9)} 12:00:00")
+    return Table({"customer": users, "product": items, "when": times})
+
+
+def main():
+    table = synthetic_interactions()
+
+    indexer = RecommendationIndexer(
+        user_input_col="customer", user_output_col="user",
+        item_input_col="product", item_output_col="item",
+    ).fit(table)
+    indexed = indexer.transform(table)
+
+    sar = SAR(
+        user_col="user", item_col="item", time_col="when",
+        similarity_function="jaccard", support_threshold=2,
+        time_decay_coeff=30,
+    ).set_indexer_model(indexer)
+    model = sar.fit(indexed)
+
+    recs = model.recommend_for_all_users(k=5, remove_seen=True)
+    first_user = indexer.recover_user(int(recs["customer" if "customer" in recs else "user"][0]))
+    first_items = indexer.inverse_transform_items([recs["recommendations"][0]])[0]
+    print(f"top-5 for {first_user}: {first_items}")
+
+    # ground truth for ranking metrics: the unseen half of each user's
+    # taste cluster is what a good recommender should surface
+    n_items = indexer.n_items
+    labels = []
+    u_idx = np.asarray(indexed["user"], np.int64)
+    i_idx = np.asarray(indexed["item"], np.int64)
+    for u in range(indexer.n_users):
+        parity = 0 if indexer.recover_user(u).endswith(
+            tuple("02468")) else 1
+        cluster = {i for i in range(n_items)
+                   if int(indexer.recover_item(i).split("_")[1]) % 2 == parity}
+        seen = set(i_idx[u_idx == u].tolist())
+        labels.append(sorted(cluster - seen))
+    ev_table = Table({
+        "prediction": [list(map(int, r)) for r in recs["recommendations"]],
+        "label": labels,
+    })
+    ev = RankingEvaluator(k=5, metric_name="ndcgAt")
+    ndcg = ev.evaluate(ev_table)
+    metrics = ev.transform(ev_table)
+    print("ranking metrics:",
+          {c: round(float(metrics[c][0]), 4) for c in metrics.columns})
+    print(f"ndcg@5 = {ndcg:.3f}")
+    assert ndcg > 0.5, "SAR failed to recover the taste clusters"
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,61 @@
+"""ImageLIME — distributed model interpretation (the reference's
+`ImageLIME.scala:27-120` / the `ModelInterpretation - Snow Leopard
+Detection` notebook): superpixel the image, score hundreds of censored
+copies in ONE batched forward, and fit a closed-form ridge regression whose
+weights say which superpixels drove the prediction. The model under
+explanation is any fitted transformer — a small dense net here so the
+example runs fast on the CPU CI mesh; LIME never looks inside it.
+"""
+
+import _backend  # noqa: F401 — honors JAX_PLATFORMS=cpu (see _backend.py)
+
+import numpy as np
+
+from mmlspark_tpu.automl.lime import ImageLIME, SuperpixelTransformer
+from mmlspark_tpu.core.schema import Table
+from mmlspark_tpu.nn import DNNLearner
+
+
+def main():
+    # images whose class is decided ONLY by the top-left quadrant
+    rng = np.random.default_rng(2)
+    n, side = 64, 32
+    y = rng.integers(0, 2, size=n).astype(np.float64)
+    x = rng.normal(size=(n, side, side, 3)).astype(np.float32) * 0.3
+    x[:, :16, :16, :] += y[:, None, None, None] * 2.0
+
+    model = DNNLearner(
+        architecture="mlp", model_config={"features": (256, 64)},
+        epochs=20, batch_size=32,
+        features_col="image", use_mesh=False, seed=0,
+    ).fit(Table({"image": x, "label": y}))
+    acc = float((np.asarray(model.transform(Table({"image": x}))["prediction"])
+                 == y).mean())
+    print(f"model train accuracy: {acc:.3f}")
+    assert acc > 0.9
+
+    # superpixel grid: 16px cells -> 2x2 = 4 superpixels per image
+    sp = SuperpixelTransformer(input_col="image", output_col="superpixels",
+                               cell_size=16)
+    print("superpixels per image:",
+          int(np.asarray(sp.transform(Table({"image": x[:1]}))["superpixels"]).max()) + 1)
+
+    lime = ImageLIME(
+        model=model, input_col="image", prediction_col="probability",
+        target_class=1, num_samples=150, cell_size=16, seed=0,
+    )
+    pos = x[y == 1][:3]
+    out = lime.transform(Table({"image": pos}))
+    weights = np.asarray(out["weights"])          # (3, 4) superpixel weights
+    print("superpixel importances (class 1):")
+    for i, w in enumerate(weights):
+        print(f"  image {i}: {np.round(w, 4).tolist()} -> "
+              f"most influential superpixel = {int(np.argmax(w))}")
+    # superpixel 0 is the top-left cell — the ONLY informative region
+    assert (np.argmax(weights, axis=1) == 0).all(), (
+        "LIME failed to attribute the prediction to the informative quadrant"
+    )
+
+
+if __name__ == "__main__":
+    main()

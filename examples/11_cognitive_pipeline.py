@@ -1,0 +1,110 @@
+"""Cognitive text-analytics pipeline — the reference's `TextAnalytics`
+stages chained over a DataFrame (TextAnalytics.scala:31-258; the
+`CognitiveServices - Celebrity Quote Analysis` notebook shape): language
+detection -> sentiment -> key phrases -> NER, all typed transformer stages
+speaking the Azure REST wire format.
+
+The service here is a LOCAL fake speaking the same protocol (this
+environment has zero egress); point `url`/`subscription_key` at a live
+endpoint and the pipeline is unchanged — exactly how the reference's
+socket-level suites drive it.
+"""
+
+import _backend  # noqa: F401 — honors JAX_PLATFORMS=cpu (see _backend.py)
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from mmlspark_tpu.core.schema import Table
+from mmlspark_tpu.io_http import (
+    KeyPhraseExtractor,
+    LanguageDetector,
+    NER,
+    TextSentiment,
+)
+
+QUOTES = [
+    "The quarterly results were excellent and the team in Seattle is thrilled.",
+    "The service outage was a disaster and customers in Paris are furious.",
+    "Redmond shipped a fine release.",
+]
+
+
+def fake_text_analytics_server():
+    """Minimal Azure-protocol text-analytics service."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = json.loads(self.rfile.read(
+                int(self.headers.get("Content-Length", 0))))
+            doc = body["documents"][0]
+            text = doc.get("text", "")
+            payload = {"id": doc["id"]}
+            if self.path.endswith("/sentiment"):
+                bad = any(w in text for w in ("outage", "disaster", "furious"))
+                payload["score"] = 0.1 if bad else 0.9
+            elif self.path.endswith("/languages"):
+                payload["detectedLanguages"] = [{"name": "English",
+                                                 "iso6391Name": "en",
+                                                 "score": 1.0}]
+            elif self.path.endswith("/keyPhrases"):
+                payload["keyPhrases"] = [w.strip(".,") for w in text.split()
+                                         if len(w) > 7][:3]
+            elif self.path.endswith("/entities/recognition/general"):
+                payload["entities"] = [
+                    {"text": w, "category": "Location"}
+                    for w in ("Seattle", "Paris", "Redmond") if w in text
+                ]
+            out = json.dumps({"documents": [payload]}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+def main():
+    srv, base = fake_text_analytics_server()
+    try:
+        table = Table({"text": QUOTES})
+        key = "fake-key"
+        stages = [
+            LanguageDetector(url=f"{base}/text/analytics/v2.0/languages",
+                             subscription_key=key, output_col="language"),
+            TextSentiment(url=f"{base}/text/analytics/v2.0/sentiment",
+                          subscription_key=key, output_col="sentiment"),
+            KeyPhraseExtractor(url=f"{base}/text/analytics/v2.0/keyPhrases",
+                               subscription_key=key, output_col="phrases"),
+            NER(url=f"{base}/text/analytics/v2.0/entities/recognition/general",
+                subscription_key=key, output_col="entities"),
+        ]
+        for stage in stages:
+            # per-row text comes from the column (ServiceParam.set_col —
+            # the scalar-or-column contract, CognitiveServiceBase.scala:25-148)
+            stage.set_col(text="text")
+            table = stage.transform(table)
+
+        for i, quote in enumerate(QUOTES):
+            lang = table["language"][i]["detectedLanguages"][0]["iso6391Name"]
+            score = table["sentiment"][i]["score"]
+            phrases = table["phrases"][i]["keyPhrases"]
+            ents = [e["text"] for e in table["entities"][i]["entities"]]
+            print(f"[{lang}] score={score:.2f} entities={ents} "
+                  f"phrases={phrases}\n    {quote!r}")
+        scores = [table["sentiment"][i]["score"] for i in range(3)]
+        assert scores[0] > 0.5 > scores[1], "sentiment polarity lost"
+        assert table["entities"][1]["entities"][0]["text"] == "Paris"
+    finally:
+        srv.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,123 @@
+"""Sink stages — score a table, then push the results to (a) an
+AzureSearch-style index (`AzureSearchWriter`: index CRUD + batched document
+upload with per-item status checking, AzureSearch.scala:23-249 /
+AzureSearchAPI.scala:19-211) and (b) a PowerBI streaming dataset
+(`PowerBIWriter.write`, PowerBIWriter.scala:94-107).
+
+Both services here are LOCAL fakes speaking the real wire protocols
+(api-key header + api-version query param + `{"value": [...]}` bodies for
+search; JSON row arrays for PowerBI) — swap the URLs for live endpoints and
+nothing else changes.
+"""
+
+import _backend  # noqa: F401 — honors JAX_PLATFORMS=cpu (see _backend.py)
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from mmlspark_tpu.core.schema import Table
+from mmlspark_tpu.gbdt import GBDTClassifier
+from mmlspark_tpu.io_http import AzureSearchWriter, PowerBIWriter
+
+
+def fake_services():
+    """One server, two protocols: /indexes* = AzureSearch, /powerbi = PBI."""
+    state = {"indexes": {}, "docs": [], "pbi_rows": []}
+
+    class Handler(BaseHTTPRequestHandler):
+        def _body(self):
+            n = int(self.headers.get("Content-Length", 0))
+            return json.loads(self.rfile.read(n)) if n else {}
+
+        def _json(self, payload, status=200):
+            out = json.dumps(payload).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+
+        def do_GET(self):
+            if self.path.startswith("/indexes/"):
+                name = self.path.split("/")[2].split("?")[0]
+                if name in state["indexes"]:
+                    self._json(state["indexes"][name])
+                else:
+                    self._json({"error": "no such index"}, status=404)
+
+        def do_POST(self):
+            body = self._body()
+            if self.path.startswith("/indexes?"):
+                state["indexes"][body["name"]] = body
+                self._json({"created": True}, status=201)
+            elif "/docs/index" in self.path:
+                docs = body["value"]
+                state["docs"].extend(docs)
+                self._json({"value": [
+                    {"key": str(i), "status": True, "statusCode": 201}
+                    for i in range(len(docs))
+                ]})
+            elif self.path == "/powerbi":
+                state["pbi_rows"].extend(body)
+                self._json({"ok": True})
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}", state
+
+
+def main():
+    # score a small table with a fitted model — the payload to publish
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(200, 5))
+    y = (x[:, 0] - 0.5 * x[:, 1] > 0).astype(np.float64)
+    model = GBDTClassifier(num_iterations=15, num_leaves=15).fit(
+        Table({"features": x, "label": y}))
+    scored = model.transform(Table({"features": x}))
+    docs = Table({
+        "id": [str(i) for i in range(20)],
+        "score": np.asarray(scored["probability"])[:20, 1].astype(np.float64),
+        "prediction": np.asarray(scored["prediction"])[:20],
+    })
+
+    srv, base, state = fake_services()
+    try:
+        # -- AzureSearch sink ------------------------------------------
+        writer = AzureSearchWriter(
+            service_url=base, api_key="fake-admin-key", batch_size=8,
+            index_definition={
+                "name": "scored-rows",
+                "fields": [
+                    {"name": "id", "type": "Edm.String", "key": True},
+                    {"name": "score", "type": "Edm.Double"},
+                    {"name": "prediction", "type": "Edm.Double"},
+                ],
+            },
+        )
+        writer.transform(docs)          # creates index, uploads 20 docs
+        writer.transform(docs)          # index exists now: upload only
+        print(f"search index {list(state['indexes'])} holds "
+              f"{len(state['docs'])} documents "
+              f"(batched {writer.get('batch_size')}/upload)")
+        assert list(state["indexes"]) == ["scored-rows"]
+        assert len(state["docs"]) == 40
+        assert state["docs"][0]["@search.action"] == "upload"
+
+        # -- PowerBI streaming-dataset sink ----------------------------
+        n_reqs = PowerBIWriter.write(docs, f"{base}/powerbi", batch_size=6)
+        print(f"PowerBI: pushed {len(state['pbi_rows'])} rows "
+              f"in {n_reqs} requests")
+        assert len(state["pbi_rows"]) == 20 and n_reqs == 4
+        assert {"id", "score", "prediction"} <= set(state["pbi_rows"][0])
+    finally:
+        srv.shutdown()
+
+
+if __name__ == "__main__":
+    main()
