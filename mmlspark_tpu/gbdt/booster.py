@@ -814,14 +814,312 @@ class Booster:
             class_labels=d.get("class_labels"),
         )
 
-    def save_native_model(self, path: str) -> None:
+    def save_native_model(self, path: str, format: str = "json") -> None:
+        """Write the model to disk: this framework's JSON (default) or
+        LightGBM's own model.txt (`format="lightgbm"`) — the reference's
+        saveNativeModel surface (LightGBMClassifier.py shim)."""
+        if format not in ("json", "lightgbm"):
+            raise ValueError(f"format must be 'json' or 'lightgbm', got {format!r}")
+        text = self.to_text() if format == "json" else self.to_lightgbm_text()
         with open(path, "w") as fh:
-            fh.write(self.to_text())
+            fh.write(text)
 
     @staticmethod
     def load_native_model(path: str) -> "Booster":
+        """Load a saved model: this framework's JSON format, or an actual
+        LightGBM `model.txt` (auto-detected) — the reference's
+        loadNativeModelFromFile (LightGBMBooster.scala:115-124)."""
         with open(path) as fh:
-            return Booster.from_text(fh.read())
+            text = fh.read()
+        if text.lstrip().startswith("{"):
+            return Booster.from_text(text)
+        return Booster.from_lightgbm_text(text)
+
+    # our objective name -> the name LightGBM writes/reads in model files
+    # (these all share the identity-or-documented output transform on both
+    # sides, so a roundtrip applies the same exp/sigmoid/softmax)
+    _TO_LGBM = {
+        "regression": "regression", "l2": "regression",
+        "l1": "regression_l1", "huber": "huber", "fair": "fair",
+        "poisson": "poisson", "quantile": "quantile", "mape": "mape",
+        "gamma": "gamma", "tweedie": "tweedie",
+    }
+
+    def to_lightgbm_text(self) -> str:
+        """Serialize in LightGBM's OWN model.txt format (the reference's
+        saveNativeModel artifact, LightGBMBooster.scala:115-124) — the
+        emitted file is loadable by actual LightGBM and by
+        `from_lightgbm_text`, with identical predictions.
+
+        The traversal semantics map exactly for numeric splits: node
+        thresholds come from `threshold_value` (raw space), missing
+        handling is encoded as missing_type=NaN + default_left
+        (decision_type=10), matching this booster's NaN->missing-bin->left
+        rule. `init_score` is folded into tree 0's leaf values (LightGBM
+        files carry no separate init; every row hits exactly one leaf per
+        tree, so the sum is unchanged). Categorical models are refused —
+        LightGBM's on-file categorical encoding is not implemented."""
+        if bool(np.any(self.is_categorical[self.feature >= 0])):
+            raise ValueError(
+                "categorical splits cannot be exported to LightGBM format"
+            )
+        if self.objective not in ("binary", "multiclass") and \
+                self.objective not in self._TO_LGBM:
+            raise ValueError(
+                f"objective {self.objective!r} has no LightGBM file-format "
+                "name; export would lose the output transform"
+            )
+        k = self.num_class
+        names = self.feature_names or [
+            f"Column_{j}" for j in range(self.num_features)
+        ]
+        out = [
+            "tree",
+            "version=v3",
+            f"num_class={k}",
+            f"num_tree_per_iteration={k if self.objective == 'multiclass' else 1}",
+            "label_index=0",
+            f"max_feature_idx={self.num_features - 1}",
+            ("objective=binary sigmoid:1" if self.objective == "binary"
+             else f"objective=multiclass num_class:{k}"
+             if self.objective == "multiclass"
+             else f"objective={self._TO_LGBM[self.objective]}"),
+            "feature_names=" + " ".join(names),
+            "feature_infos=" + " ".join(["none"] * self.num_features),
+            "",
+        ]
+        for t in range(self.num_trees):
+            feature, left, right = self.feature[t], self.left[t], self.right[t]
+            # renumber reachable nodes into LightGBM convention: internal
+            # nodes 0..L-2 in preorder, leaf l -> child id -(l+1)
+            internal: list[int] = []
+            leaves: list[int] = []
+            stack = [0]
+            while stack:
+                n = stack.pop()
+                if feature[n] < 0:
+                    leaves.append(n)
+                else:
+                    internal.append(n)
+                    stack.append(int(right[n]))
+                    stack.append(int(left[n]))
+            imap = {n: i for i, n in enumerate(internal)}
+            lmap = {n: i for i, n in enumerate(leaves)}
+
+            def child(n: int) -> int:
+                return imap[n] if feature[n] >= 0 else -(lmap[n] + 1)
+
+            leaf_vals = [float(self.value[t][n]) for n in leaves]
+            if t == 0 and self.objective != "multiclass" and self.init_score:
+                leaf_vals = [v + float(self.init_score) for v in leaf_vals]
+            out += [f"Tree={t}", f"num_leaves={len(leaves)}", "num_cat=0"]
+            if internal:
+                out += [
+                    "split_feature=" + " ".join(
+                        str(int(feature[n])) for n in internal),
+                    "split_gain=" + " ".join(
+                        repr(float(self.gain[t][n])) for n in internal),
+                    "threshold=" + " ".join(
+                        repr(float(self.threshold_value[t][n]))
+                        for n in internal),
+                    "decision_type=" + " ".join(["10"] * len(internal)),
+                    "left_child=" + " ".join(
+                        str(child(int(left[n]))) for n in internal),
+                    "right_child=" + " ".join(
+                        str(child(int(right[n]))) for n in internal),
+                ]
+            out += [
+                "leaf_value=" + " ".join(repr(v) for v in leaf_vals),
+                "shrinkage=1",
+                "",
+            ]
+        out += ["end of trees", ""]
+        return "\n".join(out)
+
+    @staticmethod
+    def from_lightgbm_text(text: str) -> "Booster":
+        """Parse LightGBM's OWN native model.txt format.
+
+        This grounds tree semantics in the reference implementation's
+        artifact: a model trained by actual LightGBM (what the reference's
+        saveNativeModel emits, LightGBMBooster.scala:115-124) loads here
+        and must reproduce its predictions (tests/test_lightgbm_format.py
+        pins this with a hand-computed fixture).
+
+        Numeric splits are `value <= threshold -> left`. The raw-space
+        thresholds become this booster's bin boundaries (one bin per
+        distinct threshold per feature), making the binned traversal
+        EXACTLY equivalent to LightGBM's raw comparisons — no precision
+        loss on finite values. Missing handling: NaN maps to this
+        framework's missing bin, which always sorts LEFT. Nodes whose
+        missing routing this booster cannot reproduce are REJECTED rather
+        than silently mispredicting: missing_type=NaN with
+        default_left=false (NaN would go right) and missing_type=Zero
+        (zero-band values route by default_left, not by comparison). With
+        missing_type=None (bits 2-3 == 0) LightGBM coerces NaN to 0.0
+        before comparing, which can also differ from missing-bin-left —
+        only relevant for NaN inputs. Also rejected: categorical splits,
+        `average_output` (rf) models, and linear trees — all would change
+        predictions silently if ignored. The pinned hand-computed fixture
+        lives in tests/test_external_truth.py."""
+        header, tree_blocks = _parse_lightgbm_sections(text)
+        if "average_output" in header:
+            raise ValueError(
+                "average_output (rf) LightGBM models are not supported — "
+                "this booster sums leaf values; loading one would "
+                "mispredict by the tree count"
+            )
+        if header.get("linear_tree", "0") not in ("0", "") or any(
+            "leaf_const" in blk or "leaf_coeff" in blk for blk in tree_blocks
+        ):
+            raise ValueError("linear-tree LightGBM models are not supported")
+        objective = header.get("objective", "regression").split()[0]
+        obj_map = {
+            "binary": "binary", "regression": "regression",
+            "regression_l2": "regression", "regression_l1": "l1",
+            "multiclass": "multiclass", "huber": "huber", "fair": "fair",
+            "poisson": "poisson", "quantile": "quantile",
+            "gamma": "gamma", "tweedie": "tweedie", "mape": "mape",
+        }
+        if objective not in obj_map:
+            raise ValueError(f"unsupported LightGBM objective {objective!r}")
+        objective = obj_map[objective]
+        num_class = int(header.get("num_class", 1))
+        max_feature = int(header.get("max_feature_idx", 0))
+        f = max_feature + 1
+        feature_names = header.get("feature_names", "").split()
+
+        # collect per-feature thresholds -> synthesized bin boundaries
+        thresholds: dict[int, set] = {}
+        for blk in tree_blocks:
+            # single-leaf (constant) trees carry no split arrays at all
+            for feat, thr, dt in zip(blk.get("split_feature", []),
+                                     blk.get("threshold", []),
+                                     blk.get("decision_type", [])):
+                dt = int(dt)
+                if dt & 1:
+                    raise ValueError(
+                        "categorical splits in LightGBM files are not supported"
+                    )
+                # decision_type bits: 0 categorical, 1 default_left,
+                # 2-3 missing_type (0 none, 1 zero, 2 nan)
+                missing_type = (dt >> 2) & 3
+                if missing_type == 2 and not (dt & 2):
+                    raise ValueError(
+                        "node routes missing (NaN) RIGHT "
+                        "(missing_type=NaN, default_left=false); this "
+                        "booster's missing bin always sorts left — refusing "
+                        "to load a model it would mispredict"
+                    )
+                if missing_type == 1:
+                    raise ValueError(
+                        "missing_type=Zero (zero_as_missing) nodes route "
+                        "the zero band by default_left, not by threshold "
+                        "comparison — refusing to load a model this "
+                        "booster would mispredict on zero values"
+                    )
+                thresholds.setdefault(int(feat), set()).add(float(thr))
+        per_feat = {j: sorted(s) for j, s in thresholds.items()}
+        max_t = max((len(v) for v in per_feat.values()), default=0)
+        mapper = BinMapper(max_bin=max(max_t + 1, 2))
+        mapper.num_features = f
+        bounds = np.full((f, max_t + 2), np.inf, np.float64)
+        nbins = np.full(f, 1, np.int32)
+        for j, ts in per_feat.items():
+            bounds[j, 1 : 1 + len(ts)] = ts
+            nbins[j] = len(ts) + 2       # missing bin + one per threshold + top
+        mapper.upper_bounds = bounds
+        mapper.num_bins = nbins
+
+        # node-layout conversion: LightGBM internal i -> node i, leaf l ->
+        # node (L-1+l); child c >= 0 is internal, c < 0 is leaf -(c+1)
+        m = max(2 * blk["num_leaves"] - 1 for blk in tree_blocks)
+        t_count = len(tree_blocks)
+        feature = np.full((t_count, m), -1, np.int32)
+        thr_bin = np.zeros((t_count, m), np.int32)
+        thr_val = np.zeros((t_count, m), np.float64)
+        left = np.full((t_count, m), -1, np.int32)
+        right = np.full((t_count, m), -1, np.int32)
+        value = np.zeros((t_count, m), np.float32)
+        gain = np.zeros((t_count, m), np.float32)
+        for t, blk in enumerate(tree_blocks):
+            nl = blk["num_leaves"]
+
+            def node_of(c: int, nl=nl) -> int:
+                return c if c >= 0 else nl - 1 + (-c - 1)
+
+            if nl == 1:                  # single-leaf tree (constant)
+                value[t, 0] = blk["leaf_value"][0]
+                continue
+            for i in range(nl - 1):
+                j = int(blk["split_feature"][i])
+                thr = float(blk["threshold"][i])
+                feature[t, i] = j
+                # bin index of threshold: 1 + position in the sorted list
+                thr_bin[t, i] = 1 + per_feat[j].index(thr)
+                thr_val[t, i] = thr
+                left[t, i] = node_of(int(blk["left_child"][i]))
+                right[t, i] = node_of(int(blk["right_child"][i]))
+                if blk.get("split_gain"):
+                    gain[t, i] = blk["split_gain"][i]
+            for leaf, lv in enumerate(blk["leaf_value"]):
+                value[t, nl - 1 + leaf] = lv
+
+        return Booster(
+            feature=feature, threshold_bin=thr_bin, threshold_value=thr_val,
+            is_categorical=np.zeros((t_count, m), bool),
+            left=left, right=right, value=value, gain=gain,
+            tree_class=np.asarray(
+                [t % num_class for t in range(t_count)], np.int32
+            ),
+            bin_mapper=mapper,
+            objective=objective,
+            num_class=num_class if objective == "multiclass" else 1,
+            init_score=0.0,              # LightGBM bakes init into leaf values
+            feature_names=feature_names,
+            class_labels=[0.0, 1.0] if objective == "binary" else None,
+        )
+
+
+def _parse_lightgbm_sections(text: str):
+    """Split a LightGBM model.txt into (header dict, [tree dict, ...])."""
+    header: dict[str, str] = {}
+    tree_blocks: list[dict] = []
+    cur: dict | None = None
+    _vec_int = ("split_feature", "left_child", "right_child", "decision_type")
+    _vec_float = ("threshold", "leaf_value", "split_gain",
+                  "leaf_const", "leaf_coeff")
+    for raw in text.splitlines():
+        line = raw.strip()
+        if line.startswith("Tree="):
+            cur = {}
+            tree_blocks.append(cur)
+            continue
+        if line in ("end of trees", "") or line.startswith(("tree", "pandas_")):
+            continue
+        if "=" not in line:
+            # bare flag lines ("average_output") matter: they change
+            # prediction semantics, so record their presence
+            if cur is None and line and " " not in line:
+                header[line] = "1"
+            continue
+        key, val = line.split("=", 1)
+        if cur is None:
+            header[key] = val
+        elif key == "num_leaves":
+            cur[key] = int(val)
+        elif key in _vec_int:
+            cur[key] = [int(v) for v in val.split()] if val else []
+        elif key in _vec_float:
+            cur[key] = [float(v) for v in val.split()] if val else []
+        # other per-tree keys (leaf_weight, internal_value, shrinkage, ...)
+        # are bookkeeping the traversal doesn't need
+    if not tree_blocks:
+        raise ValueError("no Tree= sections found; not a LightGBM model file")
+    for blk in tree_blocks:
+        if "num_leaves" not in blk or "leaf_value" not in blk:
+            raise ValueError("malformed LightGBM tree block")
+    return header, tree_blocks
 
 
 def _tree_to_host(tree: TreeArrays) -> dict[str, np.ndarray]:

@@ -165,10 +165,11 @@ class _BoosterModelMixin:
     def _load_state(self, state: dict[str, Any]) -> None:
         self.booster = Booster.from_text(state["booster_text"])
 
-    def save_native_model(self, path: str) -> None:
+    def save_native_model(self, path: str, format: str = "json") -> None:
         """Reference: LightGBMClassificationModel.saveNativeModel
-        (LightGBMClassifier.scala:148-151)."""
-        self.booster.save_native_model(path)
+        (LightGBMClassifier.scala:148-151). format="lightgbm" writes
+        LightGBM's own model.txt (loadable by actual LightGBM)."""
+        self.booster.save_native_model(path, format=format)
 
     def get_feature_importances(self, importance_type: str = "split") -> list[float]:
         return list(self.booster.feature_importances(importance_type))

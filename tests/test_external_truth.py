@@ -1,0 +1,280 @@
+"""External-truth grounding for GBDT semantics (VERDICT r2 item 6).
+
+Two anchors that do NOT reference this framework's own past outputs:
+
+1. REAL DATA vs the reference's committed gate: the vendored Wisconsin
+   Diagnostic Breast Cancer dataset (569 real rows; sklearn's bundled copy,
+   written to tests/benchmarks/data/breast_cancer_wdbc.csv) trained with the
+   reference suite's exact hyperparameters (numLeaves=5, numIterations=10,
+   objective=binary — VerifyLightGBMClassifier.scala:232-240) must reach the
+   reference's committed train-AUC value within its committed precision
+   window (breast-cancer gbdt 0.99247 ± 0.1,
+   benchmarks_VerifyLightGBMClassifier.csv:22-25).
+2. INDEPENDENT IMPLEMENTATION cross-check: sklearn's histogram GBDT —
+   a from-scratch third-party implementation of the same algorithm family —
+   must agree with this framework's AUC on identical data within a tight
+   band.
+
+Plus the format anchor: a hand-authored model file in LightGBM's OWN
+native model.txt syntax loads via `Booster.from_lightgbm_text` and
+reproduces hand-computed predictions — the loader is pinned to the
+published format semantics (value <= threshold -> left, negative child ids
+are leaves, sigmoid for binary), not to this repo's conventions.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+DATA = os.path.join(os.path.dirname(__file__), "benchmarks", "data",
+                    "breast_cancer_wdbc.csv")
+
+# the reference's committed gates for breast-cancer (train AUC, precision 0.1):
+# benchmarks_VerifyLightGBMClassifier.csv lines 22-25
+REFERENCE_GATES = {
+    "gbdt": 0.9924667959194766,
+    "rf": 0.9894725398177173,
+    "dart": 0.9915381688379931,
+    "goss": 0.9924667959194766,
+}
+PRECISION = 0.1
+
+
+def _auc(y, score):
+    order = np.argsort(score, kind="stable")
+    ranks = np.empty(len(y), np.float64)
+    ranks[order] = np.arange(1, len(y) + 1)
+    # tie-average ranks so AUC is exact for discrete scores
+    for s in np.unique(score):
+        m = score == s
+        ranks[m] = ranks[m].mean()
+    pos = y > 0.5
+    n1, n0 = pos.sum(), (~pos).sum()
+    return float((ranks[pos].sum() - n1 * (n1 + 1) / 2) / (n1 * n0))
+
+
+@pytest.fixture(scope="module")
+def wdbc():
+    from mmlspark_tpu.core.table_io import read_csv
+
+    t = read_csv(DATA)
+    y = np.asarray(t["Label"], np.float64)
+    feats = [c for c in t.columns if c != "Label"]
+    x = np.stack([np.asarray(t[c], np.float64) for c in feats], axis=1)
+    assert x.shape == (569, 30) and set(np.unique(y)) == {0.0, 1.0}
+    return x, y
+
+
+class TestReferenceGateOnRealData:
+    @pytest.mark.parametrize("boosting", ["gbdt", "rf", "dart", "goss"])
+    def test_train_auc_within_reference_window(self, wdbc, boosting):
+        """The reference suite's exact config on REAL data must land inside
+        the reference's committed AUC window — same dataset family, same
+        metric, same hyperparameters, the reference's own precision."""
+        from mmlspark_tpu.gbdt.booster import Booster, TrainOptions
+
+        x, y = wdbc
+        kw = {}
+        if boosting == "rf":
+            # the reference sets bagging for rf (VerifyLightGBMClassifier
+            # .scala:228-231); rf without bagging is degenerate
+            kw = {"bagging_fraction": 0.9, "bagging_freq": 1}
+        booster = Booster.train(x, y, TrainOptions(
+            objective="binary", boosting_type=boosting,
+            num_leaves=5, num_iterations=10, **kw,
+        ))
+        auc = _auc(y, np.asarray(booster.predict(x)))
+        want = REFERENCE_GATES[boosting]
+        assert auc > want - PRECISION, (
+            f"{boosting}: train AUC {auc:.4f} below the reference gate "
+            f"{want:.4f} - {PRECISION}"
+        )
+
+    def test_sklearn_cross_check(self, wdbc):
+        """Independent-implementation agreement: sklearn's histogram GBDT
+        with matched capacity lands within 0.02 AUC of this framework."""
+        from sklearn.ensemble import HistGradientBoostingClassifier
+
+        from mmlspark_tpu.gbdt.booster import Booster, TrainOptions
+
+        x, y = wdbc
+        ours = Booster.train(x, y, TrainOptions(
+            objective="binary", num_leaves=5, num_iterations=10,
+        ))
+        ours_auc = _auc(y, np.asarray(ours.predict(x)))
+        sk = HistGradientBoostingClassifier(
+            max_iter=10, max_leaf_nodes=5, learning_rate=0.1,
+            min_samples_leaf=20, early_stopping=False,
+        ).fit(x, y)
+        sk_auc = _auc(y, sk.predict_proba(x)[:, 1])
+        assert abs(ours_auc - sk_auc) < 0.02, (ours_auc, sk_auc)
+        assert ours_auc > 0.98
+
+
+# A hand-authored model in LightGBM's native model.txt syntax. Semantics to
+# reproduce by hand below: two trees, raw = leaf0(t0) + leaf(t1), prob =
+# sigmoid(raw).
+LIGHTGBM_MODEL_TXT = """tree
+version=v3
+num_class=1
+num_tree_per_iteration=1
+label_index=0
+max_feature_idx=2
+objective=binary sigmoid:1
+feature_names=f0 f1 f2
+feature_infos=[-5:5] [-5:5] [-5:5]
+
+Tree=0
+num_leaves=3
+num_cat=0
+split_feature=0 1
+split_gain=10 5
+threshold=1.5 -0.25
+decision_type=2 2
+left_child=1 -1
+right_child=-3 -2
+leaf_value=0.2 -0.1 0.4
+leaf_weight=10 10 10
+leaf_count=10 10 10
+internal_value=0 0
+internal_count=30 20
+shrinkage=0.1
+
+
+Tree=1
+num_leaves=2
+num_cat=0
+split_feature=2
+split_gain=3
+threshold=0.5
+decision_type=2
+left_child=-1
+right_child=-2
+leaf_value=-0.05 0.15
+leaf_weight=15 15
+leaf_count=15 15
+internal_value=0
+internal_count=30
+shrinkage=0.1
+
+
+end of trees
+
+feature importances:
+f0=1
+f1=1
+f2=1
+"""
+
+
+class TestLightGBMNativeFormat:
+    @pytest.fixture(scope="class")
+    def booster(self):
+        from mmlspark_tpu.gbdt.booster import Booster
+
+        return Booster.from_lightgbm_text(LIGHTGBM_MODEL_TXT)
+
+    def test_hand_computed_predictions(self, booster):
+        """Tree 0: node0 splits f0<=1.5 (left->node1, right->leaf2);
+        node1 splits f1<=-0.25 (left->leaf0, right->leaf1).
+        Tree 1: f2<=0.5 -> leaf0 else leaf1. Probabilities are
+        sigmoid(sum) — all four paths computed by hand."""
+        rows = np.array([
+            # f0,   f1,    f2     tree0 leaf        tree1 leaf
+            [0.0, -1.0, 0.0],   # f0<=1.5,f1<=-.25 -> 0.2 ; f2<=.5 -> -0.05
+            [0.0,  0.5, 1.0],   # f0<=1.5,f1>-.25  -> -0.1; f2>.5  -> 0.15
+            [2.0,  9.9, 0.5],   # f0>1.5           -> 0.4 ; f2<=.5 -> -0.05
+            [1.5, -0.25, 0.6],  # boundary: <= goes left   -> 0.2 + 0.15
+        ])
+        want_raw = np.array([0.2 - 0.05, -0.1 + 0.15, 0.4 - 0.05, 0.2 + 0.15])
+        want_prob = 1.0 / (1.0 + np.exp(-want_raw))
+        got = np.asarray(booster.predict(rows))
+        np.testing.assert_allclose(got, want_prob, rtol=1e-6, atol=1e-7)
+        raw = np.asarray(booster.predict_raw(rows))
+        np.testing.assert_allclose(raw, want_raw, rtol=1e-6, atol=1e-7)
+
+    def test_metadata(self, booster):
+        assert booster.objective == "binary"
+        assert booster.num_trees == 2
+        assert booster.feature_names == ["f0", "f1", "f2"]
+
+    def test_load_native_model_autodetects(self, booster, tmp_path):
+        from mmlspark_tpu.gbdt.booster import Booster
+
+        p = os.path.join(tmp_path, "model.txt")
+        with open(p, "w") as fh:
+            fh.write(LIGHTGBM_MODEL_TXT)
+        loaded = Booster.load_native_model(p)
+        x = np.random.default_rng(0).normal(size=(50, 3))
+        np.testing.assert_array_equal(
+            np.asarray(loaded.predict(x)), np.asarray(booster.predict(x))
+        )
+
+    def test_roundtrip_through_own_format(self, booster):
+        """An imported LightGBM model survives this framework's own
+        save/load with identical predictions."""
+        from mmlspark_tpu.gbdt.booster import Booster
+
+        x = np.random.default_rng(1).normal(size=(100, 3)) * 3
+        again = Booster.from_text(booster.to_text())
+        np.testing.assert_array_equal(
+            np.asarray(again.predict(x)), np.asarray(booster.predict(x))
+        )
+
+    def test_export_roundtrip_through_lightgbm_format(self, wdbc):
+        """A model trained HERE serializes to LightGBM's own model.txt
+        (saveNativeModel parity: actual LightGBM could load it) and reloads
+        through the format parser with identical predictions — export and
+        import pin each other."""
+        from mmlspark_tpu.gbdt.booster import Booster, TrainOptions
+
+        x, y = wdbc
+        trained = Booster.train(x, y, TrainOptions(
+            objective="binary", num_leaves=5, num_iterations=10,
+        ))
+        txt = trained.to_lightgbm_text()
+        assert txt.startswith("tree\n") and "Tree=9" in txt
+        again = Booster.from_lightgbm_text(txt)
+        np.testing.assert_allclose(
+            np.asarray(again.predict(x)), np.asarray(trained.predict(x)),
+            rtol=1e-6, atol=1e-7,
+        )
+        # export synthesizes Column_j names when the model has none
+        assert again.feature_names == [f"Column_{j}" for j in range(30)]
+
+    def test_export_rejects_categorical(self):
+        from mmlspark_tpu.gbdt.booster import Booster, TrainOptions
+
+        rng = np.random.default_rng(0)
+        x = np.column_stack([rng.integers(0, 4, 300), rng.normal(size=300)])
+        y = (x[:, 0] >= 2).astype(np.float64)
+        b = Booster.train(x.astype(np.float64), y, TrainOptions(
+            objective="binary", num_leaves=4, num_iterations=3,
+            min_data_in_leaf=5, categorical_indexes=(0,),
+        ))
+        with pytest.raises(ValueError, match="categorical"):
+            b.to_lightgbm_text()
+
+    def test_nan_right_node_rejected(self):
+        from mmlspark_tpu.gbdt.booster import Booster
+
+        # missing_type=NaN (8) + default_left clear -> routes NaN right
+        bad = LIGHTGBM_MODEL_TXT.replace("decision_type=2 2",
+                                         "decision_type=8 2")
+        with pytest.raises(ValueError, match="missing"):
+            Booster.from_lightgbm_text(bad)
+
+    def test_categorical_rejected(self):
+        from mmlspark_tpu.gbdt.booster import Booster
+
+        bad = LIGHTGBM_MODEL_TXT.replace("decision_type=2 2",
+                                         "decision_type=3 2")
+        with pytest.raises(ValueError, match="categorical"):
+            Booster.from_lightgbm_text(bad)
+
+    def test_not_a_model_rejected(self):
+        from mmlspark_tpu.gbdt.booster import Booster
+
+        with pytest.raises(ValueError, match="Tree="):
+            Booster.from_lightgbm_text("hello\nworld\n")
