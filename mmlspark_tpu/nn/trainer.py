@@ -60,6 +60,10 @@ class DNNLearner(HasFeaturesCol, HasLabelCol, Estimator):
     checkpoint_dir = Param(None, "epoch checkpoint directory (resume if present)", ptype=str)
     init_bundle_path = Param(None, "warm start from a saved ModelBundle", ptype=str)
     bfloat16 = Param(True, "compute in bfloat16 (f32 params)", ptype=bool)
+    # jax.checkpoint over the forward: activations are recomputed in the
+    # backward pass instead of stored — HBM for FLOPs, the standard lever
+    # for training bigger batches per chip (SURVEY "HBM bandwidth" stance)
+    remat = Param(False, "rematerialize the forward in the backward pass", ptype=bool)
 
     # optional: transfer learning — freeze all but these param path prefixes
     trainable_prefixes = Param(None, "list of param path prefixes to train (None=all)")
@@ -99,21 +103,32 @@ class DNNLearner(HasFeaturesCol, HasLabelCol, Estimator):
         loss_kind = self.get("loss")
         has_bn = bool(batch_stats)
 
+        use_remat = bool(self.get("remat"))
+
+        def _apply_bn(params, batch_stats, bx, step_rng):
+            out, updates = module.apply(
+                {"params": params, "batch_stats": batch_stats}, bx,
+                train=True, mutable=["batch_stats"],
+                rngs={"dropout": step_rng},
+            )
+            return out, updates["batch_stats"]
+
+        def _apply_plain(params, bx, step_rng):
+            return module.apply({"params": params}, bx, train=True,
+                                rngs={"dropout": step_rng})
+
+        if use_remat:
+            _apply_bn = jax.checkpoint(_apply_bn)
+            _apply_plain = jax.checkpoint(_apply_plain)
+
         def loss_fn(params, batch_stats, bx, by, step_rng):
-            variables = {"params": params}
             # a dropout rng is always supplied (flax ignores unused rngs),
             # so stochastic-regularization models train without special
             # casing; deterministic models are unaffected
-            rngs = {"dropout": step_rng}
             if has_bn:
-                variables["batch_stats"] = batch_stats
-                logits, updates = module.apply(
-                    variables, bx, train=True, mutable=["batch_stats"],
-                    rngs=rngs,
-                )
-                new_stats = updates["batch_stats"]
+                logits, new_stats = _apply_bn(params, batch_stats, bx, step_rng)
             else:
-                logits = module.apply(variables, bx, train=True, rngs=rngs)
+                logits = _apply_plain(params, bx, step_rng)
                 new_stats = batch_stats
             if loss_kind == "softmax_ce":
                 loss = optax.softmax_cross_entropy_with_integer_labels(

@@ -220,6 +220,26 @@ class TestDNNLearner:
 
         np.testing.assert_allclose(fit(True), fit(False), rtol=1e-4, atol=1e-5)
 
+    def test_remat_trains_identically(self):
+        """jax.checkpoint trades memory for recompute — the math must be
+        unchanged: remat and no-remat fits produce matching models (BN
+        model covers the mutable-stats remat path too)."""
+        rng = np.random.default_rng(11)
+        x = rng.normal(size=(64, 8, 8, 3)).astype(np.float32)
+        y = (x[:, :, :, 0].mean(axis=(1, 2)) > 0).astype(np.float64)
+        tbl = Table({"features": x, "label": y})
+
+        def fit(remat):
+            from mmlspark_tpu.nn.trainer import DNNLearner
+
+            m = DNNLearner(
+                architecture="resnet20_cifar", epochs=1, batch_size=32,
+                seed=5, use_mesh=False, bfloat16=False, remat=remat,
+            ).fit(tbl)
+            return np.asarray(m.transform(tbl)["probability"])
+
+        np.testing.assert_allclose(fit(True), fit(False), rtol=2e-4, atol=2e-5)
+
     def test_checkpoint_resume(self, tmp_path):
         tbl = vector_table(n=256)
         ck = str(tmp_path / "ckpts")
