@@ -243,6 +243,27 @@ class TestLightGBMNativeFormat:
         # export synthesizes Column_j names when the model has none
         assert again.feature_names == [f"Column_{j}" for j in range(30)]
 
+    def test_multiclass_export_roundtrip(self):
+        """Multiclass models interleave one tree per class per round;
+        num_class/num_tree_per_iteration and the softmax transform must
+        survive the LightGBM-format roundtrip."""
+        from mmlspark_tpu.gbdt.booster import Booster, TrainOptions
+
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=(400, 5))
+        y = (x[:, 0] > 0.5).astype(int) + (x[:, 1] > 0).astype(int)
+        b = Booster.train(x, y.astype(np.float64), TrainOptions(
+            objective="multiclass", num_class=3, num_leaves=7,
+            num_iterations=4, min_data_in_leaf=5,
+        ))
+        txt = b.to_lightgbm_text()
+        assert "num_class=3" in txt and "num_tree_per_iteration=3" in txt
+        again = Booster.from_lightgbm_text(txt)
+        np.testing.assert_allclose(
+            np.asarray(again.predict(x)), np.asarray(b.predict(x)),
+            rtol=1e-5, atol=1e-6,
+        )
+
     def test_export_rejects_categorical(self):
         from mmlspark_tpu.gbdt.booster import Booster, TrainOptions
 
