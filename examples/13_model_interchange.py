@@ -71,7 +71,11 @@ def main():
         txt = m.booster.to_text()
         if label == "natural":
             base = txt
-        print(f"mesh[{label}]: model hash {hash(txt) & 0xffffffff:08x}")
+        import zlib
+
+        # stable digest (hash() is salted per process — useless for a
+        # reproducibility demo)
+        print(f"mesh[{label}]: model crc32 {zlib.crc32(txt.encode()):08x}")
     assert txt == base, "deterministic models diverged across device orders"
     print("deterministic=True: byte-identical models across device permutations")
 

@@ -146,12 +146,12 @@ class TestDeterministicGBDT:
              > 0).astype(np.float64)
         return x, y
 
-    def _fit_text(self, x, y, mesh, deterministic):
+    def _fit_text(self, x, y, mesh, deterministic, **extra):
         from mmlspark_tpu.gbdt.booster import Booster, TrainOptions
 
         opts = TrainOptions(
             objective="binary", num_iterations=8, num_leaves=15,
-            min_data_in_leaf=5, deterministic=deterministic,
+            min_data_in_leaf=5, deterministic=deterministic, **extra,
         )
         return Booster.train(x, y, opts, mesh=mesh).to_text()
 
@@ -161,6 +161,17 @@ class TestDeterministicGBDT:
         t2 = self._fit_text(x, y, _mesh(perm=[5, 2, 7, 0, 3, 6, 1, 4]),
                             deterministic=True)
         assert t1 == t2
+
+    def test_voting_parallel_deterministic_across_permutations(self, data):
+        """The voting path's selected-feature histogram merge rides the
+        same hist_psum routing — deterministic mode must pin it too."""
+        x, y = data
+        texts = [
+            self._fit_text(x, y, _mesh(perm=perm), deterministic=True,
+                           tree_learner="voting_parallel", top_k=3)
+            for perm in (None, [6, 3, 0, 5, 2, 7, 4, 1])
+        ]
+        assert texts[0] == texts[1]
 
     def test_deterministic_matches_plain_quality(self, data):
         """The quantized merge must not change model quality measurably."""
