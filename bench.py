@@ -186,10 +186,40 @@ def make_dataset(n: int, f: int, seed: int = 7):
 # --------------------------------------------------------------------- #
 
 
+def _auc(y_true: np.ndarray, scores: np.ndarray) -> "float | None":
+    """Rank-based ROC-AUC (Mann-Whitney U with tie correction)."""
+    pos = y_true > 0.5
+    n_pos, n_neg = int(pos.sum()), int((~pos).sum())
+    if n_pos == 0 or n_neg == 0:
+        return None
+    order = np.argsort(scores, kind="mergesort")
+    ranks = np.empty(len(scores))
+    ranks[order] = np.arange(1, len(scores) + 1)
+    # average ranks over ties
+    sorted_scores = scores[order]
+    i = 0
+    while i < len(sorted_scores):
+        j = i
+        while j + 1 < len(sorted_scores) and sorted_scores[j + 1] == sorted_scores[i]:
+            j += 1
+        if j > i:
+            ranks[order[i:j + 1]] = (i + j + 2) / 2.0
+        i = j + 1
+    u = ranks[pos].sum() - n_pos * (n_pos + 1) / 2.0
+    return float(u / (n_pos * n_neg))
+
+
+N_VALID = 8192
+
+
 def bench_gbdt(hbm_peak_gbps: "float | None") -> dict:
     from mmlspark_tpu.gbdt.booster import Booster, TrainOptions
 
-    x, y = make_dataset(N_ROWS, N_FEATURES)
+    # held-out split: a perf change that silently broke learning must fail
+    # the bench, not just the later test gates (valid AUC is the canary)
+    x_all, y_all = make_dataset(N_ROWS + N_VALID, N_FEATURES)
+    x, y = x_all[:N_ROWS], y_all[:N_ROWS]
+    x_valid, y_valid = x_all[N_ROWS:], y_all[N_ROWS:]
     opts = TrainOptions(
         objective="binary",
         num_iterations=NUM_ITERATIONS,
@@ -214,6 +244,11 @@ def bench_gbdt(hbm_peak_gbps: "float | None") -> dict:
     pred = booster.predict(x)
     acc = float(((pred > 0.5) == (y > 0.5)).mean())
     assert acc > 0.7, f"model failed to learn (acc={acc:.3f})"
+    valid_pred = np.asarray(booster.predict(x_valid))
+    valid_auc = _auc(y_valid, valid_pred)
+    assert valid_auc is not None and valid_auc > 0.75, (
+        f"model failed to generalize (valid AUC={valid_auc})"
+    )
 
     # The algorithm's irreducible traffic is re-reading the (n, F) binned
     # matrix (int32) + grad/hess for the histogram build of each split step
@@ -231,6 +266,7 @@ def bench_gbdt(hbm_peak_gbps: "float | None") -> dict:
         "rows_per_sec": rows_per_sec,
         "fit_seconds": elapsed,
         "acc": acc,
+        "valid_auc": valid_auc,
         "modeled_hbm_gbps": gbps,
         "modeled_hbm_frac_of_peak": (
             round(gbps / hbm_peak_gbps, 4) if hbm_peak_gbps else None
@@ -250,7 +286,10 @@ def bench_gbdt_large(hbm_peak_gbps: "float | None") -> "dict | None":
     from mmlspark_tpu.gbdt.booster import Booster, TrainOptions
 
     n, f, iters, leaves = 1 << 20, 28, 50, 63
-    x, y = make_dataset_wide(n, f)
+    n_valid = 65536
+    x_all, y_all = make_dataset_wide(n + n_valid, f)
+    x, y = x_all[:n], y_all[:n]
+    x_valid, y_valid = x_all[n:], y_all[n:]
     opts = TrainOptions(objective="binary", num_iterations=iters,
                         num_leaves=leaves, learning_rate=0.1)
     Booster.train(x, y, opts)                        # compile warm-up
@@ -259,12 +298,14 @@ def bench_gbdt_large(hbm_peak_gbps: "float | None") -> "dict | None":
     elapsed = time.perf_counter() - t0
     pred = booster.predict(x[:65536])
     acc = float(((pred > 0.5) == (y[:65536] > 0.5)).mean())
+    valid_auc = _auc(y_valid, np.asarray(booster.predict(x_valid)))
     per_pass = n * f * 4 + n * 4 * 2
     gbps = iters * (leaves - 1) * per_pass / 1e9 / elapsed
     return {
         "rows_per_sec": n * iters / elapsed,
         "fit_seconds": elapsed,
         "acc": acc,
+        "valid_auc": valid_auc,
         "modeled_hbm_gbps": gbps,
         "modeled_hbm_frac_of_peak": (
             round(gbps / hbm_peak_gbps, 4) if hbm_peak_gbps else None
@@ -395,8 +436,15 @@ def bench_trainer(peak_tflops: "float | None") -> dict:
 
     t1 = fit(1)
     tn = fit(1 + extra_epochs)
-    steady = max(tn - t1, 1e-9)
-    img_per_sec = n * extra_epochs / steady
+    steady = tn - t1
+    # Timing-resolution floor: fit(1+k)-fit(1) subtracts two large
+    # compile-dominated times, so on a smoke run the difference can land
+    # inside timing noise (round-3 artifact: a clamped 1e-9 denominator
+    # produced trainer_images_per_sec=6.4e10). Below the floor — or on the
+    # CPU smoke config, whose number is meaningless anyway — report null
+    # rather than a nonsense throughput.
+    measurable = (not on_cpu) and steady > 0.05
+    img_per_sec = (n * extra_epochs / steady) if measurable else None
 
     # train-step FLOPs: XLA cost analysis of a same-shape value_and_grad
     # step on the same module (the learner's internal step is identical
@@ -422,7 +470,7 @@ def bench_trainer(peak_tflops: "float | None") -> dict:
     step = jax.jit(jax.value_and_grad(loss_fn))
     step_flops = flops_of(step, params)
     per_img = (step_flops / bs) if step_flops else 3 * 4.1e9 * (side / 224) ** 2
-    tflops = img_per_sec * per_img / 1e12
+    tflops = (img_per_sec * per_img / 1e12) if img_per_sec else None
     return {
         "train_images_per_sec": img_per_sec,
         "epoch1_seconds": t1,
@@ -550,6 +598,7 @@ def _run_suite(platform: str) -> dict:
             "gbdt_histogram_kernel": _resolve_kernel_name(),
             "gbdt_fit_seconds": round(gbdt["fit_seconds"], 3),
             "gbdt_train_acc": round(gbdt["acc"], 4),
+            "gbdt_valid_auc": round(gbdt["valid_auc"], 4),
             "gbdt_baseline_rows_per_sec": BASELINE_ROWS_PER_SEC,
             "gbdt_modeled_hbm_gbps": round(gbdt["modeled_hbm_gbps"], 2),
             "gbdt_modeled_hbm_frac_of_peak": gbdt["modeled_hbm_frac_of_peak"],
@@ -559,6 +608,10 @@ def _run_suite(platform: str) -> dict:
                 gbdt_large["fit_seconds"], 3) if gbdt_large else None,
             "gbdt_large_train_acc": round(
                 gbdt_large["acc"], 4) if gbdt_large else None,
+            "gbdt_large_valid_auc": (
+                round(gbdt_large["valid_auc"], 4)
+                if gbdt_large and gbdt_large.get("valid_auc") is not None
+                else None),
             "gbdt_large_modeled_hbm_gbps": round(
                 gbdt_large["modeled_hbm_gbps"], 2) if gbdt_large else None,
             "gbdt_large_modeled_hbm_frac_of_peak": (
@@ -574,13 +627,15 @@ def _run_suite(platform: str) -> dict:
             "model_runner_flops_per_image": round(
                 runner.get("flops_per_image", 0.0)),
             "trainer_images_per_sec": round(
-                trainer["train_images_per_sec"], 1) if trainer else None,
+                trainer["train_images_per_sec"], 1)
+                if trainer and trainer["train_images_per_sec"] else None,
             "trainer_vs_baseline": round(
                 trainer["train_images_per_sec"] / BASELINE_TRAIN_IMAGES_PER_SEC,
-                3) if trainer else None,
+                3) if trainer and trainer["train_images_per_sec"] else None,
             "trainer_baseline_images_per_sec": BASELINE_TRAIN_IMAGES_PER_SEC,
             "trainer_tflops": round(
-                trainer.get("train_tflops", 0.0), 3) if trainer else None,
+                trainer["train_tflops"], 3)
+                if trainer and trainer.get("train_tflops") else None,
             "trainer_mfu": trainer.get("train_mfu") if trainer else None,
             "trainer_image_side": trainer.get("image_side") if trainer else None,
             "trainer_smoke_only": trainer.get("smoke_only") if trainer else None,

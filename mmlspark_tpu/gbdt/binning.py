@@ -156,10 +156,13 @@ class BinMapper:
             if nb <= 1:
                 continue
             ub = self.upper_bounds[j, 1:nb]
-            # searchsorted over right-closed bin upper bounds; NaN -> bin 0
+            # searchsorted over right-closed bin upper bounds; NaN -> bin 0.
+            # ±inf bins by COMPARISON (-inf -> lowest bin, +inf -> top bin),
+            # matching LightGBM's `value <= threshold` routing — only NaN
+            # takes the missing bin.
             binned = np.searchsorted(ub, col, side="left") + 1
             binned = np.clip(binned, 1, nb - 1)
-            binned[~np.isfinite(col)] = MISSING_BIN
+            binned[np.isnan(col)] = MISSING_BIN
             out[:, j] = binned
         return out
 

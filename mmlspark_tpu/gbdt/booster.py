@@ -855,7 +855,10 @@ class Booster:
         thresholds come from `threshold_value` (raw space), missing
         handling is encoded as missing_type=NaN + default_left
         (decision_type=10), matching this booster's NaN->missing-bin->left
-        rule. `init_score` is folded into tree 0's leaf values (LightGBM
+        rule. ±inf inputs bin by comparison on both sides (-inf left of
+        every threshold, +inf right), so they predict identically under
+        real LightGBM and this booster; only NaN takes the missing path.
+        `init_score` is folded into tree 0's leaf values (LightGBM
         files carry no separate init; every row hits exactly one leaf per
         tree, so the sum is unchanged). Categorical models are refused —
         LightGBM's on-file categorical encoding is not implemented."""
@@ -950,7 +953,9 @@ class Booster:
         thresholds become this booster's bin boundaries (one bin per
         distinct threshold per feature), making the binned traversal
         EXACTLY equivalent to LightGBM's raw comparisons — no precision
-        loss on finite values. Missing handling: NaN maps to this
+        loss on finite values; ±inf also bins by comparison (-inf left,
+        +inf right of every threshold), matching LightGBM's
+        `value <= threshold` routing. Missing handling: NaN maps to this
         framework's missing bin, which always sorts LEFT. Nodes whose
         missing routing this booster cannot reproduce are REJECTED rather
         than silently mispredicting: missing_type=NaN with
@@ -973,7 +978,18 @@ class Booster:
             "leaf_const" in blk or "leaf_coeff" in blk for blk in tree_blocks
         ):
             raise ValueError("linear-tree LightGBM models are not supported")
-        objective = header.get("objective", "regression").split()[0]
+        obj_tokens = header.get("objective", "regression").split()
+        objective = obj_tokens[0]
+        # LightGBM's binary output transform is 1/(1+exp(-sigmoid*raw));
+        # this booster applies plain sigmoid (sigmoid=1). A non-unit
+        # sigmoid parameter would silently scale every probability, so
+        # reject it (reject-rather-than-mispredict policy).
+        for tok in obj_tokens[1:]:
+            if tok.startswith("sigmoid:") and float(tok.split(":", 1)[1]) != 1.0:
+                raise ValueError(
+                    f"objective parameter {tok!r} != sigmoid:1 would change "
+                    "the probability transform; refusing to load"
+                )
         obj_map = {
             "binary": "binary", "regression": "regression",
             "regression_l2": "regression", "regression_l1": "l1",

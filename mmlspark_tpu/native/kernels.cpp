@@ -122,7 +122,8 @@ void mmlspark_csv_parse(
 
 // Numeric-feature binning: replicates
 //   np.searchsorted(upper_bounds[j,1:nb], col, side='left') + 1,
-//   clipped to [1, nb-1]; NaN/inf -> bin 0.
+//   clipped to [1, nb-1]; NaN -> bin 0. ±inf bins by comparison
+//   (-inf -> bin 1, +inf -> top bin), matching LightGBM routing.
 // Categorical features (is_cat[j] != 0) and single-bin features are left
 // untouched for the Python side to fill.
 void mmlspark_bin_numeric(
@@ -145,7 +146,7 @@ void mmlspark_bin_numeric(
                 const int32_t nb = num_bins[j];
                 if (is_cat[j] || nb <= 1) continue;
                 const double v = row[j];
-                if (!std::isfinite(v)) {
+                if (std::isnan(v)) {
                     orow[j] = 0;  // MISSING_BIN
                     continue;
                 }

@@ -142,14 +142,27 @@ class ModelDownloader:
     def local_path(self, name: str) -> str:
         return os.path.join(self.local_repo, f"{name}.model")
 
+    # suffixes a dot-prefixed work file can carry: ".tmp" while fetching,
+    # or a bare external-format extension after import_external's rename
+    # strips ".tmp" mid-conversion (a crash there orphans the renamed file)
+    _WORK_SUFFIXES = (".tmp", ".safetensors", ".npz", ".pt", ".bin")
+
     def sweep_orphan_tmps(self, min_age_s: float = 3600.0) -> int:
-        """Remove stale `.*.tmp` files left by abandoned (timed-out) copy
-        workers. Age-gated: a fresh tmp may still be written by a live
-        worker thread. Returns the number removed."""
+        """Remove stale work files left by abandoned (timed-out or crashed)
+        copy/convert workers: `*.tmp` (mkstemp artifacts, the index
+        writer's rename source) plus dot-prefixed files with an
+        external-format extension (import_external's post-rename tmp).
+        Deliberately narrow — installed bundles (`*.model`), the index,
+        and foreign dot-files (e.g. `.nfs*` silly-renames) never match.
+        Age-gated: a fresh tmp may still be written by a live worker
+        thread. Returns the number removed."""
         removed = 0
         now = time.time()
         for fname in os.listdir(self.local_repo):
-            if not (fname.startswith(".") and fname.endswith(".tmp")):
+            is_work = fname.endswith(".tmp") or (
+                fname.startswith(".") and fname.endswith(self._WORK_SUFFIXES)
+            )
+            if not is_work:
                 continue
             path = os.path.join(self.local_repo, fname)
             try:

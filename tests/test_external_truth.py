@@ -299,3 +299,71 @@ class TestLightGBMNativeFormat:
 
         with pytest.raises(ValueError, match="Tree="):
             Booster.from_lightgbm_text("hello\nworld\n")
+
+    def test_nonunit_sigmoid_rejected(self):
+        """LightGBM's binary transform is sigmoid(sigmoid_param * raw);
+        loading sigmoid != 1 silently would scale every probability
+        (ADVICE r3) — reject instead."""
+        from mmlspark_tpu.gbdt.booster import Booster
+
+        bad = LIGHTGBM_MODEL_TXT.replace("objective=binary sigmoid:1",
+                                         "objective=binary sigmoid:2")
+        with pytest.raises(ValueError, match="sigmoid"):
+            Booster.from_lightgbm_text(bad)
+
+    def test_inf_bins_by_comparison(self, booster):
+        """±inf inputs follow LightGBM's `value <= threshold` routing
+        (-inf left of every split, +inf right), NOT the NaN/missing path
+        (ADVICE r3): f0=+inf fails f0<=1.5 -> leaf 0.4; f2=-inf passes
+        f2<=0.5 -> leaf -0.05. NaN still takes the missing bin (left)."""
+        inf = np.inf
+        rows = np.array([
+            [inf, 0.0, -inf],    # t0: f0>1.5 -> 0.4 ; t1: f2<=0.5 -> -0.05
+            [-inf, -1.0, inf],   # t0: left,f1<=-.25 -> 0.2; t1: f2>.5 -> 0.15
+        ])
+        want_raw = np.array([0.4 - 0.05, 0.2 + 0.15])
+        got = np.asarray(booster.predict_raw(rows))
+        np.testing.assert_allclose(got, want_raw, rtol=1e-6, atol=1e-7)
+        # NaN routes via the missing bin, which sorts left at every node
+        nan_row = np.array([[np.nan, np.nan, np.nan]])
+        np.testing.assert_allclose(
+            np.asarray(booster.predict_raw(nan_row)),
+            np.array([0.2 - 0.05]), rtol=1e-6, atol=1e-7,
+        )
+
+
+class TestAgainstRealLightGBM:
+    """Cross-checks against the actual lightgbm package (ADVICE r3: the
+    'loadable by actual LightGBM' claim needs a test that runs wherever the
+    package exists). Skipped in environments without lightgbm — the claim
+    is then pinned only by the hand fixture above."""
+
+    def test_export_loads_in_real_lightgbm(self, wdbc):
+        lgb = pytest.importorskip("lightgbm")
+        from mmlspark_tpu.gbdt.booster import Booster, TrainOptions
+
+        x, y = wdbc
+        trained = Booster.train(x, y, TrainOptions(
+            objective="binary", num_leaves=5, num_iterations=10,
+        ))
+        real = lgb.Booster(model_str=trained.to_lightgbm_text())
+        np.testing.assert_allclose(
+            real.predict(x), np.asarray(trained.predict(x)),
+            rtol=1e-5, atol=1e-6,
+        )
+
+    def test_real_lightgbm_model_loads_here(self, wdbc):
+        lgb = pytest.importorskip("lightgbm")
+        from mmlspark_tpu.gbdt.booster import Booster
+
+        x, y = wdbc
+        real = lgb.train(
+            {"objective": "binary", "num_leaves": 5, "learning_rate": 0.1,
+             "min_data_in_leaf": 20, "verbose": -1},
+            lgb.Dataset(x, label=y), num_boost_round=10,
+        )
+        ours = Booster.from_lightgbm_text(real.model_to_string())
+        np.testing.assert_allclose(
+            np.asarray(ours.predict(x)), real.predict(x),
+            rtol=1e-5, atol=1e-6,
+        )

@@ -24,6 +24,11 @@ def make_data(n=300, f=8, seed=0):
     x = rng.normal(size=(n, f))
     x[:, 2] = np.round(np.abs(x[:, 2]) * 3)          # low-cardinality column
     x[rng.random((n, f)) < 0.05] = np.nan            # missing cells
+    # ±inf cells: the C++ and numpy binners implement comparison-binning
+    # independently (isnan guard + searchsorted vs lower_bound); the
+    # bit-identity gate must cover the inf path too
+    x[rng.random((n, f)) < 0.03] = np.inf
+    x[rng.random((n, f)) < 0.03] = -np.inf
     y = (np.nan_to_num(x[:, 0]) > 0).astype(np.float64)
     return x, y
 
