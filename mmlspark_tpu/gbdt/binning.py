@@ -17,7 +17,9 @@ Bin layout per feature (LightGBM-compatible semantics):
     and bin 0 sorts "left" in every split (missing goes left by default).
   - categorical: raw value v (non-negative int-ish) maps to a bin by
     frequency rank; unseen/overflow categories map to bin 0 (the "other"
-    bin). Splits on categorical features are one-vs-rest on a single bin.
+    bin). Splits on categorical features are many-vs-many bin SUBSETS
+    chosen by the engine's sorted-prefix search (engine.py); the other-bin
+    always routes right.
 """
 
 from __future__ import annotations

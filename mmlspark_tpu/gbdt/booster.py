@@ -30,7 +30,7 @@ from ..parallel.mesh import DATA_AXIS
 
 __all__ = ["Booster", "TrainOptions"]
 
-_FORMAT_VERSION = 1
+_FORMAT_VERSION = 2   # v2: many-vs-many categorical subset splits (cat_sets)
 
 
 @dataclass
@@ -78,6 +78,11 @@ class TrainOptions:
     # reduction order / device permutation (parallel/collectives.py)
     deterministic: bool = False
     categorical_indexes: tuple[int, ...] = ()
+    # categorical split controls (LightGBM defaults): sorted-subset
+    # smoothing, extra L2 on categorical gains, smaller-side size cap
+    cat_smooth: float = 10.0
+    cat_l2: float = 10.0
+    max_cat_threshold: int = 32
     init_model: "Booster | None" = None   # warm start (reference modelString)
     seed: int = 0
 
@@ -95,6 +100,9 @@ class Booster:
     value: np.ndarray            # (T, M) float32 (shrunk leaf values)
     gain: np.ndarray             # (T, M) float32
     tree_class: np.ndarray       # (T,) int32 — class id per tree (multiclass)
+    # (T, M, Bc) bool — bins routed LEFT at categorical nodes (many-vs-many
+    # subset splits); Bc=1 placeholder for models with no categorical splits
+    cat_bitset: np.ndarray
     bin_mapper: BinMapper
     objective: str = "regression"
     num_class: int = 1
@@ -184,6 +192,9 @@ class Booster:
                 opts.top_k if str(opts.tree_learner).startswith("voting") else 0
             ),
             deterministic=opts.deterministic,
+            cat_smooth=opts.cat_smooth,
+            cat_l2=opts.cat_l2,
+            max_cat_threshold=opts.max_cat_threshold,
         )
         cat_mask = np.zeros(f, bool)
         for ci in opts.categorical_indexes:
@@ -350,7 +361,7 @@ class Booster:
                     log(f"fused boosting: done ({kept_rounds * k} trees)")
                 t_host = {kf: np.asarray(v) for kf, v in t_stack._asdict().items()}
                 names = ("feature", "threshold_bin", "is_categorical",
-                         "left", "right", "value", "gain")
+                         "left", "right", "value", "gain", "cat_bitset")
                 for r in range(kept_rounds):
                     for cls in range(k):
                         idx = (r, cls) if k > 1 else (r,)
@@ -467,6 +478,7 @@ class Booster:
             "right": self.right[t],
             "value": self.value[t],
             "gain": self.gain[t],
+            "cat_bitset": self.cat_bitset[t],
         }
 
     @staticmethod
@@ -486,6 +498,7 @@ class Booster:
                 threshold_value=z(np.float64), is_categorical=z(bool),
                 left=z(np.int32, -1), right=z(np.int32, -1),
                 value=z(np.float32), gain=z(np.float32),
+                cat_bitset=np.zeros((0, m, 1), bool),
                 tree_class=np.zeros(0, np.int32), bin_mapper=mapper,
                 objective=opts.objective,
                 num_class=opts.num_class if opts.objective == "multiclass" else 1,
@@ -495,23 +508,28 @@ class Booster:
         feature = stack("feature").astype(np.int32)
         thr_bin = stack("threshold_bin").astype(np.int32)
         is_cat = stack("is_categorical").astype(bool)
-        # raw-space thresholds for numeric splits (categorical: the raw
-        # category value of the one-vs-rest bin, NaN if the "other" bin) —
-        # one vectorized (feature, bin) table lookup over all (tree, node)
-        # pairs; a Python loop here is O(T*M) per fit and dominated training
+        # per-node category bitsets; widths can differ between warm-start
+        # trees and this fit's trees — pad to the widest, and collapse to a
+        # width-1 placeholder when the model has no categorical splits
+        bitsets = [np.asarray(t["cat_bitset"], bool) for t in trees]
+        bc = max(b.shape[-1] for b in bitsets)
+        cat_bitset = np.stack([
+            np.pad(b, ((0, 0), (0, bc - b.shape[-1]))) for b in bitsets
+        ])
+        if not is_cat.any():
+            cat_bitset = cat_bitset[:, :, :1]
+        # raw-space thresholds for numeric splits — one vectorized
+        # (feature, bin) table lookup over all (tree, node) pairs; a Python
+        # loop here is O(T*M) per fit and dominated training. Categorical
+        # nodes have no single raw threshold (many-vs-many subset): NaN.
         ub = np.asarray(mapper.upper_bounds, np.float64)        # (F, B)
         n_b = ub.shape[1]
-        cat_lut = np.full(ub.shape, np.nan)
-        for j, cmap in mapper.category_maps.items():
-            for v, b in cmap.items():
-                if 0 <= b < n_b:
-                    cat_lut[int(j), int(b)] = v
         split = feature >= 0
         fidx = np.where(split, feature, 0)
         bidx = np.minimum(thr_bin, n_b - 1)
         thr_val = np.where(
             split,
-            np.where(is_cat, cat_lut[fidx, bidx], ub[fidx, bidx]),
+            np.where(is_cat, np.nan, ub[fidx, bidx]),
             0.0,
         )
         return Booster(
@@ -519,6 +537,7 @@ class Booster:
             threshold_bin=thr_bin,
             threshold_value=thr_val,
             is_categorical=is_cat,
+            cat_bitset=cat_bitset,
             left=stack("left").astype(np.int32),
             right=stack("right").astype(np.int32),
             value=stack("value").astype(np.float32),
@@ -555,11 +574,13 @@ class Booster:
             feature=jnp.asarray(self.feature),
             thr=jnp.asarray(self.threshold_bin),
             cat=jnp.asarray(self.is_categorical),
+            bitset=jnp.asarray(self.cat_bitset),
             left=jnp.asarray(self.left),
             right=jnp.asarray(self.right),
             value=jnp.asarray(self.value),
             cls=jnp.asarray(self.tree_class),
         )
+        bc = int(self.cat_bitset.shape[-1])
 
         @jax.jit
         def run(bins):
@@ -575,7 +596,9 @@ class Booster:
                     f = jnp.maximum(tr["feature"][node], 0)
                     col = bins[jnp.arange(n), f]
                     go_left = jnp.where(
-                        tr["cat"][node], col == tr["thr"][node], col <= tr["thr"][node]
+                        tr["cat"][node],
+                        tr["bitset"][node, jnp.minimum(col, bc - 1)],
+                        col <= tr["thr"][node],
                     )
                     leaf = tr["feature"][node] < 0
                     nxt = jnp.where(
@@ -617,6 +640,7 @@ class Booster:
             np.asarray(bins, np.int32), self.feature, self.threshold_bin,
             self.is_categorical, self.left, self.right, self.value,
             self.tree_class, k, max_steps, self.init_score,
+            self.cat_bitset,
         )
         if res is not None:
             return res
@@ -657,6 +681,7 @@ class Booster:
             feature=self.feature[:t], threshold_bin=self.threshold_bin[:t],
             threshold_value=self.threshold_value[:t],
             is_categorical=self.is_categorical[:t],
+            cat_bitset=self.cat_bitset[:t],
             left=self.left[:t], right=self.right[:t],
             value=self.value[:t], gain=self.gain[:t],
             tree_class=self.tree_class[:t],
@@ -681,11 +706,15 @@ class Booster:
         rows = np.arange(n)
         feature, thr = self.feature[t], self.threshold_bin[t]
         cat, left, right = self.is_categorical[t], self.left[t], self.right[t]
+        bitset = self.cat_bitset[t]
+        bc = bitset.shape[-1]
         node = np.zeros(n, np.int64)
         for _ in range(max_steps):
             f = np.maximum(feature[node], 0)
             col = bins[rows, f]
-            go_left = np.where(cat[node], col == thr[node], col <= thr[node])
+            go_left = np.where(cat[node],
+                               bitset[node, np.minimum(col, bc - 1)],
+                               col <= thr[node])
             leaf = feature[node] < 0
             node = np.where(leaf, node,
                             np.where(go_left, left[node], right[node]))
@@ -763,7 +792,16 @@ class Booster:
 
     def to_text(self) -> str:
         """Portable text model (reference saveNativeModel,
-        LightGBMBooster.scala:115-124)."""
+        LightGBMBooster.scala:115-124).
+
+        Categorical subset splits serialize sparsely: `cat_sets` lists
+        `[tree, node, [left bins...]]` for categorical nodes only, plus
+        the bitset width — a (T, M, B) dense bool dump would dwarf the
+        rest of the payload."""
+        cat_sets = []
+        for t, m in zip(*np.nonzero(self.is_categorical & (self.feature >= 0))):
+            bins_left = np.nonzero(self.cat_bitset[t, m])[0]
+            cat_sets.append([int(t), int(m), [int(b) for b in bins_left]])
         payload = {
             "format": "mmlspark_tpu.gbdt",
             "version": _FORMAT_VERSION,
@@ -783,6 +821,8 @@ class Booster:
                 "right": self.right.tolist(),
                 "value": self.value.tolist(),
                 "gain": self.gain.tolist(),
+                "cat_bitset_width": int(self.cat_bitset.shape[-1]),
+                "cat_sets": cat_sets,
             },
             "bin_mapper": self.bin_mapper.to_dict(),
         }
@@ -795,17 +835,42 @@ class Booster:
             raise ValueError("not a mmlspark_tpu gbdt model")
         t = d["trees"]
         arr = lambda key, dt: np.asarray(t[key], dtype=dt)  # noqa: E731
+        feature = arr("feature", np.int32)
+        thr_bin = arr("threshold_bin", np.int32)
+        is_cat = arr("is_categorical", bool)
+        n_t, m = feature.shape
+        mapper = BinMapper.from_dict(d["bin_mapper"])
+        # bitset width must cover EVERY bin any categorical column can
+        # produce (the traversal clamps col to bc-1; an under-sized bitset
+        # would alias high bins onto the clamp index and flip their
+        # routing), so take it from the mapper, not from the split bins
+        full_bc = int(max(np.asarray(mapper.num_bins).max(initial=1), 1))
+        if "cat_sets" in t:
+            bc = max(int(t.get("cat_bitset_width", 1)), full_bc if is_cat.any() else 1)
+            cat_bitset = np.zeros((n_t, m, bc), bool)
+            for tt, mm, bins_left in t["cat_sets"]:
+                cat_bitset[int(tt), int(mm), np.asarray(bins_left, int)] = True
+        else:
+            # version-1 files: categorical splits were one-vs-rest on a
+            # single bin (col == threshold_bin); the equivalent subset is
+            # the singleton bitset, so old saved models keep their exact
+            # predictions under the bitset traversal
+            bc = full_bc if is_cat.any() else 1
+            cat_bitset = np.zeros((n_t, m, bc), bool)
+            for tt, mm in zip(*np.nonzero(is_cat & (feature >= 0))):
+                cat_bitset[tt, mm, thr_bin[tt, mm]] = True
         return Booster(
-            feature=arr("feature", np.int32),
-            threshold_bin=arr("threshold_bin", np.int32),
+            feature=feature,
+            threshold_bin=thr_bin,
             threshold_value=arr("threshold_value", np.float64),
-            is_categorical=arr("is_categorical", bool),
+            is_categorical=is_cat,
+            cat_bitset=cat_bitset,
             left=arr("left", np.int32),
             right=arr("right", np.int32),
             value=arr("value", np.float32),
             gain=arr("gain", np.float32),
             tree_class=np.asarray(d["tree_class"], np.int32),
-            bin_mapper=BinMapper.from_dict(d["bin_mapper"]),
+            bin_mapper=mapper,
             objective=d["objective"],
             num_class=int(d["num_class"]),
             init_score=float(d["init_score"]),
@@ -860,12 +925,30 @@ class Booster:
         real LightGBM and this booster; only NaN takes the missing path.
         `init_score` is folded into tree 0's leaf values (LightGBM
         files carry no separate init; every row hits exactly one leaf per
-        tree, so the sum is unchanged). Categorical models are refused —
-        LightGBM's on-file categorical encoding is not implemented."""
+        tree, so the sum is unchanged).
+
+        Categorical subset splits use LightGBM's own on-file encoding:
+        decision_type bit 0 set, threshold = index into this tree's
+        cat_boundaries, and cat_threshold packing the LEFT category VALUES
+        as uint32 bitset words (bit v set -> raw category v goes left).
+        Values outside any bitset route right on both sides (this
+        booster's other-bin, LightGBM's unseen-category rule). Requires
+        integer-valued non-negative categories — anything else has no
+        LightGBM file representation and is refused."""
+        # bin -> raw category value per categorical feature (for export)
+        cat_inv: dict[int, dict[int, int]] = {}
         if bool(np.any(self.is_categorical[self.feature >= 0])):
-            raise ValueError(
-                "categorical splits cannot be exported to LightGBM format"
-            )
+            for j, cmap in self.bin_mapper.category_maps.items():
+                inv = {}
+                for v, b in cmap.items():
+                    if not (float(v).is_integer() and v >= 0 and v < 2**31):
+                        raise ValueError(
+                            f"feature {j} has non-integer/negative category "
+                            f"value {v!r}; LightGBM's categorical bitset "
+                            "encoding cannot represent it"
+                        )
+                    inv[int(b)] = int(v)
+                cat_inv[int(j)] = inv
         if self.objective not in ("binary", "multiclass") and \
                 self.objective not in self._TO_LGBM:
             raise ValueError(
@@ -915,22 +998,56 @@ class Booster:
             leaf_vals = [float(self.value[t][n]) for n in leaves]
             if t == 0 and self.objective != "multiclass" and self.init_score:
                 leaf_vals = [v + float(self.init_score) for v in leaf_vals]
-            out += [f"Tree={t}", f"num_leaves={len(leaves)}", "num_cat=0"]
+            # categorical nodes: threshold = per-tree cat split index;
+            # bitset words pack the LEFT category values
+            thresholds: list[str] = []
+            decisions: list[str] = []
+            cat_bounds = [0]
+            cat_words: list[int] = []
+            for n in internal:
+                if bool(self.is_categorical[t][n]):
+                    j = int(feature[n])
+                    vals = [cat_inv[j][int(b)]
+                            for b in np.nonzero(self.cat_bitset[t][n])[0]
+                            if int(b) in cat_inv.get(j, {})]
+                    if not vals or bool(self.cat_bitset[t][n][0]):
+                        raise ValueError(
+                            f"tree {t} node {n}: categorical left set routes "
+                            "the other/unseen bin left — LightGBM's finite "
+                            "bitset cannot express 'unseen goes left'"
+                        )
+                    n_words = max(v for v in vals) // 32 + 1
+                    words = [0] * n_words
+                    for v in vals:
+                        words[v // 32] |= 1 << (v % 32)
+                    thresholds.append(str(len(cat_bounds) - 1))
+                    decisions.append("1")
+                    cat_bounds.append(cat_bounds[-1] + n_words)
+                    cat_words.extend(words)
+                else:
+                    thresholds.append(repr(float(self.threshold_value[t][n])))
+                    decisions.append("10")
+            num_cat = len(cat_bounds) - 1
+            out += [f"Tree={t}", f"num_leaves={len(leaves)}",
+                    f"num_cat={num_cat}"]
             if internal:
                 out += [
                     "split_feature=" + " ".join(
                         str(int(feature[n])) for n in internal),
                     "split_gain=" + " ".join(
                         repr(float(self.gain[t][n])) for n in internal),
-                    "threshold=" + " ".join(
-                        repr(float(self.threshold_value[t][n]))
-                        for n in internal),
-                    "decision_type=" + " ".join(["10"] * len(internal)),
+                    "threshold=" + " ".join(thresholds),
+                    "decision_type=" + " ".join(decisions),
                     "left_child=" + " ".join(
                         str(child(int(left[n]))) for n in internal),
                     "right_child=" + " ".join(
                         str(child(int(right[n]))) for n in internal),
                 ]
+                if num_cat:
+                    out += [
+                        "cat_boundaries=" + " ".join(str(b) for b in cat_bounds),
+                        "cat_threshold=" + " ".join(str(w) for w in cat_words),
+                    ]
             out += [
                 "leaf_value=" + " ".join(repr(v) for v in leaf_vals),
                 "shrinkage=1",
@@ -963,10 +1080,21 @@ class Booster:
         (zero-band values route by default_left, not by comparison). With
         missing_type=None (bits 2-3 == 0) LightGBM coerces NaN to 0.0
         before comparing, which can also differ from missing-bin-left —
-        only relevant for NaN inputs. Also rejected: categorical splits,
-        `average_output` (rf) models, and linear trees — all would change
-        predictions silently if ignored. The pinned hand-computed fixture
-        lives in tests/test_external_truth.py."""
+        only relevant for NaN inputs.
+
+        Categorical splits (decision_type bit 0) load natively: each
+        node's cat_threshold bitset words decode to the raw category
+        values routed LEFT; the union per feature synthesizes the
+        category map (one bin per value), so the per-node bin bitsets
+        reproduce LightGBM's value-level routing exactly. Values absent
+        from every bitset — including unseen-at-predict categories — land
+        in the other-bin and route RIGHT, LightGBM's rule. NaN
+        categorical inputs route right here (LightGBM's missing handling
+        for categories treats them as no-match).
+
+        Still rejected: `average_output` (rf) models and linear trees —
+        both would change predictions silently if ignored. The pinned
+        hand-computed fixture lives in tests/test_external_truth.py."""
         header, tree_blocks = _parse_lightgbm_sections(text)
         if "average_output" in header:
             raise ValueError(
@@ -1005,18 +1133,45 @@ class Booster:
         f = max_feature + 1
         feature_names = header.get("feature_names", "").split()
 
-        # collect per-feature thresholds -> synthesized bin boundaries
+        # collect per-feature thresholds (numeric) and left-routed category
+        # values (categorical) -> synthesized bin boundaries / category maps
+        def _cat_left_values(blk, i):
+            """Decode node i's cat_threshold bitset words -> left values."""
+            bounds = blk.get("cat_boundaries", [])
+            words = blk.get("cat_threshold", [])
+            ci = int(blk["threshold"][i])
+            if not (0 <= ci < len(bounds) - 1) or bounds[ci + 1] > len(words):
+                raise ValueError(
+                    "malformed categorical split: cat_boundaries/"
+                    "cat_threshold do not cover the node's split index"
+                )
+            vals = []
+            for wi in range(bounds[ci], bounds[ci + 1]):
+                w = int(words[wi])
+                base = 32 * (wi - bounds[ci])
+                for b in range(32):
+                    if (w >> b) & 1:
+                        vals.append(base + b)
+            return vals
+
         thresholds: dict[int, set] = {}
+        cat_vals: dict[int, set] = {}
         for blk in tree_blocks:
             # single-leaf (constant) trees carry no split arrays at all
-            for feat, thr, dt in zip(blk.get("split_feature", []),
-                                     blk.get("threshold", []),
-                                     blk.get("decision_type", [])):
+            for i, (feat, thr, dt) in enumerate(
+                zip(blk.get("split_feature", []),
+                    blk.get("threshold", []),
+                    blk.get("decision_type", []))
+            ):
                 dt = int(dt)
+                feat = int(feat)
                 if dt & 1:
-                    raise ValueError(
-                        "categorical splits in LightGBM files are not supported"
+                    # categorical: union the left values; routing of any
+                    # value not in some node's set is right, our other-bin
+                    cat_vals.setdefault(feat, set()).update(
+                        _cat_left_values(blk, i)
                     )
+                    continue
                 # decision_type bits: 0 categorical, 1 default_left,
                 # 2-3 missing_type (0 none, 1 zero, 2 nan)
                 missing_type = (dt >> 2) & 3
@@ -1034,16 +1189,34 @@ class Booster:
                         "comparison — refusing to load a model this "
                         "booster would mispredict on zero values"
                     )
-                thresholds.setdefault(int(feat), set()).add(float(thr))
+                thresholds.setdefault(feat, set()).add(float(thr))
+        mixed = set(thresholds) & set(cat_vals)
+        if mixed:
+            raise ValueError(
+                f"features {sorted(mixed)} have both numeric and categorical "
+                "splits in the same model file"
+            )
         per_feat = {j: sorted(s) for j, s in thresholds.items()}
         max_t = max((len(v) for v in per_feat.values()), default=0)
-        mapper = BinMapper(max_bin=max(max_t + 1, 2))
+        mapper = BinMapper(
+            max_bin=max(max_t + 1, 2,
+                        *(len(v) for v in cat_vals.values())) if cat_vals
+            else max(max_t + 1, 2),
+            categorical_indexes=tuple(sorted(cat_vals)),
+        )
         mapper.num_features = f
         bounds = np.full((f, max_t + 2), np.inf, np.float64)
         nbins = np.full(f, 1, np.int32)
         for j, ts in per_feat.items():
             bounds[j, 1 : 1 + len(ts)] = ts
             nbins[j] = len(ts) + 2       # missing bin + one per threshold + top
+        cat_maps = {
+            j: {float(v): i + 1 for i, v in enumerate(sorted(s))}
+            for j, s in cat_vals.items()
+        }
+        for j, cmap in cat_maps.items():
+            nbins[j] = len(cmap) + 1     # other-bin + one per left value
+        mapper.category_maps = cat_maps
         mapper.upper_bounds = bounds
         mapper.num_bins = nbins
 
@@ -1051,9 +1224,12 @@ class Booster:
         # node (L-1+l); child c >= 0 is internal, c < 0 is leaf -(c+1)
         m = max(2 * blk["num_leaves"] - 1 for blk in tree_blocks)
         t_count = len(tree_blocks)
+        bc = max((len(cm) + 1 for cm in cat_maps.values()), default=1)
         feature = np.full((t_count, m), -1, np.int32)
         thr_bin = np.zeros((t_count, m), np.int32)
         thr_val = np.zeros((t_count, m), np.float64)
+        is_cat_arr = np.zeros((t_count, m), bool)
+        cat_bitset = np.zeros((t_count, m, bc), bool)
         left = np.full((t_count, m), -1, np.int32)
         right = np.full((t_count, m), -1, np.int32)
         value = np.zeros((t_count, m), np.float32)
@@ -1069,11 +1245,19 @@ class Booster:
                 continue
             for i in range(nl - 1):
                 j = int(blk["split_feature"][i])
-                thr = float(blk["threshold"][i])
                 feature[t, i] = j
-                # bin index of threshold: 1 + position in the sorted list
-                thr_bin[t, i] = 1 + per_feat[j].index(thr)
-                thr_val[t, i] = thr
+                dt = int(blk["decision_type"][i])
+                if dt & 1:
+                    is_cat_arr[t, i] = True
+                    thr_val[t, i] = np.nan
+                    cmap = cat_maps[j]
+                    for v in _cat_left_values(blk, i):
+                        cat_bitset[t, i, cmap[float(v)]] = True
+                else:
+                    thr = float(blk["threshold"][i])
+                    # bin index of threshold: 1 + position in the sorted list
+                    thr_bin[t, i] = 1 + per_feat[j].index(thr)
+                    thr_val[t, i] = thr
                 left[t, i] = node_of(int(blk["left_child"][i]))
                 right[t, i] = node_of(int(blk["right_child"][i]))
                 if blk.get("split_gain"):
@@ -1083,7 +1267,8 @@ class Booster:
 
         return Booster(
             feature=feature, threshold_bin=thr_bin, threshold_value=thr_val,
-            is_categorical=np.zeros((t_count, m), bool),
+            is_categorical=is_cat_arr,
+            cat_bitset=cat_bitset,
             left=left, right=right, value=value, gain=gain,
             tree_class=np.asarray(
                 [t % num_class for t in range(t_count)], np.int32
@@ -1102,7 +1287,8 @@ def _parse_lightgbm_sections(text: str):
     header: dict[str, str] = {}
     tree_blocks: list[dict] = []
     cur: dict | None = None
-    _vec_int = ("split_feature", "left_child", "right_child", "decision_type")
+    _vec_int = ("split_feature", "left_child", "right_child", "decision_type",
+                "cat_boundaries", "cat_threshold")
     _vec_float = ("threshold", "leaf_value", "split_gain",
                   "leaf_const", "leaf_coeff")
     for raw in text.splitlines():
@@ -1147,6 +1333,7 @@ def _tree_to_host(tree: TreeArrays) -> dict[str, np.ndarray]:
         "right": np.asarray(tree.right),
         "value": np.asarray(tree.value),
         "gain": np.asarray(tree.gain),
+        "cat_bitset": np.asarray(tree.cat_bitset),
     }
 
 

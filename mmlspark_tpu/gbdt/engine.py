@@ -20,9 +20,16 @@ TPU-first redesign:
     identical trees from the identical summed histogram, mirroring the
     reference's replicated-model-by-construction design
     (LightGBMClassifier.scala:82-85 `.reduce((b1,_)=>b1)`).
-  - Categorical splits are one-vs-rest on a single bin (LightGBM's
-    cat_smooth/max_cat_threshold refinements are approximated by
-    frequency-ordered bins from binning.py).
+  - Categorical splits are LightGBM's many-vs-many sorted-subset search
+    (LightGBMUtils.scala:63-88 metadata feeding lib_lightgbm's categorical
+    path): at each node the categories are ordered by grad/(hess+cat_smooth)
+    and scanned as prefixes of that ordering, exactly like a numeric
+    feature — the winning prefix becomes a per-node category BITSET
+    (TreeArrays.cat_bitset) that routes rows. cat_l2 adds extra L2 to
+    categorical split gains; max_cat_threshold caps the smaller side of the
+    subset; the other/unseen bin (0) always routes right, matching
+    LightGBM's unseen-category semantics and keeping every trained model
+    expressible in its finite on-file bitsets.
 """
 
 from __future__ import annotations
@@ -46,13 +53,17 @@ class TreeArrays(NamedTuple):
     """SoA tree layout (M = 2*num_leaves - 1 nodes, fixed)."""
 
     feature: jnp.ndarray        # (M,) int32, -1 on leaves
-    threshold_bin: jnp.ndarray  # (M,) int32 (<= goes left; == for categorical)
+    threshold_bin: jnp.ndarray  # (M,) int32 (numeric: <= goes left;
+                                #  categorical: sorted-prefix length - 1)
     is_categorical: jnp.ndarray # (M,) bool
     left: jnp.ndarray           # (M,) int32, -1 on leaves
     right: jnp.ndarray          # (M,) int32
     value: jnp.ndarray          # (M,) float32 (already shrunk by learning_rate)
     is_leaf: jnp.ndarray        # (M,) bool
     gain: jnp.ndarray           # (M,) float32 split gain (importance bookkeeping)
+    cat_bitset: jnp.ndarray     # (M, B) bool — bins routed LEFT at a
+                                # categorical node (many-vs-many subset);
+                                # all-False on numeric/leaf nodes
 
 
 class GrowConfig(NamedTuple):
@@ -77,6 +88,11 @@ class GrowConfig(NamedTuple):
     # permutation. Off by default: plain psum is faster and the replicated
     # model is still self-consistent within one compiled program.
     deterministic: bool = False
+    # categorical split controls (LightGBM's cat_smooth / cat_l2 /
+    # max_cat_threshold, with LightGBM's defaults)
+    cat_smooth: float = 10.0
+    cat_l2: float = 10.0
+    max_cat_threshold: int = 32
 
 
 def pad_rows(n: int, shards: int) -> int:
@@ -97,9 +113,10 @@ def tree_apply(tree: "TreeArrays", bins, max_steps: int):
     def body(_, node):
         f = jnp.maximum(tree.feature[node], 0)
         col = bins[jnp.arange(n), f]
+        bcol = jnp.minimum(col, tree.cat_bitset.shape[-1] - 1)
         go_left = jnp.where(
             tree.is_categorical[node],
-            col == tree.threshold_bin[node],
+            tree.cat_bitset[node, bcol],
             col <= tree.threshold_bin[node],
         )
         leaf = tree.feature[node] < 0
@@ -176,12 +193,35 @@ def make_grow_fn(
             return _histogram(bins, stats, num_bins)           # (F, B, 3)
 
         # -- static bin-validity masks ---------------------------------
+        cat_any = bool(np.asarray(categorical_mask).any())
         bin_idx = jnp.arange(num_bins)                         # (B,)
         # numeric: can split at any bin except the last real one
         valid_num = bin_idx[None, :] < (fbins[:, None] - 1)    # (F, B)
-        # categorical: any real bin can be the one-vs-rest bin
-        valid_cat = bin_idx[None, :] < fbins[:, None]
+        # categorical: positions index PREFIXES of the per-node sorted
+        # category ordering (many-vs-many); a prefix of size k+1 must leave
+        # at least one real category on the right, and the smaller side of
+        # the subset is capped by max_cat_threshold (LightGBM semantics)
+        n_cats = fbins[:, None] - 1                            # excl. other-bin 0
+        kp1 = bin_idx[None, :] + 1
+        valid_cat = (kp1 <= n_cats - 1) & (
+            jnp.minimum(kp1, n_cats - kp1) <= cfg.max_cat_threshold
+        )
         valid_base = jnp.where(is_cat_f[:, None], valid_cat, valid_num)
+
+        def cat_order(hist, fb):
+            """Per-node category ordering by grad/(hess + cat_smooth) —
+            the sort underlying LightGBM's many-vs-many subset search.
+            The other/missing bin (0), empty bins, and out-of-range bins
+            key to +inf so they sort last and never join a (valid) left
+            prefix: unseen categories route RIGHT, which also keeps every
+            trained model expressible in LightGBM's finite on-file
+            bitsets. argsort is stable, so recomputing at split time
+            reproduces the gain scan's ordering bit-for-bit."""
+            g, h, c = hist[..., 0], hist[..., 1], hist[..., 2]
+            ratio = g / (h + cfg.cat_smooth)
+            pos = jnp.arange(num_bins)
+            pushed = (pos == 0) | (c <= 0) | (pos >= fb[..., None])
+            return jnp.argsort(jnp.where(pushed, jnp.inf, ratio), axis=-1)
 
         # -- voting-parallel feature pre-selection (per tree) -----------
         # Each shard proposes top-k features by LOCAL root-split gain
@@ -193,10 +233,24 @@ def make_grow_fn(
         def split_gain_tensor(hist, ng, nh, nc, vb):
             """(F,B) split gains for one node's histogram — the single source
             of the gain/constraint rule (shared by the splitter and the
-            voting ranking so they can never drift apart)."""
+            voting ranking so they can never drift apart).
+
+            Numeric columns: position b = split at bin b (cumulative left).
+            Categorical columns: position k = left set is the first k+1
+            categories of this node's grad/hess-sorted order (cumulative
+            over the SORTED histogram), with cat_l2 extra regularization."""
             cum = jnp.cumsum(hist, axis=1)
-            # numeric: left = bins <= b (cumulative); categorical: left = bin == b
-            left = jnp.where(is_cat_f[:, None, None], hist, cum)
+            if cat_any:
+                order = cat_order(hist, fbins)                 # (F, B)
+                sorted_hist = jnp.take_along_axis(
+                    hist, order[..., None], axis=1
+                )
+                left = jnp.where(
+                    is_cat_f[:, None, None],
+                    jnp.cumsum(sorted_hist, axis=1), cum,
+                )
+            else:
+                left = cum
             gl, hl, cl = left[..., 0], left[..., 1], left[..., 2]
             gr, hr, cr = ng - gl, nh - hl, nc - cl
             ok = (
@@ -212,6 +266,14 @@ def make_grow_fn(
                 + _leaf_objective(gr, hr, cfg.lambda_l1, cfg.lambda_l2)
                 - parent
             )
+            if cat_any:
+                l2c = cfg.lambda_l2 + cfg.cat_l2
+                gain_cat = (
+                    _leaf_objective(gl, hl, cfg.lambda_l1, l2c)
+                    + _leaf_objective(gr, hr, cfg.lambda_l1, l2c)
+                    - _leaf_objective(ng, nh, cfg.lambda_l1, l2c)
+                )
+                gain = jnp.where(is_cat_f[:, None], gain_cat, gain)
             return jnp.where(ok, gain, -jnp.inf)
 
         sel_vec = None      # (F,) 0/1 — None = all features (data-parallel)
@@ -277,6 +339,7 @@ def make_grow_fn(
             value=jnp.zeros((m,), jnp.float32),
             is_leaf=jnp.zeros((m,), bool).at[0].set(True),
             gain=jnp.zeros((m,), jnp.float32),
+            cat_bitset=jnp.zeros((m, num_bins), bool),
         )
         node_of_row = jnp.zeros((n,), jnp.int32)
         if axis_name is not None:
@@ -333,7 +396,21 @@ def make_grow_fn(
             nl_id = jnp.minimum(num_nodes, m - 2)
             nr_id = nl_id + 1
             col = bins[jnp.arange(n), jnp.broadcast_to(f, (n,))]
-            go_left = jnp.where(cat, col == b, col <= b)
+            if cat_any:
+                # materialize the winning prefix of this node's sorted
+                # category order as a bitset over bins (the many-vs-many
+                # left set); cat_order on the stored node histogram
+                # reproduces the gain scan's ordering exactly
+                order_f = cat_order(hists[p, f], fbins[f])     # (B,)
+                in_prefix = jnp.arange(num_bins) <= b
+                bitset = (
+                    jnp.zeros((num_bins,), bool).at[order_f].set(in_prefix)
+                    & cat
+                )
+                go_left = jnp.where(cat, bitset[col], col <= b)
+            else:
+                bitset = jnp.zeros((num_bins,), bool)
+                go_left = col <= b
             in_p = (node_of_row == p) & act
             node_of_row = jnp.where(
                 in_p, jnp.where(go_left, nl_id, nr_id), node_of_row
@@ -346,6 +423,9 @@ def make_grow_fn(
                 feature=tree.feature.at[p].set(gated(tree.feature[p], f)),
                 threshold_bin=tree.threshold_bin.at[p].set(gated(tree.threshold_bin[p], b)),
                 is_categorical=tree.is_categorical.at[p].set(gated(tree.is_categorical[p], cat)),
+                cat_bitset=tree.cat_bitset.at[p].set(
+                    gated(tree.cat_bitset[p], bitset)
+                ),
                 left=tree.left.at[p].set(gated(tree.left[p], nl_id)),
                 right=tree.right.at[p].set(gated(tree.right[p], nr_id)),
                 is_leaf=(tree.is_leaf

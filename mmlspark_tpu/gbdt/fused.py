@@ -59,7 +59,7 @@ _FUSED_CACHE: dict = {}
 _FUSED_CACHE_MAX = 8
 
 
-def _zero_tree(num_leaves: int) -> TreeArrays:
+def _zero_tree(num_leaves: int, num_bins: int) -> TreeArrays:
     m = 2 * num_leaves - 1
     return TreeArrays(
         feature=jnp.full((m,), -1, jnp.int32),
@@ -70,6 +70,7 @@ def _zero_tree(num_leaves: int) -> TreeArrays:
         value=jnp.zeros((m,), jnp.float32),
         is_leaf=jnp.zeros((m,), bool).at[0].set(True),
         gain=jnp.zeros((m,), jnp.float32),
+        cat_bitset=jnp.zeros((m, num_bins), bool),
     )
 
 
@@ -251,7 +252,7 @@ def make_fused_train_fn(
 
             def inactive(op):
                 pred, bag, val_raw = op
-                z = _zero_tree(cfg.num_leaves)
+                z = _zero_tree(cfg.num_leaves, num_bins)
                 if k > 1:
                     z = jax.tree.map(
                         lambda a: jnp.broadcast_to(a, (k,) + a.shape), z

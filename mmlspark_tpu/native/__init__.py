@@ -90,7 +90,7 @@ def get_lib() -> "ctypes.CDLL | None":
         lib.mmlspark_predict_trees.argtypes = [
             _I32, _I64, _I64, _I64, _I64,
             _I32, _I32, _U8, _I32, _I32, _F32, _I32,
-            ctypes.c_int32, ctypes.c_int32, ctypes.c_float, _F32,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_float, _U8, _I64, _F32,
         ]
         lib.mmlspark_predict_trees.restype = None
         lib.mmlspark_csv_parse.argtypes = [
@@ -154,13 +154,19 @@ def csv_parse(data: bytes, offsets: np.ndarray, n_cols: int,
 def predict_trees(bins: np.ndarray, feature: np.ndarray, threshold: np.ndarray,
                   is_cat: np.ndarray, left: np.ndarray, right: np.ndarray,
                   value: np.ndarray, tree_class: np.ndarray, k: int,
-                  max_steps: int, init_score: float) -> "np.ndarray | None":
-    """SoA tree-walk scoring; None when the native lib is unavailable."""
+                  max_steps: int, init_score: float,
+                  cat_bitset: "np.ndarray | None" = None
+                  ) -> "np.ndarray | None":
+    """SoA tree-walk scoring; None when the native lib is unavailable.
+    cat_bitset: (T, M, Bc) bool left-subset masks for categorical nodes."""
     lib = get_lib()
     if lib is None:
         return None
     n, f = bins.shape
     t, m = feature.shape
+    if cat_bitset is None:
+        cat_bitset = np.zeros((t, m, 1), bool)
+    bc = cat_bitset.shape[-1]
     out = (np.zeros((n, k), np.float32) if k > 1 else np.zeros((n,), np.float32))
     lib.mmlspark_predict_trees(
         np.ascontiguousarray(bins, np.int32), n, f, t, m,
@@ -171,6 +177,7 @@ def predict_trees(bins: np.ndarray, feature: np.ndarray, threshold: np.ndarray,
         np.ascontiguousarray(right, np.int32),
         np.ascontiguousarray(value, np.float32),
         np.ascontiguousarray(tree_class, np.int32),
-        k, max_steps, float(init_score), out,
+        k, max_steps, float(init_score),
+        np.ascontiguousarray(cat_bitset, np.uint8), bc, out,
     )
     return out

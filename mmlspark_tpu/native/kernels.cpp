@@ -203,6 +203,8 @@ void mmlspark_predict_trees(
     int32_t k,                  // 1 = scalar output, >1 = (n, k) multiclass
     int32_t max_steps,
     float init_score,
+    const uint8_t* cat_bitset,  // (T, M, Bc) — bins routed left at cat nodes
+    int64_t bc,                 // Bc (bitset width; >= 1)
     float* out)                 // (n,) or (n, k), pre-zeroed
 {
     if (k <= 1) {
@@ -216,6 +218,7 @@ void mmlspark_predict_trees(
         const int32_t* tl = left + off;
         const int32_t* tr = right + off;
         const float* tv = value + off;
+        const uint8_t* tb = cat_bitset + off * bc;
         const int32_t cls = tree_class[t];
         for (int64_t i = 0; i < n; ++i) {
             int32_t node = 0;
@@ -223,8 +226,12 @@ void mmlspark_predict_trees(
                 const int32_t feat = tf[node];
                 if (feat < 0) break;  // leaf
                 const int32_t col = bins[i * f + feat];
-                const bool go_left = tc[node] ? (col == tt[node])
-                                              : (col <= tt[node]);
+                // categorical: many-vs-many subset lookup (bins past the
+                // bitset width can only occur on numeric columns)
+                const int64_t bcol = col < bc ? col : bc - 1;
+                const bool go_left = tc[node]
+                    ? (tb[node * bc + bcol] != 0)
+                    : (col <= tt[node]);
                 node = go_left ? tl[node] : tr[node];
             }
             if (k > 1) {
