@@ -23,13 +23,25 @@ What the mapper translates (torchvision ResNet naming -> nn.models.ResNet):
 The result is validated leaf-for-leaf (path and shape) against the target
 module's own `init` tree, so a wrong transpose or a missing block fails
 loudly at import time, not silently at serving time.
+
+Beyond the hand-written ResNet mapper, `MapRule`/`apply_mapping_spec`
+define a DECLARATIVE mapping language (anchored regex -> target path +
+layout transform) so new checkpoint families are a rule table, not a new
+parser; `TRANSFORMER_SPEC` maps HF-style flat encoder state dicts
+(`encoder.layer.<i>.attention.self.query.weight`, torch (out,in) layouts)
+onto nn.models.TransformerEncoder. Note the architecture here is pre-LN
+(ln before attention/mlp, final ln before pooling): checkpoints from
+post-LN models (original BERT) carry the same tensor NAMES but different
+math — importing one gives a well-formed model that is not
+weight-equivalent to its source. The spec documents naming + layout, not
+architectural equivalence.
 """
 
 from __future__ import annotations
 
 import os
 import re
-from typing import Any, Mapping
+from typing import Any, Callable, Mapping, NamedTuple
 
 import numpy as np
 
@@ -37,6 +49,13 @@ __all__ = [
     "load_state_dict",
     "torch_resnet_to_flax",
     "import_torch_resnet",
+    "MapRule",
+    "apply_mapping_spec",
+    "TRANSFORMER_SPEC",
+    "torch_transformer_to_flax",
+    "import_torch_transformer",
+    "import_external_weights",
+    "IMPORTERS",
 ]
 
 
@@ -150,6 +169,137 @@ def torch_resnet_to_flax(
     return {"params": params, "batch_stats": batch_stats}
 
 
+# --------------------------------------------------------------------- #
+# declarative mapping specs                                             #
+# --------------------------------------------------------------------- #
+
+
+class MapRule(NamedTuple):
+    """One mapping rule: `pattern` is an anchored regex over state-dict
+    keys; `target` is a '/'-joined destination path whose FIRST segment
+    names the collection (params | batch_stats), either a template string
+    (regex group expansion via m.expand) or a callable(match) -> str;
+    None drops the tensor (framework-only bookkeeping). `transform`
+    (value, ctx) -> value converts torch layouts to flax (ctx carries
+    model config the shapes alone can't determine, e.g. num_heads)."""
+
+    pattern: str
+    target: "str | Callable | None"
+    transform: "Callable[[np.ndarray, dict], np.ndarray] | None" = None
+
+
+def apply_mapping_spec(
+    state_dict: Mapping[str, np.ndarray],
+    rules: "list[MapRule]",
+    ctx: "dict | None" = None,
+) -> dict[str, Any]:
+    """Run a rule table over a flat state dict -> flax variables.
+
+    First matching rule wins; a key no rule matches raises (silent drops
+    are how transposed/missing weights slip through to garbage
+    activations — same contract as the hand-written ResNet mapper)."""
+    ctx = ctx or {}
+    compiled = [(re.compile(r.pattern), r) for r in rules]
+    out: dict[str, Any] = {"params": {}, "batch_stats": {}}
+    for name, value in state_dict.items():
+        for cre, rule in compiled:
+            m = cre.fullmatch(name)
+            if m is None:
+                continue
+            if rule.target is None:
+                break
+            target = (rule.target(m) if callable(rule.target)
+                      else m.expand(rule.target))
+            path = tuple(target.split("/"))
+            if path[0] not in out:
+                raise ValueError(
+                    f"rule for {name!r} targets unknown collection {path[0]!r}"
+                )
+            v = np.asarray(value)
+            if rule.transform is not None:
+                v = rule.transform(v, ctx)
+            _assign(out[path[0]], path[1:], v)
+            break
+        else:
+            raise ValueError(f"unrecognized state-dict key {name!r}")
+    return out
+
+
+def _t_transpose(v, ctx):
+    """torch Dense (out, in) -> flax (in, out)."""
+    return np.transpose(v, (1, 0))
+
+
+def _t_qkv_kernel(v, ctx):
+    """torch (D, D) projection -> flax MHA DenseGeneral (D, H, D//H)."""
+    d_model, h = v.shape[1], ctx["num_heads"]
+    return np.transpose(v, (1, 0)).reshape(d_model, h, v.shape[0] // h)
+
+
+def _t_qkv_bias(v, ctx):
+    h = ctx["num_heads"]
+    return v.reshape(h, v.shape[0] // h)
+
+
+def _t_attn_out_kernel(v, ctx):
+    """torch (D_out, D_in) output projection -> flax (H, D_in//H, D_out)."""
+    h = ctx["num_heads"]
+    return np.transpose(v, (1, 0)).reshape(h, v.shape[1] // h, v.shape[0])
+
+
+# HF-style flat naming for a PRE-LN encoder (see module docstring for the
+# post-LN caveat): attention.ln / mlp.ln are the pre-attention and pre-mlp
+# layer norms, final_layer_norm closes the stack, classifier is the head.
+TRANSFORMER_SPEC: "list[MapRule]" = [
+    MapRule(r"embeddings\.word_embeddings\.weight", "params/embed/embedding"),
+    MapRule(r"embeddings\.position_embeddings\.weight", "params/pos_embed"),
+    MapRule(r"stem\.weight", "params/stem/kernel", _t_transpose),
+    MapRule(r"stem\.bias", "params/stem/bias"),
+    MapRule(r"encoder\.layer\.(?P<i>\d+)\.attention\.ln\.weight",
+            r"params/ln_attn_\g<i>/scale"),
+    MapRule(r"encoder\.layer\.(?P<i>\d+)\.attention\.ln\.bias",
+            r"params/ln_attn_\g<i>/bias"),
+    MapRule(r"encoder\.layer\.(?P<i>\d+)\.attention\.self\."
+            r"(?P<proj>query|key|value)\.weight",
+            r"params/attn_\g<i>/\g<proj>/kernel", _t_qkv_kernel),
+    MapRule(r"encoder\.layer\.(?P<i>\d+)\.attention\.self\."
+            r"(?P<proj>query|key|value)\.bias",
+            r"params/attn_\g<i>/\g<proj>/bias", _t_qkv_bias),
+    MapRule(r"encoder\.layer\.(?P<i>\d+)\.attention\.output\.dense\.weight",
+            r"params/attn_\g<i>/out/kernel", _t_attn_out_kernel),
+    MapRule(r"encoder\.layer\.(?P<i>\d+)\.attention\.output\.dense\.bias",
+            r"params/attn_\g<i>/out/bias"),
+    MapRule(r"encoder\.layer\.(?P<i>\d+)\.mlp\.ln\.weight",
+            r"params/ln_mlp_\g<i>/scale"),
+    MapRule(r"encoder\.layer\.(?P<i>\d+)\.mlp\.ln\.bias",
+            r"params/ln_mlp_\g<i>/bias"),
+    MapRule(r"encoder\.layer\.(?P<i>\d+)\.intermediate\.dense\.weight",
+            r"params/mlp_up_\g<i>/kernel", _t_transpose),
+    MapRule(r"encoder\.layer\.(?P<i>\d+)\.intermediate\.dense\.bias",
+            r"params/mlp_up_\g<i>/bias"),
+    MapRule(r"encoder\.layer\.(?P<i>\d+)\.output\.dense\.weight",
+            r"params/mlp_down_\g<i>/kernel", _t_transpose),
+    MapRule(r"encoder\.layer\.(?P<i>\d+)\.output\.dense\.bias",
+            r"params/mlp_down_\g<i>/bias"),
+    MapRule(r"final_layer_norm\.weight", "params/ln_final/scale"),
+    MapRule(r"final_layer_norm\.bias", "params/ln_final/bias"),
+    MapRule(r"classifier\.weight", "params/head/kernel", _t_transpose),
+    MapRule(r"classifier\.bias", "params/head/bias"),
+    MapRule(r".*\.num_batches_tracked", None),
+]
+
+
+def torch_transformer_to_flax(
+    state_dict: Mapping[str, np.ndarray], num_heads: int,
+) -> dict[str, Any]:
+    """Map an HF-style flat encoder state dict onto
+    nn.models.TransformerEncoder variables. num_heads is required: the
+    fused (D, D) projection shapes cannot determine the head split."""
+    return apply_mapping_spec(
+        state_dict, TRANSFORMER_SPEC, {"num_heads": int(num_heads)}
+    )
+
+
 def _tree_leaves(tree: Any, prefix: str = "") -> dict[str, tuple[int, ...]]:
     out: dict[str, tuple[int, ...]] = {}
     if isinstance(tree, Mapping):
@@ -198,6 +348,16 @@ def import_torch_resnet(
         ),
         num_outputs=int(num_outputs), **config,
     )
+    return _validate_and_install(bundle, variables, architecture)
+
+
+def _validate_and_install(bundle, variables, architecture: str):
+    """Leaf-for-leaf validation against the architecture's own init tree
+    (every path present on both sides, same shape), then install the
+    imported arrays as float32 device arrays. Shared by every importer so
+    a new family can't skip the check."""
+    import jax.numpy as jnp
+
     want = _tree_leaves(bundle.variables)
     got = _tree_leaves(variables)
     missing = sorted(set(want) - set(got))
@@ -214,10 +374,106 @@ def import_torch_resnet(
         )
         raise ValueError(f"imported weights do not fit {architecture}: {detail}")
     bundle.variables = {
-        "params": _as_jnp(variables["params"], jnp),
-        "batch_stats": _as_jnp(variables["batch_stats"], jnp),
+        k: _as_jnp(variables.get(k, {}), jnp) for k in bundle.variables
     }
     return bundle
+
+
+def import_torch_transformer(
+    path: str,
+    architecture: str = "transformer",
+    num_outputs: int | None = None,
+    input_shape: tuple[int, ...] = (),
+    preprocess: dict | None = None,
+    class_labels=None,
+    **config,
+):
+    """Load HF-style flat encoder weights into a ready-to-serve
+    ModelBundle (the second imported family next to ResNet; reference
+    parity anchor: ModelDownloader ingesting arbitrary published models,
+    Schema.scala:30-119).
+
+    Model dimensions are inferred from the checkpoint where shapes
+    determine them (d_model/vocab_size from the embedding, num_layers
+    from the layer indexes, d_ff from the mlp width, max_len from the
+    position table, num_outputs from the classifier); `num_heads` cannot
+    be inferred and must come from config (default 4)."""
+    sd = load_state_dict(path)
+    cfg = dict(config)
+    emb = sd.get("embeddings.word_embeddings.weight")
+    stem = sd.get("stem.weight")
+    if emb is not None:
+        cfg.setdefault("vocab_size", int(emb.shape[0]))
+        cfg.setdefault("d_model", int(emb.shape[1]))
+    elif stem is not None:
+        cfg.setdefault("vocab_size", 0)
+        cfg.setdefault("d_model", int(stem.shape[0]))
+    else:
+        raise ValueError(
+            "state dict has neither embeddings.word_embeddings.weight nor "
+            "stem.weight; not an encoder checkpoint this spec understands"
+        )
+    layer_ids = [
+        int(m.group(1)) for m in
+        (re.match(r"encoder\.layer\.(\d+)\.", k) for k in sd)
+        if m is not None
+    ]
+    if not layer_ids:
+        raise ValueError("state dict has no encoder.layer.<i> tensors")
+    cfg.setdefault("num_layers", max(layer_ids) + 1)
+    up0 = sd.get("encoder.layer.0.intermediate.dense.weight")
+    if up0 is not None:
+        cfg.setdefault("d_ff", int(up0.shape[0]))
+    pos = sd.get("embeddings.position_embeddings.weight")
+    if pos is not None:
+        cfg.setdefault("max_len", int(pos.shape[0]))
+    if num_outputs is None:
+        head = sd.get("classifier.weight")
+        if head is None:
+            raise ValueError("state dict has no classifier.weight; "
+                             "pass num_outputs")
+        num_outputs = int(head.shape[0])
+    cfg.setdefault("num_heads", 4)
+    if cfg["d_model"] % cfg["num_heads"]:
+        raise ValueError(
+            f"d_model {cfg['d_model']} is not divisible by num_heads "
+            f"{cfg['num_heads']}"
+        )
+    variables = torch_transformer_to_flax(sd, num_heads=cfg["num_heads"])
+
+    from .models import ModelBundle
+
+    if not input_shape:
+        # one token position is enough to trace init; the pos table is
+        # sized by max_len, not by the probe length
+        input_shape = (8,) if cfg.get("vocab_size") else (8, 1)
+    bundle = ModelBundle.init(
+        architecture, input_shape=tuple(input_shape), seed=0,
+        class_labels=class_labels, preprocess=dict(preprocess or {}),
+        num_outputs=int(num_outputs), **cfg,
+    )
+    return _validate_and_install(bundle, variables, architecture)
+
+
+# architecture name -> importer; zoo.import_external dispatches here, so
+# registering a new family makes it fetchable/verifiable end to end
+IMPORTERS: "dict[str, Callable]" = {
+    "resnet": import_torch_resnet,
+    "resnet50": import_torch_resnet,
+    "resnet20_cifar": import_torch_resnet,
+    "transformer": import_torch_transformer,
+}
+
+
+def import_external_weights(path: str, architecture: str, **kw):
+    """Dispatch an external checkpoint to its family importer."""
+    imp = IMPORTERS.get(architecture)
+    if imp is None:
+        raise ValueError(
+            f"no weight importer registered for architecture "
+            f"{architecture!r}; known: {sorted(IMPORTERS)}"
+        )
+    return imp(path, architecture=architecture, **kw)
 
 
 def _as_jnp(tree, jnp):

@@ -261,15 +261,17 @@ class ModelDownloader:
             typed = tmp[: -len(".tmp")]
             os.replace(tmp, typed)
             tmp = typed
-            from .import_weights import import_torch_resnet
+            from .import_weights import import_external_weights
 
-            bundle = import_torch_resnet(
+            kw = dict(schema.extra.get("config", {}))
+            if schema.input_shape:
+                kw["input_shape"] = tuple(schema.input_shape)
+            bundle = import_external_weights(
                 tmp,
                 architecture=schema.architecture or "resnet50",
                 num_outputs=schema.num_outputs,
-                input_shape=tuple(schema.input_shape) or (224, 224, 3),
                 class_labels=schema.class_labels,
-                **schema.extra.get("config", {}),
+                **kw,
             )
             bundle.save(dest)
         finally:

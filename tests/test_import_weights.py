@@ -179,6 +179,158 @@ class TestActivations:
         np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-4)
 
 
+TRANSFORMER_FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                                   "imported_transformer_logits.json")
+
+# tiny encoder checkpoint dimensions (documented manifest, written out from
+# the spec's naming contract — NOT produced by the importer under test)
+T_VOCAB, T_DMODEL, T_HEADS, T_DFF, T_LAYERS, T_MAXLEN, T_OUT = (
+    37, 16, 4, 32, 2, 24, 3
+)
+
+
+def transformer_manifest() -> "dict[str, tuple[int, ...]]":
+    m: dict[str, tuple[int, ...]] = {
+        "embeddings.word_embeddings.weight": (T_VOCAB, T_DMODEL),
+        "embeddings.position_embeddings.weight": (T_MAXLEN, T_DMODEL),
+        "final_layer_norm.weight": (T_DMODEL,),
+        "final_layer_norm.bias": (T_DMODEL,),
+        "classifier.weight": (T_OUT, T_DMODEL),
+        "classifier.bias": (T_OUT,),
+    }
+    for i in range(T_LAYERS):
+        p = f"encoder.layer.{i}"
+        m[f"{p}.attention.ln.weight"] = (T_DMODEL,)
+        m[f"{p}.attention.ln.bias"] = (T_DMODEL,)
+        for proj in ("query", "key", "value"):
+            m[f"{p}.attention.self.{proj}.weight"] = (T_DMODEL, T_DMODEL)
+            m[f"{p}.attention.self.{proj}.bias"] = (T_DMODEL,)
+        m[f"{p}.attention.output.dense.weight"] = (T_DMODEL, T_DMODEL)
+        m[f"{p}.attention.output.dense.bias"] = (T_DMODEL,)
+        m[f"{p}.mlp.ln.weight"] = (T_DMODEL,)
+        m[f"{p}.mlp.ln.bias"] = (T_DMODEL,)
+        m[f"{p}.intermediate.dense.weight"] = (T_DFF, T_DMODEL)
+        m[f"{p}.intermediate.dense.bias"] = (T_DFF,)
+        m[f"{p}.output.dense.weight"] = (T_DMODEL, T_DFF)
+        m[f"{p}.output.dense.bias"] = (T_DMODEL,)
+    return m
+
+
+def synthetic_transformer_state_dict(seed: int = 1) -> "dict[str, np.ndarray]":
+    rng = np.random.default_rng(seed)
+    sd = {}
+    for name, shape in transformer_manifest().items():
+        if name.endswith("ln.weight") or name == "final_layer_norm.weight":
+            sd[name] = (1.0 + 0.05 * rng.standard_normal(shape)).astype(
+                np.float32)
+        else:
+            sd[name] = (0.2 * rng.standard_normal(shape)).astype(np.float32)
+    return sd
+
+
+class TestTransformerImport:
+    @pytest.fixture(scope="class")
+    def tbundle(self, tmp_path_factory):
+        from mmlspark_tpu.nn.import_weights import import_torch_transformer
+
+        d = tmp_path_factory.mktemp("tweights")
+        path = os.path.join(d, "encoder.npz")
+        np.savez(path, **synthetic_transformer_state_dict())
+        return import_torch_transformer(path, num_heads=T_HEADS)
+
+    def test_dims_inferred_from_checkpoint(self, tbundle):
+        cfg = tbundle.config
+        assert cfg["vocab_size"] == T_VOCAB
+        assert cfg["d_model"] == T_DMODEL
+        assert cfg["num_layers"] == T_LAYERS
+        assert cfg["d_ff"] == T_DFF
+        assert cfg["max_len"] == T_MAXLEN
+        assert cfg["num_outputs"] == T_OUT
+
+    def test_qkv_reshape_layout(self):
+        """torch (out,in) q/k/v weights land as flax (in, H, out/H) with
+        the head split on the OUTPUT axis after the transpose."""
+        from mmlspark_tpu.nn.import_weights import torch_transformer_to_flax
+
+        sd = synthetic_transformer_state_dict()
+        v = torch_transformer_to_flax(sd, num_heads=T_HEADS)
+        w = sd["encoder.layer.0.attention.self.query.weight"]
+        k = v["params"]["attn_0"]["query"]["kernel"]
+        dh = T_DMODEL // T_HEADS
+        assert k.shape == (T_DMODEL, T_HEADS, dh)
+        # out index o = h*dh + j; kernel[i, h, j] == w[o, i]
+        np.testing.assert_array_equal(k[3, 2, 1], w[2 * dh + 1, 3])
+        out_k = v["params"]["attn_0"]["out"]["kernel"]
+        assert out_k.shape == (T_HEADS, dh, T_DMODEL)
+        wo = sd["encoder.layer.0.attention.output.dense.weight"]
+        np.testing.assert_array_equal(out_k[2, 1, 5], wo[5, 2 * dh + 1])
+
+    def test_unknown_key_raises(self):
+        from mmlspark_tpu.nn.import_weights import torch_transformer_to_flax
+
+        sd = synthetic_transformer_state_dict()
+        sd["pooler.dense.weight"] = np.zeros((4, 4), np.float32)
+        with pytest.raises(ValueError, match="unrecognized"):
+            torch_transformer_to_flax(sd, num_heads=T_HEADS)
+
+    def test_missing_layer_raises(self, tmp_path):
+        from mmlspark_tpu.nn.import_weights import import_torch_transformer
+
+        sd = synthetic_transformer_state_dict()
+        sd.pop("encoder.layer.1.mlp.ln.weight")
+        path = os.path.join(tmp_path, "broken.npz")
+        np.savez(path, **sd)
+        with pytest.raises(ValueError, match="missing"):
+            import_torch_transformer(path, num_heads=T_HEADS)
+
+    def test_bad_head_count_raises(self, tmp_path):
+        from mmlspark_tpu.nn.import_weights import import_torch_transformer
+
+        path = os.path.join(tmp_path, "enc.npz")
+        np.savez(path, **synthetic_transformer_state_dict())
+        with pytest.raises(ValueError, match="num_heads"):
+            import_torch_transformer(path, num_heads=5)
+
+    def test_forward_matches_committed_fixture(self, tbundle):
+        import jax
+
+        tokens = np.arange(2 * 12).reshape(2, 12) % T_VOCAB
+        logits = np.asarray(jax.jit(
+            lambda v, xb: tbundle.module.apply(v, xb, train=False)
+        )(tbundle.variables, tokens.astype(np.int32)))
+        assert logits.shape == (2, T_OUT) and np.isfinite(logits).all()
+        got = logits.tolist()
+        if os.environ.get("MMLSPARK_TPU_REGEN_IMPORT_FIXTURE"):
+            os.makedirs(os.path.dirname(TRANSFORMER_FIXTURE), exist_ok=True)
+            with open(TRANSFORMER_FIXTURE, "w") as fh:
+                json.dump({"logits_2x3": got}, fh, indent=2)
+            pytest.skip("fixture regenerated")
+        assert os.path.exists(TRANSFORMER_FIXTURE), (
+            "run with MMLSPARK_TPU_REGEN_IMPORT_FIXTURE=1 to create the fixture"
+        )
+        with open(TRANSFORMER_FIXTURE) as fh:
+            want = np.asarray(json.load(fh)["logits_2x3"])
+        np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-4)
+
+    def test_zoo_dispatches_transformer(self, tmp_path):
+        from mmlspark_tpu.nn.zoo import ModelDownloader, ModelSchema
+
+        src = os.path.join(tmp_path, "src", "encoder.npz")
+        os.makedirs(os.path.dirname(src))
+        np.savez(src, **synthetic_transformer_state_dict())
+        dl = ModelDownloader(os.path.join(tmp_path, "repo"))
+        schema = ModelSchema(
+            name="tiny_encoder", uri=src, architecture="transformer",
+            num_outputs=T_OUT,
+            extra={"config": {"num_heads": T_HEADS}},
+        )
+        dest = dl.import_external(schema)
+        assert os.path.exists(dest)
+        loaded = dl.load_bundle("tiny_encoder")
+        assert loaded.architecture == "transformer"
+        assert loaded.config["num_heads"] == T_HEADS
+
+
 class TestZooAndFeaturizer:
     def test_zoo_import_external_roundtrip(self, tmp_path):
         from safetensors.numpy import save_file
