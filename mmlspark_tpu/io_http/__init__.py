@@ -23,6 +23,7 @@ from .transformer import (
     CustomInputParser,
     CustomOutputParser,
 )
+from .journal import ServingJournal
 from .serving import MicroBatchQuery, ServingFleet, ServingServer, serve_model
 from .consolidator import PartitionConsolidator
 from .powerbi import PowerBIWriter
@@ -64,6 +65,7 @@ __all__ = [
     "CustomInputParser",
     "CustomOutputParser",
     "MicroBatchQuery",
+    "ServingJournal",
     "ServingFleet",
     "ServingServer",
     "serve_model",

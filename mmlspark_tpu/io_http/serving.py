@@ -74,11 +74,19 @@ class ServingServer:
         reply_timeout_s: float = 30.0,
         api_path: str = "/",
         mode: str = "continuous",
+        checkpoint_dir: str | None = None,
     ):
         if mode not in ("continuous", "batch"):
             raise ValueError(f"mode must be 'continuous' or 'batch', got {mode!r}")
         if mode == "continuous" and handler is None:
             raise ValueError("continuous mode needs a handler(Table) -> Table")
+        if checkpoint_dir is not None and mode != "batch":
+            raise ValueError(
+                "checkpoint_dir journals the micro-batch source; it "
+                "requires mode='batch' (the reference's checkpointLocation "
+                "applies to the streaming query, "
+                "docs/mmlspark-serving.md:50-52)"
+            )
         self.handler = handler
         self.host, self.port = host, port
         self.max_batch_size = max_batch_size
@@ -96,6 +104,20 @@ class ServingServer:
         self._server: ThreadingHTTPServer | None = None
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
+        # durable accept/reply journal (reference checkpointLocation,
+        # DistributedHTTPSource.scala:308-343): accepted-but-unanswered
+        # requests survive a restart and are replayed by the next query
+        self.journal = None
+        if checkpoint_dir is not None:
+            from .journal import ServingJournal
+
+            self.journal = ServingJournal(checkpoint_dir)
+            # never reuse a journaled id after restart
+            self._id_counter = itertools.count(self.journal.max_id() + 1)
+            # recovery: re-park the replay set; no live socket waits on
+            # these exchanges — their replies land in the journal only
+            for ex_id, req in self.journal.unanswered().items():
+                self._pending[ex_id] = _Exchange(req)
         # serving counters (reference requestsSeen/Accepted/Answered,
         # DistributedHTTPSource.scala:98-107); incremented from concurrent
         # ThreadingHTTPServer handler threads, so guarded by a lock
@@ -157,13 +179,21 @@ class ServingServer:
                 ex_id = None
                 if outer.mode == "batch":
                     ex_id = str(next(outer._id_counter))
+                    # journal BEFORE parking: a journaled reply always has
+                    # its accept record on disk first
+                    if outer.journal is not None:
+                        outer.journal.record_accept(ex_id, ex.request)
                     with outer._counter_lock:
                         outer._pending[ex_id] = ex
                 else:
                     outer._queue.put(ex)
                 if not ex.event.wait(outer.reply_timeout_s):
-                    if ex_id is not None:
-                        # dead client: stop re-serving it via get_batch()
+                    if ex_id is not None and outer.journal is None:
+                        # dead client: stop re-serving it via get_batch().
+                        # With a journal the request is DATA in the stream
+                        # (accepted = must be processed): it stays parked,
+                        # its reply lands in the journal even though this
+                        # connection gets a 504.
                         with outer._counter_lock:
                             outer._pending.pop(ex_id, None)
                     self.send_response(504)
@@ -223,6 +253,8 @@ class ServingServer:
         if self._server:
             self._server.shutdown()
             self._server.server_close()
+        if self.journal is not None:
+            self.journal.close()
 
     @property
     def url(self) -> str:
@@ -263,9 +295,15 @@ class ServingServer:
             requests = [self._pending[i].request for i in ids]
         return Table({"id": ids, "request": requests})
 
-    def reply(self, ids: list[str], responses: list[HTTPResponseData]) -> None:
+    def reply(self, ids: list[str], responses: list[HTTPResponseData],
+              record: bool = True) -> None:
         """Complete batch-mode requests by id (reference `HTTPSink` keyed by
-        (name, partitionId, requestId), HTTPSourceV2.scala:421-476)."""
+        (name, partitionId, requestId), HTTPSourceV2.scala:421-476).
+
+        record=False answers live clients WITHOUT journaling the reply as
+        the request's final answer — the transient-failure path: a 500 for
+        a failed batch must leave the request in the durable replay set
+        (the reference's failed micro-batch reruns after restart)."""
         if self.mode != "batch":
             raise RuntimeError("reply() is only available in batch mode")
         if len(ids) != len(responses):
@@ -274,8 +312,18 @@ class ServingServer:
                 "repliers must answer every drained request"
             )
         for ex_id, resp in zip(ids, responses):
+            ex_id = str(ex_id)
+            if self.journal is not None:
+                if self.journal.replied(ex_id):
+                    # already answered durably (e.g. a batch raced a
+                    # restart's replay): exactly-once drops the duplicate
+                    with self._counter_lock:
+                        self._pending.pop(ex_id, None)
+                    continue
+                if record:
+                    self.journal.record_reply(ex_id, resp)
             with self._counter_lock:
-                ex = self._pending.pop(str(ex_id), None)
+                ex = self._pending.pop(ex_id, None)
             if ex is not None:
                 ex.response = resp
                 ex.event.set()
@@ -335,13 +383,17 @@ class MicroBatchQuery:
     def __init__(self, server: "ServingServer",
                  handler: Callable[[Table], Table],
                  trigger_interval_s: float = 0.05,
-                 max_rows_per_batch: int | None = None):
+                 max_rows_per_batch: int | None = None,
+                 compact_every_batches: int = 64):
         if server.mode != "batch":
             raise ValueError("MicroBatchQuery drives a mode='batch' server")
         self.server = server
         self.handler = handler
         self.trigger_interval_s = trigger_interval_s
         self.max_rows_per_batch = max_rows_per_batch
+        # journal commit-trimming cadence (reference commit(),
+        # DistributedHTTPSource.scala:308-343); 0 disables
+        self.compact_every_batches = compact_every_batches
         self.batches_processed = 0
         self.rows_processed = 0
         self.exception: Exception | None = None
@@ -374,9 +426,18 @@ class MicroBatchQuery:
                 self.server.reply(out_ids, list(out["reply"]))
             except Exception as e:  # noqa: BLE001 — batch fails, query lives
                 self.exception = e
-                self.server.reply(ids, [_handler_error_response(e)] * len(ids))
+                # record=False: live clients get the 500, but the journal
+                # keeps these requests UNANSWERED so a restart replays them
+                # (transient failures must not commit as final answers)
+                self.server.reply(
+                    ids, [_handler_error_response(e)] * len(ids), record=False
+                )
             self.batches_processed += 1
             self.rows_processed += len(ids)
+            if (self.server.journal is not None
+                    and self.compact_every_batches
+                    and self.batches_processed % self.compact_every_batches == 0):
+                self.server.journal.compact()
 
     def stop(self) -> None:
         self._stop.set()

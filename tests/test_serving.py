@@ -176,6 +176,156 @@ class TestBatchMode:
             q.stop()
             srv.stop()
 
+    def test_kill_and_restart_replays_exactly_once(self, tmp_path):
+        """Durable serving (reference checkpointLocation contract,
+        DistributedHTTPSource.scala:308-343): requests accepted before a
+        crash are replayed by the restarted query and answered EXACTLY
+        once — the journal records one reply per accepted id, duplicates
+        are suppressed, and compaction trims completed pairs."""
+        import json as _json
+        import urllib.request
+
+        from mmlspark_tpu.io_http import MicroBatchQuery, ServingJournal
+
+        ckpt = str(tmp_path / "ckpt")
+        handled: list[str] = []
+
+        def handler(batch):
+            ids = list(batch["id"])
+            handled.extend(str(i) for i in ids)
+            replies = [
+                HTTPResponseData(
+                    200, "ok", {"Content-Type": "application/json"},
+                    _json.dumps({"y": _json.loads(r.entity)["x"] + 1}).encode(),
+                )
+                for r in batch["request"]
+            ]
+            return Table({"id": ids, "reply": replies})
+
+        # ---- incarnation 1: accept requests, serve NO batches, "crash" ---
+        srv1 = ServingServer(mode="batch", checkpoint_dir=ckpt,
+                             reply_timeout_s=0.2).start()
+        for x in range(3):
+            req = urllib.request.Request(
+                srv1.url, data=_json.dumps({"x": x}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                urllib.request.urlopen(req, timeout=5)
+            except urllib.error.HTTPError as e:
+                assert e.code == 504        # no query running: client times out
+        srv1.stop()                          # crash before any processing
+
+        # ---- incarnation 2: same checkpoint dir -> replay ---------------
+        srv2 = ServingServer(mode="batch", checkpoint_dir=ckpt).start()
+        assert len(srv2.get_batch()) == 3    # recovery re-parked all three
+        q = MicroBatchQuery(srv2, handler, trigger_interval_s=0.01,
+                            compact_every_batches=0).start()
+        deadline = time.monotonic() + 10.0
+        while len(handled) < 3 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        q.stop()
+        assert sorted(handled) == ["0", "1", "2"]      # each exactly once
+        j = srv2.journal
+        assert not j.unanswered()
+        for i in "012":
+            resp = j.reply_of(i)
+            assert resp is not None and resp.status_code == 200
+            assert resp.json()["y"] == int(i) + 1
+        # duplicate replies are dropped at the journal (exactly-once)
+        srv2._pending["1"] = srv2._pending.get("1") or None  # no-op guard
+        srv2.reply(["1"], [HTTPResponseData(200, "dup")])
+        assert j.reply_of("1").json()["y"] == 2        # original answer kept
+        # commit trimming: completed pairs leave the journal file
+        assert j.compact() == 3
+        srv2.stop()
+
+        # ---- incarnation 3: nothing left to replay ----------------------
+        srv3 = ServingServer(mode="batch", checkpoint_dir=ckpt).start()
+        assert len(srv3.get_batch()) == 0
+        srv3.stop()
+
+    def test_journal_transient_failure_stays_replayable(self, tmp_path):
+        """A handler error 500s the live client but must NOT commit as the
+        request's durable answer: the journal keeps it unanswered, and the
+        restarted query (with a healthy handler) replays it (the
+        reference's failed-micro-batch rerun semantics)."""
+        import json as _json
+        import urllib.request
+
+        from mmlspark_tpu.io_http import MicroBatchQuery
+
+        ckpt = str(tmp_path / "ckpt")
+        srv = ServingServer(mode="batch", checkpoint_dir=ckpt).start()
+
+        def broken(batch):
+            raise RuntimeError("transient")
+
+        q = MicroBatchQuery(srv, broken, trigger_interval_s=0.01).start()
+        req = urllib.request.Request(
+            srv.url, data=b'{"x": 7}',
+            headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            raise AssertionError("expected a 500")
+        except urllib.error.HTTPError as e:
+            assert e.code == 500
+        q.stop()
+        assert list(srv.journal.unanswered()) == ["0"]   # not committed
+        srv.stop()
+
+        # restart with a healthy handler: the request replays and commits
+        srv2 = ServingServer(mode="batch", checkpoint_dir=ckpt).start()
+
+        def healthy(batch):
+            replies = [HTTPResponseData(200, "ok", {}, b'{"done": true}')
+                       for _ in batch["request"]]
+            return Table({"id": list(batch["id"]), "reply": replies})
+
+        q2 = MicroBatchQuery(srv2, healthy, trigger_interval_s=0.01).start()
+        deadline = time.monotonic() + 10
+        while srv2.journal.unanswered() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        q2.stop()
+        assert not srv2.journal.unanswered()
+        assert srv2.journal.reply_of("0").status_code == 200
+        srv2.stop()
+
+    def test_journal_live_clients_and_id_resume(self, tmp_path):
+        """With a live query, journaled serving answers clients normally;
+        a restarted server resumes ids past the journaled range."""
+        import json as _json
+        import urllib.request
+
+        from mmlspark_tpu.io_http import MicroBatchQuery
+
+        ckpt = str(tmp_path / "ckpt")
+        srv = ServingServer(mode="batch", checkpoint_dir=ckpt).start()
+
+        def handler(batch):
+            replies = [
+                HTTPResponseData(200, "ok", {}, b'{"ok": true}')
+                for _ in batch["request"]
+            ]
+            return Table({"id": list(batch["id"]), "reply": replies})
+
+        q = MicroBatchQuery(srv, handler, trigger_interval_s=0.01).start()
+        try:
+            req = urllib.request.Request(
+                srv.url, data=b'{"x": 0}',
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=10) as r:
+                assert r.status == 200
+        finally:
+            q.stop()
+            srv.stop()
+        srv2 = ServingServer(mode="batch", checkpoint_dir=ckpt).start()
+        try:
+            assert next(srv2._id_counter) == 1   # past journaled id 0
+        finally:
+            srv2.stop()
+
     def test_get_batch_reply_roundtrip(self):
         """Caller-driven micro-batch: requests park until get_batch drains
         them and reply() completes each exchange (HTTPSource semantics)."""
