@@ -313,6 +313,30 @@ def bench_gbdt_large(hbm_peak_gbps: "float | None") -> "dict | None":
     }
 
 
+def bench_gbdt_dart() -> "dict | None":
+    """dart-mode fit throughput (VERDICT r3 item 8: the fused dart loop —
+    drop bookkeeping carried in the scan — must keep dart at O(1)
+    dispatches per fit like the other modes; this row measures it)."""
+    from mmlspark_tpu.gbdt.booster import Booster, TrainOptions
+
+    x, y = make_dataset(N_ROWS, N_FEATURES)
+    opts = TrainOptions(
+        objective="binary", boosting_type="dart",
+        num_iterations=NUM_ITERATIONS, num_leaves=NUM_LEAVES,
+        learning_rate=0.1, drop_rate=0.1,
+    )
+    Booster.train(x, y, opts)                        # compile warm-up
+    t0 = time.perf_counter()
+    booster = Booster.train(x, y, opts)
+    elapsed = time.perf_counter() - t0
+    acc = float(((booster.predict(x) > 0.5) == (y > 0.5)).mean())
+    return {
+        "rows_per_sec": N_ROWS * NUM_ITERATIONS / elapsed,
+        "fit_seconds": elapsed,
+        "acc": acc,
+    }
+
+
 def make_dataset_wide(n: int, f: int, seed: int = 9):
     rng = np.random.default_rng(seed)
     x = rng.normal(size=(n, f)).astype(np.float32)
@@ -557,6 +581,11 @@ def _run_suite(platform: str) -> dict:
         print(f"bench: large gbdt bench failed ({e!r})", file=sys.stderr)
         gbdt_large = None
     try:
+        dart = bench_gbdt_dart()
+    except Exception as e:  # noqa: BLE001 — mode family is auxiliary
+        print(f"bench: dart bench failed ({e!r})", file=sys.stderr)
+        dart = None
+    try:
         runner = bench_model_runner(peak_tflops)
     except Exception as e:  # noqa: BLE001 — never lose the line
         import jax
@@ -616,6 +645,11 @@ def _run_suite(platform: str) -> dict:
                 gbdt_large["modeled_hbm_gbps"], 2) if gbdt_large else None,
             "gbdt_large_modeled_hbm_frac_of_peak": (
                 gbdt_large["modeled_hbm_frac_of_peak"] if gbdt_large else None),
+            "gbdt_dart_rows_per_sec": round(
+                dart["rows_per_sec"], 1) if dart else None,
+            "gbdt_dart_fit_seconds": round(
+                dart["fit_seconds"], 3) if dart else None,
+            "gbdt_dart_train_acc": round(dart["acc"], 4) if dart else None,
             "model_runner_images_per_sec": round(runner["images_per_sec"], 1),
             "model_runner_vs_baseline": round(
                 runner["images_per_sec"] / BASELINE_IMAGES_PER_SEC, 3),

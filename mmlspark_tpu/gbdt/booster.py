@@ -256,10 +256,10 @@ class Booster:
             )
         rng = np.random.default_rng(bag_seed)
         frng = np.random.default_rng(feat_seed)
-        drng = np.random.default_rng(drop_seed)
 
-        # host loop below only serves dart (gbdt/goss/rf return from the
-        # fused branch); bagging is the only row sampling dart uses
+        # host loop below only serves MULTICLASS dart (gbdt/goss/rf and
+        # single-class dart return from the fused branches); bagging is
+        # the only row sampling it uses
         use_bagging = (
             opts.boosting_type == "dart"
             and opts.bagging_fraction < 1.0
@@ -268,9 +268,6 @@ class Booster:
 
         trees: list[dict[str, np.ndarray]] = list(prev_trees)
         tree_classes: list[int] = [int(c) for c in (warm.tree_class if warm is not None else [])]
-        # dart bookkeeping: per-tree train-set contribution (host, float32)
-        dart_contribs: list[np.ndarray] = []
-        dart_weights: list[float] = []
 
         # early stopping state: validation raw scores maintained incrementally
         # (bin once, add each new tree's contribution — no per-round rebuild).
@@ -376,7 +373,54 @@ class Booster:
             out.best_iteration = best_iter
             return out
 
-        # ---- dart host loop (drop bookkeeping spans rounds) --------------
+        # ---- fused dart (single-class): drop bookkeeping IN the scan ----
+        if opts.boosting_type == "dart" and k == 1:
+            from .fused import FusedTrainSpec, make_fused_dart_fn
+
+            num_rounds = opts.num_iterations - start_iter
+            if num_rounds > 0:
+                spec = FusedTrainSpec(
+                    num_rounds=num_rounds,
+                    num_class=1,
+                    boosting_type="dart",
+                    bagging_fraction=opts.bagging_fraction,
+                    bagging_freq=opts.bagging_freq,
+                    feature_fraction=opts.feature_fraction,
+                    drop_rate=opts.drop_rate,
+                )
+                fused = make_fused_dart_fn(
+                    f, num_bins, cfg, mapper.num_bins, cat_mask, obj_fn, spec,
+                    mesh=mesh,
+                    cache_key=(opts.objective, opts.alpha,
+                               opts.tweedie_variance_power, opts.fair_c),
+                )
+                if log:
+                    log(f"fused dart: {num_rounds} rounds in one XLA "
+                        "program (first run compiles)")
+                # per-purpose seeds (already master-seed-derived above):
+                # varying bagging_seed alone must change only the bags
+                t_stack, w_dev, _pred = fused(
+                    bins_dev, jnp.asarray(y_pad, jnp.float32), base_mask,
+                    pred, drop_seed, bag_seed, feat_seed,
+                )
+                t_host = {kf: np.asarray(v) for kf, v in t_stack._asdict().items()}
+                w_host = np.asarray(w_dev, np.float64)
+                names = ("feature", "threshold_bin", "is_categorical",
+                         "left", "right", "value", "gain", "cat_bitset")
+                for r in range(num_rounds):
+                    trees.append(_scale_tree(
+                        {name: t_host[name][r] for name in names},
+                        float(w_host[r]),
+                    ))
+                    tree_classes.append(0)
+            out = Booster._from_tree_dicts(
+                trees, tree_classes, mapper, opts, init, feature_names or []
+            )
+            out.best_iteration = best_iter
+            return out
+
+        # ---- dart host loop (multiclass only: plain gbdt updates — the
+        # drop algebra is single-model; see fused dart above) -------------
         bag_mask = base_mask
         for it in range(start_iter, opts.num_iterations):
             if use_bagging and it % max(opts.bagging_freq, 1) == 0:
@@ -391,21 +435,11 @@ class Booster:
             else:
                 feat_mask = jnp.ones((f,), jnp.float32)
 
-            # dart: drop a subset of existing trees for this round's gradients
-            # (multiclass dart falls back to gbdt updates)
-            dart_mode = k == 1
-            pred_round = pred
-            dropped: list[int] = []
-            if dart_mode and dart_contribs:
-                dropped = [i for i in range(len(dart_contribs)) if drng.random() < opts.drop_rate]
-                if dropped:
-                    drop_sum = np.sum(
-                        [dart_contribs[i] * dart_weights[i] for i in dropped], axis=0
-                    )
-                    pred_round = pred - jnp.asarray(drop_sum, jnp.float32)
-
+            # multiclass dart performs plain additive (gbdt) updates — the
+            # per-tree drop/renormalize algebra is only defined for the
+            # single-model case, which the fused dart path covers
             for cls in range(k):
-                g, h = grad_hess(pred_round, cls)
+                g, h = grad_hess(pred, cls)
                 tree, row_val = grow(bins_dev, g, h, bag_mask, feat_mask)
                 if es_active:
                     contrib = tree_val_contrib(tree)
@@ -413,27 +447,11 @@ class Booster:
                         val_raw = val_raw.at[:, cls].add(contrib)
                     else:
                         val_raw = val_raw + contrib
-                if dart_mode:
-                    # new tree and dropped trees renormalized (standard DART)
-                    norm_new = 1.0 / (len(dropped) + 1)
-                    for i in dropped:
-                        dart_weights[i] *= len(dropped) / (len(dropped) + 1.0)
-                    row_val_np = np.asarray(row_val, np.float32)
-                    resum = (
-                        np.sum([dart_contribs[i] * dart_weights[i] for i in dropped], axis=0)
-                        if dropped
-                        else np.zeros_like(row_val_np)
-                    )
-                    pred = pred_round + jnp.asarray(resum + row_val_np * norm_new, jnp.float32)
-                    dart_contribs.append(row_val_np)
-                    dart_weights.append(norm_new)
-                    trees.append(_tree_to_host(tree))  # scaled at the end
-                elif opts.objective == "multiclass":
+                if opts.objective == "multiclass":
                     pred = pred.at[:, cls].add(row_val)
-                    trees.append(_tree_to_host(tree))
                 else:
                     pred = pred + row_val
-                    trees.append(_tree_to_host(tree))
+                trees.append(_tree_to_host(tree))
                 tree_classes.append(cls)
 
             if es_active:
@@ -452,12 +470,6 @@ class Booster:
                         break
             if log and (it + 1) % 10 == 0:
                 log(f"iter {it + 1}/{opts.num_iterations}")
-
-        if k == 1 and dart_weights:
-            start = len(prev_trees)
-            trees = trees[:start] + [
-                _scale_tree(t, dart_weights[i]) for i, t in enumerate(trees[start:])
-            ]
 
         out = Booster._from_tree_dicts(
             trees, tree_classes, mapper, opts, init, feature_names or []

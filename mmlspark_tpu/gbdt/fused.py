@@ -15,8 +15,17 @@ Covers gbdt / goss / rf, WITH early stopping for gbdt/goss: validation raw
 scores are maintained incrementally on device, the per-objective loss is
 tracked in the scan carry, and once `since_best >= early_stopping_round`
 every remaining round takes the `lax.cond` no-op branch (near-zero work) —
-the host truncates the returned tree stack to the best round. dart (per-tree
-drop bookkeeping spanning rounds) stays on the host-loop path in booster.py.
+the host truncates the returned tree stack to the best round.
+
+Single-class dart fuses too (`make_fused_dart_fn`): the cross-round drop
+bookkeeping that kept it on the host loop — per-tree weights mutated by
+every drop, and dropped trees' row contributions subtracted from the round's
+predictions — is carried IN the scan as a (rounds, n) contribution matrix
+and a (rounds,) weight vector. Each round's base prediction is one matvec
+`contribs^T @ (weights * keep)`, an MXU-friendly O(R*n) read instead of a
+host round trip; O(1) dispatches per dart fit. Multiclass dart (plain gbdt
+updates — the drop algebra is single-model) stays on the host-loop path in
+booster.py.
 
 Randomness is `jax.random` threaded through the scan (fold_in per round and
 per mesh shard), so the fused path is deterministic for a fixed seed but not
@@ -37,7 +46,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..parallel.mesh import DATA_AXIS
 from .engine import GrowConfig, TreeArrays, make_grow_fn, tree_apply
 
-__all__ = ["FusedTrainSpec", "make_fused_train_fn"]
+__all__ = ["FusedTrainSpec", "make_fused_train_fn", "make_fused_dart_fn"]
 
 
 class FusedTrainSpec(NamedTuple):
@@ -53,6 +62,7 @@ class FusedTrainSpec(NamedTuple):
     top_rate: float = 0.2              # goss
     other_rate: float = 0.1            # goss
     early_stopping_round: int = 0      # 0: off (gbdt/goss only)
+    drop_rate: float = 0.1             # dart
 
 
 _FUSED_CACHE: dict = {}
@@ -307,6 +317,166 @@ def make_fused_train_fn(
                 TreeArrays(*([P()] * len(TreeArrays._fields))),
                 rowk,
                 (P(), P()),
+            ),
+        ))
+    else:
+        fn = jax.jit(functools.partial(loop, axis_name=None))
+    if cache_key is not None:
+        if len(_FUSED_CACHE) >= _FUSED_CACHE_MAX:
+            _FUSED_CACHE.pop(next(iter(_FUSED_CACHE)))
+        _FUSED_CACHE[full_key] = fn
+    return fn
+
+
+def make_fused_dart_fn(
+    num_features: int,
+    num_bins: int,
+    cfg: GrowConfig,
+    feature_num_bins: np.ndarray,
+    categorical_mask: np.ndarray,
+    obj_fn: Callable,
+    spec: FusedTrainSpec,
+    mesh: Mesh | None = None,
+    cache_key: tuple | None = None,
+):
+    """Fused single-class DART: the whole drop/renormalize boosting loop as
+    one XLA program (the standard DART algorithm the host loop implements,
+    with identical weight algebra; jax.random drops instead of numpy).
+
+      fn(bins, y, base_w, pred0, drop_seed, bag_seed, feat_seed)
+        -> (TreeArrays stacked over rounds, tree_weights (R,), final_pred)
+
+    Seeds are per purpose — drop selection, bagging, feature sampling —
+    preserving the host path's contract that e.g. varying bagging_seed
+    alone changes the bags without reshuffling the drops.
+
+    Per round r: a replicated Bernoulli(drop_rate) mask over trees < r is
+    drawn; the round's base prediction is pred0 + contribs^T @ (weights *
+    keep) (one matvec over the carried (R, n) contribution matrix); the new
+    tree trains on gradients at that prediction; dropped weights scale by
+    k/(k+1) and the new tree enters at 1/(k+1). Tree VALUES come back
+    unscaled — the host folds the returned weights in, exactly like the
+    host loop's end-of-fit rescale.
+
+    Memory: the carry holds R*n float32 contributions (e.g. 1M rows x 100
+    rounds = 400 MB HBM — fine on-chip; row-sharded under the mesh).
+    """
+    if spec.num_class != 1:
+        raise ValueError("fused dart covers the single-class path only")
+    if cache_key is not None:
+        from ..core.kernels import kernel_mode
+
+        full_key = (
+            "dart", num_features, num_bins, cfg,
+            bytes(np.asarray(feature_num_bins)),
+            bytes(np.asarray(categorical_mask, np.uint8)),
+            spec, mesh, cache_key, kernel_mode(),
+        )
+        hit = _FUSED_CACHE.get(full_key)
+        if hit is not None:
+            return hit
+    f = num_features
+    rounds = spec.num_rounds
+    grow = make_grow_fn(
+        num_features, num_bins, cfg, feature_num_bins, categorical_mask, raw=True
+    )
+    use_bagging = spec.bagging_fraction < 1.0 and spec.bagging_freq > 0
+    bag_freq = max(spec.bagging_freq, 1)
+
+    def loop(bins, y, base_w, pred0, drop_seed, bag_seed, feat_seed,
+             axis_name=None):
+        n = bins.shape[0]
+        key_drop = jax.random.PRNGKey(drop_seed)     # replicated
+        key_feat = jax.random.PRNGKey(feat_seed)     # replicated
+        key_bag = jax.random.PRNGKey(bag_seed)       # per-shard rows
+        if axis_name is not None:
+            key_bag = jax.random.fold_in(
+                key_bag, jax.lax.axis_index(axis_name)
+            )
+
+        def feature_mask_of(kf):
+            u = jax.random.uniform(kf, (f,))
+            sel = u < spec.feature_fraction
+            fallback = jnp.arange(f) == jnp.argmin(u)
+            return jnp.where(sel.any(), sel, fallback).astype(jnp.float32)
+
+        trees0 = jax.tree.map(
+            lambda a: jnp.broadcast_to(a, (rounds,) + a.shape),
+            _zero_tree(cfg.num_leaves, num_bins),
+        )
+        contribs0 = jnp.zeros((rounds, n), jnp.float32)
+        weights0 = jnp.zeros((rounds,), jnp.float32)
+        if axis_name is not None:
+            # the contribution matrix holds row-sharded values; the zeros
+            # init must carry the varying manual-axis type so the scan
+            # carry types line up (engine.py's node_of_row pattern)
+            contribs0 = jax.lax.pcast(contribs0, (axis_name,), to="varying")
+
+        def body(carry, it):
+            trees, contribs, weights, bag = carry
+            # drop selection is REPLICATED (same key on every shard): the
+            # weight vector feeds the replicated tree bookkeeping
+            kd = jax.random.fold_in(key_drop, it)
+            drop = (
+                jax.random.uniform(kd, (rounds,)) < spec.drop_rate
+            ) & (jnp.arange(rounds) < it)
+            k_drop = drop.sum().astype(jnp.float32)
+            keep_w = jnp.where(drop, 0.0, weights)
+            # HIGHEST precision: on TPU the default einsum would be a bf16
+            # MXU dot, degrading every round's base prediction (and
+            # breaking dart(drop_rate=0) == gbdt bit-identity) — same rule
+            # as the histogram kernels (hist_kernel.py)
+            pred_round = pred0 + jnp.einsum(
+                "rn,r->n", contribs, keep_w,
+                precision=jax.lax.Precision.HIGHEST,
+            ).astype(pred0.dtype)
+
+            if use_bagging:
+                kb = jax.random.fold_in(key_bag, it)
+                fresh = jnp.where(
+                    jax.random.uniform(kb, (n,)) < spec.bagging_fraction,
+                    base_w, 0.0,
+                )
+                bag = jnp.where(it % bag_freq == 0, fresh, bag)
+            g, h = obj_fn(y, pred_round)
+            fmask = (
+                feature_mask_of(jax.random.fold_in(key_feat, it))
+                if spec.feature_fraction < 1.0
+                else jnp.ones((f,), jnp.float32)
+            )
+            tree, rv = grow(bins, g, h, bag, fmask, axis_name=axis_name)
+
+            # standard DART renormalization (the host loop's algebra):
+            # dropped weights shrink by k/(k+1), the new tree enters at
+            # 1/(k+1); k_drop == 0 degrades to a plain gbdt round
+            norm_new = 1.0 / (k_drop + 1.0)
+            weights = jnp.where(drop, weights * k_drop / (k_drop + 1.0),
+                                weights)
+            weights = weights.at[it].set(norm_new)
+            contribs = contribs.at[it].set(rv)
+            trees = jax.tree.map(lambda s, t: s.at[it].set(t), trees, tree)
+            return (trees, contribs, weights, bag), None
+
+        carry0 = (trees0, contribs0, weights0, base_w)
+        (trees, contribs, weights, _), _ = jax.lax.scan(
+            body, carry0, jnp.arange(rounds)
+        )
+        final_pred = pred0 + jnp.einsum(
+            "rn,r->n", contribs, weights,
+            precision=jax.lax.Precision.HIGHEST,
+        ).astype(pred0.dtype)
+        return trees, weights, final_pred
+
+    if mesh is not None and mesh.shape.get(DATA_AXIS, 1) > 1:
+        row = P(DATA_AXIS)
+        fn = jax.jit(shard_map(
+            functools.partial(loop, axis_name=DATA_AXIS),
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS, None), row, row, row, P(), P(), P()),
+            out_specs=(
+                TreeArrays(*([P()] * len(TreeArrays._fields))),
+                P(),
+                row,
             ),
         ))
     else:

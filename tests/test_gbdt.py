@@ -293,6 +293,32 @@ class TestBooster:
         smaller_side = np.minimum(sizes, n_categories - sizes)
         assert cat_nodes.any() and (smaller_side <= 1).all(), sizes
 
+    def test_fused_dart_zero_drop_equals_gbdt(self):
+        """The fused dart loop with drop_rate=0 must be BIT-IDENTICAL to
+        gbdt: every round's drop set is empty, weights stay 1, and the
+        weight algebra degenerates to plain additive boosting — pins the
+        fused drop/renormalize bookkeeping to the known-good path."""
+        x, y = make_classification(n=1200)
+        bg = Booster.train(x, y, TrainOptions(
+            objective="binary", num_iterations=8, num_leaves=15))
+        bd = Booster.train(x, y, TrainOptions(
+            objective="binary", boosting_type="dart", num_iterations=8,
+            num_leaves=15, drop_rate=0.0))
+        np.testing.assert_array_equal(
+            np.asarray(bd.predict_raw(x)), np.asarray(bg.predict_raw(x)))
+
+    def test_fused_dart_mesh_matches_single_device(self, mesh8):
+        """dart under the data mesh: replicated drop decisions + psum
+        histograms give the single-device model (same contract as gbdt)."""
+        x, y = make_classification(n=1024)
+        opts = TrainOptions(
+            objective="binary", boosting_type="dart", num_iterations=10,
+            num_leaves=15, drop_rate=0.15)
+        b1 = Booster.train(x, y, opts)
+        b2 = Booster.train(x, y, opts, mesh=mesh8)
+        np.testing.assert_allclose(
+            b1.predict_raw(x), b2.predict_raw(x), rtol=1e-3, atol=1e-3)
+
     def test_v1_text_format_one_vs_rest_compat(self):
         """Version-1 saved models encoded categorical splits as
         one-vs-rest (col == threshold_bin); the loader must reproduce
