@@ -54,34 +54,48 @@ class ServingJournal:
     def _load(self) -> None:
         if not os.path.exists(self.path):
             return
-        with open(self.path, encoding="utf-8") as fh:
-            for line in fh:
-                line = line.strip()
+        good_end = 0     # byte offset just past the last intact record
+        with open(self.path, "rb") as fh:
+            for raw in fh:
+                if not raw.endswith(b"\n"):
+                    break                       # torn tail (no newline)
+                line = raw.strip()
                 if not line:
+                    good_end += len(raw)
                     continue
                 try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError:
-                    # torn tail write from a crash mid-append: everything
-                    # before it is intact, the torn record's request was
-                    # never acknowledged durably — stop here
+                    rec = json.loads(line.decode("utf-8"))
+                except (json.JSONDecodeError, UnicodeDecodeError):
+                    # torn/corrupt record from a crash mid-append:
+                    # everything before it is intact, the torn record's
+                    # request was never acknowledged durably — stop here
                     break
-                if rec.get("t") == "accept":
-                    self._accepts[rec["id"]] = HTTPRequestData(
-                        method=rec.get("method", "POST"),
-                        url=rec.get("url", ""),
-                        headers=rec.get("headers", {}),
-                        entity=base64.b64decode(rec["entity"])
-                        if rec.get("entity") is not None else None,
-                    )
-                elif rec.get("t") == "reply":
-                    self._replies[rec["id"]] = HTTPResponseData(
-                        status_code=rec.get("status", 0),
-                        reason=rec.get("reason", ""),
-                        headers=rec.get("headers", {}),
-                        entity=base64.b64decode(rec["entity"])
-                        if rec.get("entity") is not None else None,
-                    )
+                good_end += len(raw)
+                self._apply(rec)
+        # drop the torn tail ON DISK, not just in memory: appending after
+        # a partial line would fuse the next record onto it and a later
+        # restart would lose everything from that point on
+        if good_end < os.path.getsize(self.path):
+            with open(self.path, "r+b") as fh:
+                fh.truncate(good_end)
+
+    def _apply(self, rec: dict) -> None:
+        if rec.get("t") == "accept":
+            self._accepts[rec["id"]] = HTTPRequestData(
+                method=rec.get("method", "POST"),
+                url=rec.get("url", ""),
+                headers=rec.get("headers", {}),
+                entity=base64.b64decode(rec["entity"])
+                if rec.get("entity") is not None else None,
+            )
+        elif rec.get("t") == "reply":
+            self._replies[rec["id"]] = HTTPResponseData(
+                status_code=rec.get("status", 0),
+                reason=rec.get("reason", ""),
+                headers=rec.get("headers", {}),
+                entity=base64.b64decode(rec["entity"])
+                if rec.get("entity") is not None else None,
+            )
 
     def _append(self, rec: dict) -> None:
         self._fh.write(json.dumps(rec) + "\n")

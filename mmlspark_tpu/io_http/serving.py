@@ -432,6 +432,23 @@ class MicroBatchQuery:
                 self.server.reply(
                     ids, [_handler_error_response(e)] * len(ids), record=False
                 )
+                if self.server.journal is not None:
+                    # re-park the failed batch so THIS query retries it on a
+                    # later tick (the clients already got their 500s; the
+                    # retried replies land in the journal only) — without
+                    # this, accepted-but-failed requests would wait for a
+                    # full process restart even though the query recovered
+                    reqs = list(batch["request"])
+                    with self.server._counter_lock:
+                        for ex_id, req in zip(ids, reqs):
+                            ex_id = str(ex_id)
+                            if not self.server.journal.replied(ex_id):
+                                self.server._pending.setdefault(
+                                    ex_id, _Exchange(req)
+                                )
+                    # breathe between retries of a failing handler instead
+                    # of spinning the tick loop hot
+                    self._stop.wait(self.trigger_interval_s)
             self.batches_processed += 1
             self.rows_processed += len(ids)
             if (self.server.journal is not None

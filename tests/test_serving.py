@@ -291,6 +291,70 @@ class TestBatchMode:
         assert srv2.journal.reply_of("0").status_code == 200
         srv2.stop()
 
+    def test_journal_torn_tail_truncated_on_load(self, tmp_path):
+        """A crash mid-append leaves a partial record; the loader must
+        TRUNCATE it on disk — appending after a torn line would fuse the
+        next record onto it and a later restart would silently lose
+        everything from that point on."""
+        import os
+
+        from mmlspark_tpu.io_http import ServingJournal
+        from mmlspark_tpu.io_http.schema import HTTPRequestData
+
+        ckpt = str(tmp_path / "ckpt")
+        j = ServingJournal(ckpt)
+        j.record_accept("0", HTTPRequestData(entity=b"a"))
+        j.close()
+        with open(j.path, "a") as fh:
+            fh.write('{"t": "accept", "id": "1", "ent')   # torn tail
+        size_torn = os.path.getsize(j.path)
+        j2 = ServingJournal(ckpt)
+        assert list(j2.unanswered()) == ["0"]
+        assert os.path.getsize(j2.path) < size_torn       # tail dropped
+        j2.record_accept("2", HTTPRequestData(entity=b"c"))
+        j2.close()
+        # the post-crash append parses cleanly on the NEXT restart
+        j3 = ServingJournal(ckpt)
+        assert sorted(j3.unanswered()) == ["0", "2"]
+        j3.close()
+
+    def test_journal_same_process_retry_after_transient_failure(self, tmp_path):
+        """A journaled batch that fails once is retried by the SAME query
+        once the handler recovers — no restart needed."""
+        import urllib.request
+
+        from mmlspark_tpu.io_http import MicroBatchQuery
+
+        srv = ServingServer(mode="batch",
+                            checkpoint_dir=str(tmp_path / "ckpt")).start()
+        state = {"fail": True}
+
+        def flaky(batch):
+            if state["fail"]:
+                state["fail"] = False
+                raise RuntimeError("first tick fails")
+            replies = [HTTPResponseData(200, "ok", {}, b'{"ok":1}')
+                       for _ in batch["request"]]
+            return Table({"id": list(batch["id"]), "reply": replies})
+
+        q = MicroBatchQuery(srv, flaky, trigger_interval_s=0.01).start()
+        try:
+            req = urllib.request.Request(
+                srv.url, data=b'{"x":1}',
+                headers={"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(req, timeout=10)
+            except urllib.error.HTTPError as e:
+                assert e.code == 500          # client saw the failure
+            deadline = time.monotonic() + 10
+            while srv.journal.unanswered() and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert not srv.journal.unanswered()
+            assert srv.journal.reply_of("0").status_code == 200
+        finally:
+            q.stop()
+            srv.stop()
+
     def test_journal_live_clients_and_id_resume(self, tmp_path):
         """With a live query, journaled serving answers clients normally;
         a restarted server resumes ids past the journaled range."""
