@@ -20,7 +20,7 @@ from .ops import (
 from .transformer import ImageTransformer, ResizeImageTransformer
 from .unroll import UnrollImage, UnrollBinaryImage
 from .augmenter import ImageSetAugmenter
-from .io import read_images, read_binary_files
+from .io import read_images, read_binary_files, write_binary_files
 
 __all__ = [
     "resize_image",
@@ -37,4 +37,5 @@ __all__ = [
     "ImageSetAugmenter",
     "read_images",
     "read_binary_files",
+    "write_binary_files",
 ]

@@ -20,7 +20,8 @@ import numpy as np
 
 from ..core.schema import IMAGE_SPEC, Table
 
-__all__ = ["read_images", "read_binary_files", "decode_image", "encode_image"]
+__all__ = ["read_images", "read_binary_files", "write_binary_files",
+           "decode_image", "encode_image"]
 
 _IMAGE_EXTS = {".jpg", ".jpeg", ".png", ".bmp", ".gif", ".ppm", ".tif", ".tiff"}
 
@@ -74,6 +75,73 @@ def read_binary_files(
         lengths.append(len(data))
     return Table({"path": paths, "bytes": blobs,
                   "length": np.asarray(lengths, np.int64)})
+
+
+def write_binary_files(
+    table: Table,
+    out_dir: str,
+    path_col: str = "path",
+    bytes_col: str = "bytes",
+    overwrite: bool = False,
+    base_dir: str | None = None,
+) -> list[str]:
+    """Table{path, bytes} -> files under `out_dir` — the write side of the
+    binary format (reference `BinaryOutputWriter`,
+    BinaryFileFormat.scala:219+: each row's byte payload lands at a path
+    derived from its path column).
+
+    Destination mapping: relative paths keep their directory structure
+    under `out_dir`. Absolute paths (what `read_binary_files` emits) are
+    relativized to `base_dir` when given — the lossless recursive
+    roundtrip: `write_binary_files(read_binary_files(d, recursive=True),
+    out, base_dir=d)` — and re-rooted by basename otherwise. Duplicate
+    destinations (two rows, one target) and traversal outside `out_dir`
+    are rejected UP FRONT, before any byte is written, so a bad table
+    can't leave a half-written directory. Returns the written file paths,
+    in row order."""
+    out_root = Path(out_dir).resolve()
+    base = Path(base_dir).resolve() if base_dir is not None else None
+    paths = table[path_col]
+    blobs = table[bytes_col]
+    dests: list[Path] = []
+    for rel in paths:
+        p = Path(str(rel))
+        if p.is_absolute():
+            if base is not None:
+                try:
+                    p = p.resolve().relative_to(base)
+                except ValueError:
+                    raise ValueError(
+                        f"path {rel!r} is not under base_dir {base_dir!r}"
+                    ) from None
+            else:
+                p = Path(p.name)
+        dest = (out_root / p).resolve()
+        if out_root != dest and out_root not in dest.parents:
+            raise ValueError(f"path {rel!r} escapes the output directory")
+        dests.append(dest)
+    dupes = {d for d in dests if dests.count(d) > 1}
+    if dupes:
+        raise ValueError(
+            f"{len(dupes)} destination collision(s) (e.g. "
+            f"{sorted(dupes)[0]}): rows map to the same output file — "
+            "pass base_dir to preserve source structure"
+        )
+    if not overwrite:
+        existing = [d for d in dests if d.exists()]
+        if existing:
+            raise FileExistsError(
+                f"{existing[0]} exists; pass overwrite=True to replace"
+            )
+    out_root.mkdir(parents=True, exist_ok=True)
+    written: list[str] = []
+    for dest, data in zip(dests, blobs):
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        if isinstance(data, np.ndarray):
+            data = data.tobytes()
+        dest.write_bytes(bytes(data))
+        written.append(str(dest))
+    return written
 
 
 def read_images(

@@ -1,6 +1,8 @@
 """Image subsystem tests (reference: ImageTransformerSuite,
 UnrollImageSuite, BinaryFileReaderSuite, ImageSetAugmenterSuite)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -153,3 +155,51 @@ class TestIO:
         rec = read_binary_files(str(tmp_path), glob="*.bin", recursive=True)
         assert len(rec) == 2
         assert sorted(rec["length"].tolist()) == [3, 5]
+
+    def test_write_binary_files_roundtrip(self, tmp_path):
+        """Write side of the binary format (BinaryOutputWriter,
+        BinaryFileFormat.scala:219+): read -> write re-roots absolute
+        paths by basename, relative paths keep structure, bytes survive."""
+        from mmlspark_tpu.core.schema import Table
+        from mmlspark_tpu.image import write_binary_files
+
+        src = tmp_path / "src"
+        src.mkdir()
+        (src / "x.bin").write_bytes(b"abc")
+        (src / "y.bin").write_bytes(b"defgh")
+        tbl = read_binary_files(str(src), glob="*.bin")
+        out = tmp_path / "out"
+        written = write_binary_files(tbl, str(out))
+        assert sorted(os.path.basename(w) for w in written) == \
+            ["x.bin", "y.bin"]
+        again = read_binary_files(str(out), glob="*.bin")
+        assert sorted(bytes(b) for b in again["bytes"]) == [b"abc", b"defgh"]
+        # recursive roundtrip with duplicate basenames: base_dir preserves
+        # the source structure (basename re-rooting would collide)
+        (src / "sub").mkdir()
+        (src / "sub" / "x.bin").write_bytes(b"nested")
+        rec = read_binary_files(str(src), glob="*.bin", recursive=True)
+        out_r = tmp_path / "out_rec"
+        write_binary_files(rec, str(out_r), base_dir=str(src))
+        assert (out_r / "x.bin").read_bytes() == b"abc"
+        assert (out_r / "sub" / "x.bin").read_bytes() == b"nested"
+        # without base_dir the duplicate basenames are rejected UP FRONT
+        # (nothing written)
+        out_c = tmp_path / "out_collide"
+        with pytest.raises(ValueError, match="collision"):
+            write_binary_files(rec, str(out_c))
+        assert not out_c.exists()
+        # relative paths keep their directory structure
+        t2 = Table({"path": ["a/b.bin"], "bytes": [b"zz"]})
+        w2 = write_binary_files(t2, str(tmp_path / "out2"))
+        assert w2[0].endswith(os.path.join("a", "b.bin"))
+        assert (tmp_path / "out2" / "a" / "b.bin").read_bytes() == b"zz"
+        # traversal escapes are rejected; overwrite is explicit
+        with pytest.raises(ValueError, match="escapes"):
+            write_binary_files(
+                Table({"path": ["../evil"], "bytes": [b"x"]}),
+                str(tmp_path / "out3"),
+            )
+        with pytest.raises(FileExistsError):
+            write_binary_files(t2, str(tmp_path / "out2"))
+        write_binary_files(t2, str(tmp_path / "out2"), overwrite=True)

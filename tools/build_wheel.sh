@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Build a distributable wheel + sdist into dist/ (the reference's tools/pip
+# packaging role). Pure-python package: the native C++ kernel ships as
+# source (see [tool.setuptools.package-data]) and compiles on first use
+# via the ctypes loader, so one wheel serves every platform with a
+# toolchain and degrades to the numpy path without one.
+#
+# Offline-friendly: --no-build-isolation uses the environment's setuptools
+# instead of fetching a fresh build backend.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+rm -rf build dist ./*.egg-info
+python -m pip wheel --no-deps --no-build-isolation -w dist .
+python - <<'PYEOF'
+import glob, zipfile
+whl = glob.glob("dist/*.whl")[0]
+names = zipfile.ZipFile(whl).namelist()
+assert any(n.endswith("native/kernels.cpp") for n in names), \
+    "native kernel source missing from the wheel"
+assert any(n.endswith("gbdt/booster.py") for n in names)
+print(f"{whl}: {len(names)} files, native source included")
+PYEOF
+echo "wheel ready in dist/"
