@@ -539,13 +539,23 @@ def bench_serving() -> dict:
         for _ in range(20):          # warm-up: compile the scoring step
             post()
         srv.reset_latency_stats()
+        # measure BOTH sides: the server's enqueue->reply-written window
+        # and the client's full round trip — a transport stall (the Nagle/
+        # delayed-ACK class of bug) is invisible to the first and dominant
+        # in the second
+        rtt = []
         for _ in range(200):
+            t0 = time.perf_counter()
             post()
+            rtt.append(time.perf_counter() - t0)
         stats = srv.latency_stats()
+        rtt_ms = np.asarray(rtt) * 1e3
         conn.close()
     finally:
         srv.stop()
-    return {"p50_ms": stats["p50_ms"], "p99_ms": stats["p99_ms"]}
+    return {"p50_ms": stats["p50_ms"], "p99_ms": stats["p99_ms"],
+            "client_rtt_p50_ms": float(np.percentile(rtt_ms, 50)),
+            "client_rtt_p99_ms": float(np.percentile(rtt_ms, 99))}
 
 
 def _resolve_kernel_name() -> str:
@@ -675,6 +685,10 @@ def _run_suite(platform: str) -> dict:
             "trainer_smoke_only": trainer.get("smoke_only") if trainer else None,
             "serving_p50_ms": round(serving["p50_ms"], 3) if serving else None,
             "serving_p99_ms": round(serving["p99_ms"], 3) if serving else None,
+            "serving_client_rtt_p50_ms": round(
+                serving["client_rtt_p50_ms"], 3) if serving else None,
+            "serving_client_rtt_p99_ms": round(
+                serving["client_rtt_p99_ms"], 3) if serving else None,
             "headroom_note": (
                 "gbdt fit is HBM-bound (see gbdt_modeled_hbm_* vs chip peak); "
                 "end-to-end runner throughput is host->device transfer bound: "

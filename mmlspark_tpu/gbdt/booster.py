@@ -582,15 +582,33 @@ class Booster:
             return self._predict_cache[key]
         max_steps = int(self.feature.shape[1] // 2 + 1)  # deepest leaf-wise chain
         k = self.num_class
+        # trees process in BLOCKS: within a block the gather-walks run
+        # vmapped (T-way batched work for the TPU), blocks run as a scan so
+        # live memory stays O(block * n) rather than O(T * n). Padding
+        # trees are all-leaf/zero-value: they walk to node 0 and add 0.
+        t_total = self.feature.shape[0]
+        block = min(64, max(t_total, 1))
+        pad = (-t_total) % block
+
+        def padded(a, fill=0):
+            a = np.asarray(a)
+            if not pad:
+                return a
+            shape = (pad,) + a.shape[1:]
+            return np.concatenate([a, np.full(shape, fill, a.dtype)])
+
+        def blocked(a):
+            return jnp.asarray(a).reshape((-1, block) + a.shape[1:])
+
         stacked = dict(
-            feature=jnp.asarray(self.feature),
-            thr=jnp.asarray(self.threshold_bin),
-            cat=jnp.asarray(self.is_categorical),
-            bitset=jnp.asarray(self.cat_bitset),
-            left=jnp.asarray(self.left),
-            right=jnp.asarray(self.right),
-            value=jnp.asarray(self.value),
-            cls=jnp.asarray(self.tree_class),
+            feature=blocked(padded(self.feature, -1)),
+            thr=blocked(padded(self.threshold_bin)),
+            cat=blocked(padded(self.is_categorical)),
+            bitset=blocked(padded(self.cat_bitset)),
+            left=blocked(padded(self.left, -1)),
+            right=blocked(padded(self.right, -1)),
+            value=blocked(padded(self.value)),
+            cls=blocked(padded(self.tree_class)),
         )
         bc = int(self.cat_bitset.shape[-1])
 
@@ -601,7 +619,10 @@ class Booster:
                 (n,), self.init_score, jnp.float32
             )
 
-            def one_tree(acc, tr):
+            def walk_one(tr):
+                """Leaf values of ONE tree for every row — vmapped over
+                trees below, so XLA sees all T gather-walks as one batched
+                program instead of T sequential ones."""
                 node = jnp.zeros((n,), jnp.int32)
 
                 def body(_, node):
@@ -613,20 +634,29 @@ class Booster:
                         col <= tr["thr"][node],
                     )
                     leaf = tr["feature"][node] < 0
-                    nxt = jnp.where(
-                        leaf, node, jnp.where(go_left, tr["left"][node], tr["right"][node])
+                    return jnp.where(
+                        leaf, node,
+                        jnp.where(go_left, tr["left"][node], tr["right"][node]),
                     )
-                    return nxt
 
                 node = jax.lax.fori_loop(0, max_steps, body, node)
-                val = tr["value"][node]
+                return tr["value"][node]
+
+            # accumulate IN TREE ORDER (a cheap scan of adds) so the
+            # float32 sum is bit-identical to the host/C++ walk — the
+            # expensive gather-walks stay batched within each block
+            def add_one(acc, tv):
+                val, cls = tv
                 if k > 1:
-                    acc = acc.at[:, tr["cls"]].add(val)
-                else:
-                    acc = acc + val
+                    return acc.at[:, cls].add(val), None
+                return acc + val, None
+
+            def do_block(acc, blk):
+                vals = jax.vmap(walk_one)(blk)       # (block, n)
+                acc, _ = jax.lax.scan(add_one, acc, (vals, blk["cls"]))
                 return acc, None
 
-            acc, _ = jax.lax.scan(one_tree, out0, stacked)
+            acc, _ = jax.lax.scan(do_block, out0, stacked)
             return acc
 
         self._predict_cache[key] = run

@@ -21,11 +21,12 @@ from __future__ import annotations
 import json
 import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import ThreadingHTTPServer
 from typing import Any, Callable
 
 from ..core.params import HasInputCol, HasOutputCol, Param
 from ..core.pipeline import Transformer
+from .serving import SingleSegmentHandler
 from ..core.schema import Table
 from ..core.serialize import register_stage
 from ..utils.async_utils import buffered_map
@@ -105,7 +106,7 @@ class ConsolidatorService:
     def start(self) -> "ConsolidatorService":
         outer = self
 
-        class Handler(BaseHTTPRequestHandler):
+        class Handler(SingleSegmentHandler):
             def do_POST(self):  # noqa: N802 — http.server API
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length) if length else b""

@@ -56,6 +56,22 @@ class _Exchange:
     enqueued_at: float = 0.0
 
 
+class SingleSegmentHandler(BaseHTTPRequestHandler):
+    """Base for every HTTP handler in this package: buffered writes +
+    TCP_NODELAY so each response leaves as ONE TCP segment.
+
+    The stdlib defaults (wbufsize=0, Nagle on) write headers and body as
+    separate small sends; on a keep-alive connection the second send
+    stalls behind the peer's delayed ACK — ~40 ms added to every round
+    trip, invisible to server-side latency counters (enqueue -> reply
+    written) and devastating to the ~1 ms serving claim. Subclass this
+    instead of BaseHTTPRequestHandler so no future endpoint reintroduces
+    the stall."""
+
+    wbufsize = -1
+    disable_nagle_algorithm = True
+
+
 class ServingServer:
     """HTTP frontend + batched scoring loop.
 
@@ -132,7 +148,7 @@ class ServingServer:
     def start(self) -> "ServingServer":
         outer = self
 
-        class Handler(BaseHTTPRequestHandler):
+        class Handler(SingleSegmentHandler):
             # HTTP/1.1 keep-alive: one connection (and one server thread)
             # serves a client's whole request stream instead of paying TCP
             # setup + thread spawn per request — the tail-latency source on
@@ -592,7 +608,7 @@ class FleetRendezvous:
     def start(self) -> "FleetRendezvous":
         outer = self
 
-        class Handler(BaseHTTPRequestHandler):
+        class Handler(SingleSegmentHandler):
             def _reply(self, status: int, payload: bytes) -> None:
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")

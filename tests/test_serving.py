@@ -74,6 +74,43 @@ class TestContinuousLatency:
         assert stats["p50_ms"] < 10.0, stats
         assert stats["p99_ms"] < 50.0, stats
 
+    def test_keepalive_client_rtt_no_transport_stall(self):
+        """Full CLIENT round trip over a persistent HTTP/1.1 connection —
+        the measurement the server-side window can't make. Regression gate
+        for the Nagle/delayed-ACK class: an unbuffered two-segment
+        response stalls ~40 ms per round trip behind the peer's delayed
+        ACK, while the fixed path (buffered single-segment response +
+        TCP_NODELAY) answers in ~1 ms. The 20 ms bar separates the two
+        regimes with wide CI-noise margin."""
+        import http.client
+        import json as _json
+
+        srv = ServingServer(_echo_handler, max_latency_ms=0.2).start()
+        try:
+            conn = http.client.HTTPConnection(srv.host, srv.port, timeout=30)
+            body = _json.dumps({"x": 1.0}).encode()
+
+            def post():
+                conn.request("POST", srv.api_path, body=body,
+                             headers={"Content-Type": "application/json"})
+                r = conn.getresponse()
+                r.read()
+                assert r.status == 200
+
+            for _ in range(20):
+                post()
+            lat = []
+            for _ in range(100):
+                t0 = time.perf_counter()
+                post()
+                lat.append(time.perf_counter() - t0)
+            conn.close()
+        finally:
+            srv.stop()
+        p50 = sorted(lat)[50] * 1e3
+        assert p50 < 20.0, f"keep-alive client RTT p50 {p50:.1f} ms — " \
+            "transport stall (Nagle/delayed-ACK) regression"
+
     def test_latency_in_info_endpoint(self):
         srv = ServingServer(_echo_handler).start()
         try:

@@ -58,7 +58,12 @@ p50, p99 = e.get("serving_p50_ms"), e.get("serving_p99_ms")
 assert p50 is not None and p99 is not None, "no serving latency in bench"
 assert p50 <= 1.5, f"serving p50 {p50} ms exceeds 1.5 ms gate"
 assert p99 <= 5.0, f"serving p99 {p99} ms exceeds 5 ms gate"
-print(f"latency gate OK: p50={p50} ms p99={p99} ms")
+# the full client round trip (catches transport stalls the server-side
+# window can't see — the Nagle/delayed-ACK class)
+c50, c99 = e.get("serving_client_rtt_p50_ms"), e.get("serving_client_rtt_p99_ms")
+assert c50 is None or c50 <= 3.0, f"client RTT p50 {c50} ms exceeds 3 ms gate"
+assert c99 is None or c99 <= 10.0, f"client RTT p99 {c99} ms exceeds 10 ms gate"
+print(f"latency gate OK: p50={p50} p99={p99} rtt_p50={c50} rtt_p99={c99} ms")
 PYEOF
 then
   echo "LATENCY GATE FAILED"
