@@ -120,6 +120,53 @@ def main():
         tuned_acc = float((tuned_pred == y).mean())
         print(f"head-only fine-tune train accuracy: {tuned_acc:.3f}")
 
+        # -- 5. second imported family: HF-style transformer encoder ----
+        # (the mapping-spec importer generalizes beyond ResNet: flat
+        # torch-layout encoder tensors -> TransformerEncoder, dims
+        # inferred from the checkpoint, num_heads explicit)
+        import jax
+
+        from mmlspark_tpu.nn.import_weights import import_torch_transformer
+
+        enc = {}
+        rng = np.random.default_rng(7)
+        d_model, heads, layers, d_ff, vocab, out_dim = 32, 4, 2, 64, 50, 5
+        enc["embeddings.word_embeddings.weight"] = (vocab, d_model)
+        enc["embeddings.position_embeddings.weight"] = (64, d_model)
+        for i in range(layers):
+            p = f"encoder.layer.{i}"
+            enc[f"{p}.attention.ln.weight"] = (d_model,)
+            enc[f"{p}.attention.ln.bias"] = (d_model,)
+            for proj in ("query", "key", "value"):
+                enc[f"{p}.attention.self.{proj}.weight"] = (d_model, d_model)
+                enc[f"{p}.attention.self.{proj}.bias"] = (d_model,)
+            enc[f"{p}.attention.output.dense.weight"] = (d_model, d_model)
+            enc[f"{p}.attention.output.dense.bias"] = (d_model,)
+            enc[f"{p}.mlp.ln.weight"] = (d_model,)
+            enc[f"{p}.mlp.ln.bias"] = (d_model,)
+            enc[f"{p}.intermediate.dense.weight"] = (d_ff, d_model)
+            enc[f"{p}.intermediate.dense.bias"] = (d_ff,)
+            enc[f"{p}.output.dense.weight"] = (d_model, d_ff)
+            enc[f"{p}.output.dense.bias"] = (d_model,)
+        enc["final_layer_norm.weight"] = (d_model,)
+        enc["final_layer_norm.bias"] = (d_model,)
+        enc["classifier.weight"] = (out_dim, d_model)
+        enc["classifier.bias"] = (out_dim,)
+        enc_sd = {k: (0.1 * rng.standard_normal(s)).astype(np.float32)
+                  for k, s in enc.items()}
+        enc_path = os.path.join(tmp, "encoder.npz")
+        np.savez(enc_path, **enc_sd)
+        tbundle = import_torch_transformer(enc_path, num_heads=heads)
+        tokens = (np.arange(24).reshape(2, 12) % vocab).astype(np.int32)
+        logits = np.asarray(jax.jit(
+            lambda v, t: tbundle.module.apply(v, t, train=False)
+        )(tbundle.variables, tokens))
+        assert logits.shape == (2, out_dim)
+        print(f"imported transformer encoder: inferred "
+              f"d_model={tbundle.config['d_model']} "
+              f"layers={tbundle.config['num_layers']} "
+              f"vocab={tbundle.config['vocab_size']}; logits {logits.shape}")
+
 
 if __name__ == "__main__":
     main()
