@@ -69,6 +69,7 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
     early_stopping_round = Param(0, "stop if no val improvement for N rounds", ptype=int)
     validation_fraction = Param(0.0, "fraction of rows held out for early stopping", ptype=float)
     categorical_slot_indexes = Param((), "indexes of categorical feature slots", ptype=(list, tuple))
+    bin_dtype = Param("int32", "device bin-matrix dtype: int32 | uint8 (4x less histogram HBM read)", ptype=str)
     cat_smooth = Param(10.0, "categorical smoothing for the sorted-subset split order", ptype=float)
     cat_l2 = Param(10.0, "extra L2 regularization on categorical splits", ptype=float)
     max_cat_threshold = Param(32, "max categories on the smaller side of a categorical split", ptype=int)
@@ -119,6 +120,7 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
             feature_fraction=self.get("feature_fraction"),
             early_stopping_round=self.get("early_stopping_round"),
             categorical_indexes=tuple(self.get("categorical_slot_indexes") or ()),
+            bin_dtype=self.get("bin_dtype"),
             cat_smooth=self.get("cat_smooth"),
             cat_l2=self.get("cat_l2"),
             max_cat_threshold=self.get("max_cat_threshold"),

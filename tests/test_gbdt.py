@@ -293,6 +293,32 @@ class TestBooster:
         smaller_side = np.minimum(sizes, n_categories - sizes)
         assert cat_nodes.any() and (smaller_side <= 1).all(), sizes
 
+    def test_uint8_bin_storage_bit_identical(self):
+        """bin_dtype="uint8" (4x narrower histogram HBM reads) must be a
+        pure storage change: bins never exceed 255, kernels cast to int32
+        in VMEM, and the trained model is BIT-IDENTICAL to int32 storage —
+        across numeric+categorical features and both boosting loops."""
+        rng = np.random.default_rng(4)
+        n = 2000
+        cats = rng.integers(0, 7, n).astype(np.float64)
+        x = np.column_stack([rng.normal(size=(n, 5)), cats])
+        y = ((x[:, 0] > 0) ^ np.isin(cats, [1, 4])).astype(np.float64)
+        for boosting in ("gbdt", "dart"):
+            kw = dict(objective="binary", boosting_type=boosting,
+                      num_iterations=8, num_leaves=15,
+                      categorical_indexes=(5,), min_data_in_leaf=5)
+            b32 = Booster.train(x, y, TrainOptions(**kw))
+            b8 = Booster.train(x, y, TrainOptions(bin_dtype="uint8", **kw))
+            assert b8.to_text() == b32.to_text(), (
+                f"{boosting}: uint8 bin storage changed the model"
+            )
+
+    def test_bad_bin_dtype_rejected(self):
+        x, y = make_classification(n=200)
+        with pytest.raises(ValueError, match="bin_dtype"):
+            Booster.train(x, y, TrainOptions(
+                objective="binary", num_iterations=2, bin_dtype="int8"))
+
     def test_fused_dart_zero_drop_equals_gbdt(self):
         """The fused dart loop with drop_rate=0 must be BIT-IDENTICAL to
         gbdt: every round's drop set is empty, weights stay 1, and the
@@ -666,6 +692,14 @@ class TestHistKernel:
         for j in range(f):
             np.add.at(ref[j], bn[:, j], st)
         np.testing.assert_allclose(hx, ref, rtol=1e-4, atol=1e-4)
+        # uint8 bin storage must be bit-identical through EVERY variant
+        # (the kernels cast in VMEM; bench's bin_dtype="uint8" fast path)
+        b8 = bins.astype(jnp.uint8)
+        np.testing.assert_array_equal(hx, np.asarray(histogram_xla(b8, stats, b)))
+        np.testing.assert_array_equal(
+            hp, np.asarray(histogram_pallas_interpret(b8, stats, b)))
+        np.testing.assert_array_equal(
+            hs, np.asarray(histogram_xla_scatter(b8, stats, b)))
 
     def test_fused_variant_agrees(self):
         # F*B 128-aligned -> the FUSED single-dot pallas kernel (the variant
@@ -687,6 +721,10 @@ class TestHistKernel:
         hx2 = np.asarray(hk.histogram_xla(bins2, stats, b2))
         hp2 = np.asarray(hk.histogram_pallas_interpret(bins2, stats, b2))
         np.testing.assert_allclose(hx2, hp2, rtol=1e-5, atol=1e-5)
+        # the FUSED kernel's in-VMEM uint8 cast at the bench shape
+        hp2_u8 = np.asarray(hk.histogram_pallas_interpret(
+            bins2.astype(jnp.uint8), stats, b2))
+        np.testing.assert_array_equal(hp2, hp2_u8)
 
     def test_registry_resolution(self):
         from mmlspark_tpu.core import kernels

@@ -70,6 +70,27 @@ then
   rc=1
 fi
 
+echo "== xprof trace of a GBDT fit (for roofline analysis next round) =="
+if timeout 600 env MMLSPARK_TPU_TRACE_DIR="$OUT/xprof" \
+    MMLSPARK_TPU_BENCH_PROBE_ATTEMPTS=1 python - > "$OUT/trace.txt" 2>&1 <<'PYEOF'
+import numpy as np
+from mmlspark_tpu.gbdt.booster import Booster, TrainOptions
+from mmlspark_tpu.utils.profiling import device_trace
+import os
+rng = np.random.default_rng(7)
+x = rng.normal(size=(1 << 18, 28)); y = (x[:, 0] - 0.5 * x[:, 1] > 0).astype(float)
+opts = TrainOptions(objective="binary", num_iterations=20, num_leaves=63)
+Booster.train(x, y, opts)                 # compile warm-up outside the trace
+with device_trace(os.environ["MMLSPARK_TPU_TRACE_DIR"]):
+    Booster.train(x, y, opts)
+print("trace captured")
+PYEOF
+then
+  tail -1 "$OUT/trace.txt"
+else
+  echo "TRACE FAILED (non-fatal):"; tail -3 "$OUT/trace.txt"
+fi
+
 if [ "$rc" -eq 0 ]; then
   echo "== done — outputs in $OUT/ =="
 else
