@@ -67,6 +67,16 @@ IMG_BATCH = int(os.environ.get("MMLSPARK_TPU_BENCH_IMG_BATCH", 1024))
 N_IMAGES = 8192         # CIFAR10-scale eval slice
 
 _FORCE_CPU_ENV = "MMLSPARK_TPU_BENCH_FORCE_CPU"
+# Orchestrator plumbing (see main()): the tunneled TPU is EXCLUSIVE to one
+# process — a second process hangs in backend init until the first exits —
+# so the families run as SEQUENTIAL child processes, each with a hard
+# timeout. A native-code compile hang (observed: ResNet-50 backward at
+# bs=128/224px never returned in 21 min) cannot be interrupted from inside
+# the process (signals only fire between bytecodes), so the watchdog must
+# live in a parent that never touches the device.
+_SKIP_TRAINER_ENV = "MMLSPARK_TPU_BENCH_SKIP_TRAINER"
+_CORE_TIMEOUT_ENV = "MMLSPARK_TPU_BENCH_CORE_TIMEOUT"
+_TRAINER_TIMEOUT_ENV = "MMLSPARK_TPU_BENCH_TRAINER_TIMEOUT"
 
 
 # --------------------------------------------------------------------- #
@@ -452,8 +462,12 @@ def bench_trainer(peak_tflops: "float | None") -> dict:
 
     on_cpu = jax.default_backend() == "cpu"
     side = 32 if on_cpu else 224
-    n = 64 if on_cpu else 1024
-    bs = 32 if on_cpu else 128
+    n = 64 if on_cpu else 512
+    # bs=64 is the largest 224px train batch that compiles in bounded time
+    # on the tunneled chip (bs=128's backward never returned in 21 min —
+    # see tools/sweep_batch.py); the orchestrator's trainer timeout guards
+    # the rest.
+    bs = 32 if on_cpu else 64
     extra_epochs = 1 if on_cpu else 2
     classes = 10
     rng = np.random.default_rng(5)
@@ -584,6 +598,24 @@ def _resolve_kernel_name() -> str:
 # --------------------------------------------------------------------- #
 
 
+def _trainer_extra(trainer: "dict | None") -> dict:
+    """Trainer fields of the JSON line — shared by _run_suite and the
+    orchestrator's post-hoc merge of the trainer child's output."""
+    ips = trainer.get("train_images_per_sec") if trainer else None
+    return {
+        "trainer_images_per_sec": round(ips, 1) if ips else None,
+        "trainer_vs_baseline": round(
+            ips / BASELINE_TRAIN_IMAGES_PER_SEC, 3) if ips else None,
+        "trainer_baseline_images_per_sec": BASELINE_TRAIN_IMAGES_PER_SEC,
+        "trainer_tflops": round(
+            trainer["train_tflops"], 3)
+            if trainer and trainer.get("train_tflops") else None,
+        "trainer_mfu": trainer.get("train_mfu") if trainer else None,
+        "trainer_image_side": trainer.get("image_side") if trainer else None,
+        "trainer_smoke_only": trainer.get("smoke_only") if trainer else None,
+    }
+
+
 def _run_suite(platform: str) -> dict:
     chip, peak_tflops, peak_gbps = chip_peaks()
 
@@ -622,12 +654,17 @@ def _run_suite(platform: str) -> dict:
         runner = {"images_per_sec": 0.0, "transform_seconds": 0.0,
                   "resident_images_per_sec": 0.0, "resident_tflops": 0.0,
                   "resident_mfu": None, "flops_per_image": 0.0}
-    try:
-        trainer = bench_trainer(peak_tflops)
-    except Exception as e:  # noqa: BLE001 — auxiliary; never lose the line
-        print(f"bench: trainer bench failed ({e!r})", file=sys.stderr)
-        traceback.print_exc()
+    if os.environ.get(_SKIP_TRAINER_ENV):
+        # orchestrated run: the trainer family runs in its own child
+        # process (compile-hang watchdog) and is merged in by the parent
         trainer = None
+    else:
+        try:
+            trainer = bench_trainer(peak_tflops)
+        except Exception as e:  # noqa: BLE001 — auxiliary; never lose the line
+            print(f"bench: trainer bench failed ({e!r})", file=sys.stderr)
+            traceback.print_exc()
+            trainer = None
     try:
         serving = bench_serving()
     except Exception as e:  # noqa: BLE001 — latency is auxiliary
@@ -687,19 +724,7 @@ def _run_suite(platform: str) -> dict:
             "model_runner_resident_mfu": runner.get("resident_mfu"),
             "model_runner_flops_per_image": round(
                 runner.get("flops_per_image", 0.0)),
-            "trainer_images_per_sec": round(
-                trainer["train_images_per_sec"], 1)
-                if trainer and trainer["train_images_per_sec"] else None,
-            "trainer_vs_baseline": round(
-                trainer["train_images_per_sec"] / BASELINE_TRAIN_IMAGES_PER_SEC,
-                3) if trainer and trainer["train_images_per_sec"] else None,
-            "trainer_baseline_images_per_sec": BASELINE_TRAIN_IMAGES_PER_SEC,
-            "trainer_tflops": round(
-                trainer["train_tflops"], 3)
-                if trainer and trainer.get("train_tflops") else None,
-            "trainer_mfu": trainer.get("train_mfu") if trainer else None,
-            "trainer_image_side": trainer.get("image_side") if trainer else None,
-            "trainer_smoke_only": trainer.get("smoke_only") if trainer else None,
+            **_trainer_extra(trainer),
             "serving_p50_ms": round(serving["p50_ms"], 3) if serving else None,
             "serving_p99_ms": round(serving["p99_ms"], 3) if serving else None,
             "serving_client_rtt_p50_ms": round(
@@ -717,7 +742,26 @@ def _run_suite(platform: str) -> dict:
     }
 
 
-def main() -> None:
+def _cpu_fallback_reexec(backend: str, msg: str) -> bool:
+    """On a non-CPU failure, re-exec this same invocation in a fresh
+    process pinned to CPU (the failed process's jax backend state is
+    poisoned) and exit with the child's rc — the JSON line must land with
+    rc=0 even through a tunnel outage. Returns False when the caller
+    should re-raise instead (already on/forced to CPU)."""
+    if backend == "cpu" or os.environ.get(_FORCE_CPU_ENV):
+        return False
+    print(msg, file=sys.stderr)
+    traceback.print_exc()
+    env = dict(os.environ, **{_FORCE_CPU_ENV: "1"})
+    child = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)] + sys.argv[1:], env=env)
+    sys.exit(child.returncode)
+
+
+def _family_core_main() -> None:
+    """Everything except the trainer family, in this process (with the
+    existing lost-backend CPU re-exec). Emits the full JSON line with
+    trainer fields null; the orchestrator fills them in."""
     backend = _probe_backend()
     import jax
 
@@ -732,19 +776,123 @@ def main() -> None:
               file=sys.stderr)
         line = _run_suite(platform)
     except Exception:
-        if backend != "cpu" and not os.environ.get(_FORCE_CPU_ENV):
-            # backend lost mid-run (or any non-CPU failure): the process's
-            # jax backend state is poisoned, so re-execute the whole bench
-            # in a fresh process pinned to CPU — the JSON line must land
-            # with rc=0 even through a tunnel outage
-            print("bench: non-CPU run failed; re-executing on CPU fallback",
-                  file=sys.stderr)
-            traceback.print_exc()
-            env = dict(os.environ, **{_FORCE_CPU_ENV: "1"})
-            child = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                                   env=env)
-            sys.exit(child.returncode)
-        raise
+        if not _cpu_fallback_reexec(
+                backend, "bench: non-CPU run failed; re-executing on CPU "
+                "fallback"):
+            raise
+    print(json.dumps(line))
+
+
+def _family_trainer_main() -> None:
+    """The trainer family alone. Runs in its own process because its
+    224px ResNet-50 backward compile has hung natively (uninterruptible
+    in-process); the orchestrator kills this child on timeout."""
+    backend = _probe_backend()
+    import jax
+
+    if backend == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        _, peak_tflops, _ = chip_peaks()
+        out = bench_trainer(peak_tflops)
+    except Exception:
+        if not _cpu_fallback_reexec(
+                backend, "bench: trainer family failed on device; CPU "
+                "fallback"):
+            raise
+    print(json.dumps(out))
+
+
+def _run_watched(args: list, env: dict,
+                 timeout: float) -> "tuple[int | None, str, str]":
+    """Run a child in its own process group and return (rc, stdout, stderr);
+    rc is None on timeout. Killing the GROUP matters: the family children
+    re-exec a CPU-fallback grandchild on device failure, and a plain
+    child-kill would orphan it to race the orchestrator's own retry."""
+    import signal
+
+    proc = subprocess.Popen(args, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True,
+                            start_new_session=True)
+    try:
+        out, err = proc.communicate(timeout=timeout)
+        return proc.returncode, out or "", err or ""
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        out, err = proc.communicate()
+        return None, out or "", err or ""
+
+
+def _last_json_line(stdout: str) -> "dict | None":
+    for text in reversed((stdout or "").strip().splitlines()):
+        try:
+            return json.loads(text)
+        except ValueError:
+            continue
+    return None
+
+
+def main() -> None:
+    if "--family" in sys.argv:
+        idx = sys.argv.index("--family") + 1
+        family = sys.argv[idx] if idx < len(sys.argv) else "<missing>"
+        if family == "core":
+            return _family_core_main()
+        if family == "trainer":
+            return _family_trainer_main()
+        raise SystemExit(f"bench: unknown family {family!r}")
+
+    # Orchestrator: never imports jax (the tunneled TPU is single-process;
+    # holding it here would deadlock the children). Core families first —
+    # they carry the headline metric — then the trainer under its own
+    # compile-hang timeout; a trainer loss costs only null trainer fields.
+    here = os.path.abspath(__file__)
+    core_timeout = float(os.environ.get(_CORE_TIMEOUT_ENV, 1800))
+    trainer_timeout = float(os.environ.get(_TRAINER_TIMEOUT_ENV, 900))
+
+    line = None
+    core_cpu = False
+    core_env = dict(os.environ, **{_SKIP_TRAINER_ENV: "1"})
+    for forced in (False, True):
+        env = dict(core_env, **({_FORCE_CPU_ENV: "1"} if forced else {}))
+        rc, out, err = _run_watched(
+            [sys.executable, here, "--family", "core"], env, core_timeout)
+        sys.stderr.write(err[-20000:])
+        if rc == 0:
+            line = _last_json_line(out)
+            if line is not None:
+                core_cpu = (forced
+                            or line.get("extra", {}).get("platform") == "cpu")
+                break
+        reason = (f"exceeded {core_timeout:.0f}s" if rc is None
+                  else f"rc={rc}")
+        print(f"bench: core families {reason}; retrying on CPU fallback",
+              file=sys.stderr)
+    if line is None:
+        raise SystemExit("bench: core families failed even on CPU fallback")
+
+    trainer_env = dict(os.environ)
+    if core_cpu:
+        # the device already proved dead/absent this run — don't let the
+        # trainer child burn its whole timeout re-probing the tunnel
+        trainer_env[_FORCE_CPU_ENV] = "1"
+    # cap the trainer child's probe retries below its own timeout
+    trainer_env.setdefault("MMLSPARK_TPU_BENCH_PROBE_ATTEMPTS", "2")
+    rc, out, err = _run_watched(
+        [sys.executable, here, "--family", "trainer"], trainer_env,
+        trainer_timeout)
+    sys.stderr.write(err[-20000:])
+    trainer = _last_json_line(out) if rc == 0 else None
+    if rc != 0:
+        reason = (f"exceeded {trainer_timeout:.0f}s (compile-hang guard)"
+                  if rc is None else f"rc={rc}")
+        print(f"bench: trainer family {reason}; trainer fields stay null",
+              file=sys.stderr)
+    if trainer is not None:
+        line["extra"].update(_trainer_extra(trainer))
     print(json.dumps(line))
 
 

@@ -120,7 +120,10 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=None, help="also write CSV here")
     ap.add_argument("--runner-batches", default="256,512,1024,2048,4096")
-    ap.add_argument("--trainer-batches", default="32,64,128,256")
+    # 128+ excluded from the default: the 224px ResNet-50 backward compile
+    # at bs=128 hung >21 min on the tunneled chip (2026-07-30 session) and
+    # a native compile hang is unkillable in-process
+    ap.add_argument("--trainer-batches", default="32,64")
     ap.add_argument("--trainer-side", type=int, default=224)
     args = ap.parse_args()
 

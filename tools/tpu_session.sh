@@ -5,6 +5,8 @@
 #   tools/tpu_session.sh           # probe, then sweep + bench
 set -uo pipefail
 cd "$(dirname "$0")/.."
+# scripts under tools/ put tools/ at sys.path[0]; the package lives at root
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 OUT=tpu_session_out
 mkdir -p "$OUT"
 
@@ -36,7 +38,9 @@ else
 fi
 
 echo "== bench =="
-if timeout 2400 python bench.py > "$OUT/bench.json" 2> "$OUT/bench.err"; then
+# worst case inside the orchestrator: device core attempt (1800s) + CPU
+# core retry (1800s) + trainer child (900s) — the outer guard must cover it
+if timeout 4800 python bench.py > "$OUT/bench.json" 2> "$OUT/bench.err"; then
   tail -1 "$OUT/bench.json"
 else
   echo "BENCH FAILED (rc=$?) — tail of $OUT/bench.err:"; tail -5 "$OUT/bench.err"

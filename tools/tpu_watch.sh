@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Tunnel watcher: probe the device every PROBE_INTERVAL seconds and fire
+# tools/tpu_session.sh the moment a window opens. Loops until one session
+# COMPLETES with rc=0 (a session that loses the tunnel mid-run exits
+# nonzero and the watcher re-arms for the next window), or until
+# MAX_PROBES consecutive probes fail.
+#
+#   tools/tpu_watch.sh [logfile]       # default /tmp/tunnel_watch.log
+set -uo pipefail
+cd "$(dirname "$0")/.."
+LOG="${1:-/tmp/tunnel_watch.log}"
+PROBE_INTERVAL="${PROBE_INTERVAL:-240}"
+MAX_PROBES="${MAX_PROBES:-150}"
+
+echo "$(date -u +%FT%TZ) watcher armed (interval=${PROBE_INTERVAL}s)" >> "$LOG"
+probe_n=0
+while [ "$probe_n" -lt "$MAX_PROBES" ]; do
+  probe_n=$((probe_n + 1))
+  if timeout 120 python -c \
+      "import jax; d=jax.devices()[0]; assert d.platform != 'cpu'" \
+      >/dev/null 2>&1; then
+    echo "$(date -u +%FT%TZ) probe $probe_n OK — firing session" >> "$LOG"
+    if bash tools/tpu_session.sh >> "$LOG" 2>&1; then
+      echo "$(date -u +%FT%TZ) session complete rc=0 — watcher done" >> "$LOG"
+      exit 0
+    fi
+    echo "$(date -u +%FT%TZ) session failed — re-arming" >> "$LOG"
+  else
+    echo "$(date -u +%FT%TZ) probe $probe_n failed" >> "$LOG"
+  fi
+  sleep "$PROBE_INTERVAL"
+done
+echo "$(date -u +%FT%TZ) watcher gave up after $MAX_PROBES probes" >> "$LOG"
+exit 1
