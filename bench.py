@@ -133,6 +133,16 @@ def _mfu(tflops_achieved: "float | None", peak: "float | None") -> "float | None
     return round(tflops_achieved / peak, 4)
 
 
+def pin_cpu_if_requested() -> None:
+    """Honor JAX_PLATFORMS=cpu under the axon sitecustomize, which pins
+    jax_platforms so the env var alone is ignored — shared by the tools/
+    scripts (call after importing jax, before first device use)."""
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
 def _probe_backend(timeout_s: float = 180.0, attempts: int = 5,
                    retry_delay_s: float = 90.0) -> str:
     """Try real-device backend init in a subprocess; 'default' if it works,

@@ -1,0 +1,113 @@
+"""Measure the tunneled device's per-dispatch cost, separated from compute.
+
+The round-4 sweep saw ResNet-50 224px training at ~0.4% MFU under a
+one-dispatch-per-step host loop while a fused forward hit near-peak — the
+suspected culprit is per-dispatch client latency on the remote (axon
+tunnel) device, which a lax.scan-fused dispatch amortizes away. This
+prints the numbers that settle it:
+
+  rtt_tiny_ms        — N dependent dispatches of a trivial jitted op
+                       (x @ w, 128x128): pure dispatch round-trip.
+  rtt_tiny_donated   — same with buffer donation (donation can force the
+                       client to synchronize on remote runtimes).
+  scan_tiny_ms       — the same N trivial steps fused in one lax.scan
+                       dispatch: the floor dispatch cost once amortized.
+  fwd224_ms          — one ResNet-50 224px bf16 forward, bs=32: is the
+                       *forward* compute itself sane on this chip?
+
+Usage: python tools/diag_tunnel.py  (run on the real chip)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_STEPS = 16
+
+
+def timed(fn, *args, reps=3):
+    out = fn(*args)
+    import jax
+
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from bench import pin_cpu_if_requested
+
+    pin_cpu_if_requested()
+
+    dev = jax.devices()[0]
+    print(f"device: {dev.device_kind} ({dev.platform})")
+
+    w = jnp.eye(128, dtype=jnp.float32) * 0.999
+    x0 = jnp.ones((128, 128), jnp.float32)
+
+    step = jax.jit(lambda x: x @ w)
+
+    def loop(x):
+        for _ in range(N_STEPS):
+            x = step(x)
+        return x
+
+    jax.block_until_ready(loop(x0))  # warm
+    t = timed(loop, x0)
+    print(f"rtt_tiny_ms          {t / N_STEPS * 1e3:8.3f}   "
+          f"({N_STEPS} dependent dispatches, trivial op)", flush=True)
+
+    step_don = jax.jit(lambda x: x @ w, donate_argnums=(0,))
+
+    def loop_don(_):
+        x = jnp.ones((128, 128), jnp.float32)
+        for _ in range(N_STEPS):
+            x = step_don(x)
+        return x
+
+    jax.block_until_ready(loop_don(None))
+    t = timed(loop_don, None)
+    print(f"rtt_tiny_donated_ms  {t / N_STEPS * 1e3:8.3f}   "
+          f"(same, with donation)", flush=True)
+
+    scan = jax.jit(
+        lambda x: jax.lax.scan(lambda c, _: (c @ w, None), x, None,
+                               length=N_STEPS)[0])
+    jax.block_until_ready(scan(x0))
+    t = timed(scan, x0)
+    print(f"scan_tiny_ms         {t / N_STEPS * 1e3:8.3f}   "
+          f"(same steps fused in one scan dispatch)", flush=True)
+
+    from mmlspark_tpu.nn.models import make_model
+
+    on_cpu = dev.platform == "cpu"
+    arch, side, gflop_img = (("resnet20_cifar", 32, 0.041) if on_cpu
+                             else ("resnet50", 224, 4.1))
+    module = make_model(arch, num_outputs=10, dtype=jnp.bfloat16)
+    rng = np.random.default_rng(0)
+    xb = jnp.asarray(rng.integers(0, 256, size=(32, side, side, 3),
+                                  dtype=np.uint8))
+    variables = module.init(jax.random.PRNGKey(0), xb[:1].astype(jnp.float32))
+    fwd = jax.jit(lambda v, x: module.apply(v, x.astype(jnp.float32),
+                                            train=False))
+    t = timed(fwd, variables, xb)
+    gflop = gflop_img * 32
+    print(f"fwd_{side}px_ms      {t * 1e3:8.3f}   "
+          f"({arch} bs=32 fwd ≈ {gflop / t / 1e3:.1f} TFLOP/s)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -62,7 +62,15 @@ def sweep_runner(batches, peak_tflops):
     return rows
 
 
-def sweep_trainer(batches, peak_tflops, side=224):
+def sweep_trainer(batches, peak_tflops, side=224, scan_steps=8):
+    """Two dispatch patterns per batch size:
+
+    * ``scan`` — all steps inside ONE jitted lax.scan, the DNNLearner
+      fused-epoch pattern (nn/trainer.py). One dispatch per measurement.
+    * ``loop`` — one dispatch per step (the naive host loop). On the
+      tunneled chip this pays per-dispatch client latency every step;
+      the scan/loop ratio IS the measured dispatch tax.
+    """
     import jax
     import jax.numpy as jnp
     import optax
@@ -97,22 +105,43 @@ def sweep_trainer(batches, peak_tflops, side=224):
             updates, opt_state = tx.update(grads, opt_state, params)
             return optax.apply_updates(params, updates), bst, opt_state, loss
 
-        jit_step = jax.jit(step, donate_argnums=(0, 1, 2))
-        params, batch_stats, opt_state, _ = jit_step(params, batch_stats, opt_state)
-        n_steps = 8
-        t0 = time.perf_counter()
-        for _ in range(n_steps):
-            params, batch_stats, opt_state, loss = jit_step(
-                params, batch_stats, opt_state)
-        jax.block_until_ready(loss)
-        ips = n_steps * bs / (time.perf_counter() - t0)
         per_img = (flops_of(jax.jit(step), params, batch_stats, opt_state)
                    or 3 * 4.1e9 * (side / 224) ** 2 * bs) / bs
-        tflops = ips * per_img / 1e12
-        mfu = tflops / peak_tflops if peak_tflops else float("nan")
-        rows.append((f"trainer_resnet50_{side}", bs, ips, tflops, mfu))
-        print(f"trainer bs={bs}: {ips:,.0f} img/s, {tflops:.2f} TFLOP/s, "
-              f"mfu={mfu:.3f}", file=sys.stderr)
+
+        def scan_steps_fn(params, batch_stats, opt_state):
+            def body(carry, _):
+                p, bst, o, loss = step(*carry)
+                return (p, bst, o), loss
+            (p, bst, o), losses = jax.lax.scan(
+                body, (params, batch_stats, opt_state), None,
+                length=scan_steps)
+            return p, bst, o, losses[-1]
+
+        for name, fn, n_dispatch in (
+                ("scan", jax.jit(scan_steps_fn), 1),
+                ("loop", jax.jit(step, donate_argnums=(0, 1, 2)), scan_steps)):
+            p, bst, o = params, batch_stats, opt_state
+            if n_dispatch == 1:
+                out = fn(p, bst, o)          # compile + warm
+                jax.block_until_ready(out)
+                t0 = time.perf_counter()
+                out = fn(p, bst, o)
+                jax.block_until_ready(out)
+                dt = time.perf_counter() - t0
+            else:
+                p, bst, o, _ = fn(p, bst, o)  # compile + warm
+                t0 = time.perf_counter()
+                for _ in range(scan_steps):
+                    p, bst, o, loss = fn(p, bst, o)
+                jax.block_until_ready(loss)
+                dt = time.perf_counter() - t0
+            ips = scan_steps * bs / dt
+            tflops = ips * per_img / 1e12
+            mfu = tflops / peak_tflops if peak_tflops else float("nan")
+            rows.append((f"trainer_resnet50_{side}_{name}", bs, ips, tflops,
+                         mfu))
+            print(f"trainer[{name}] bs={bs}: {ips:,.0f} img/s, "
+                  f"{tflops:.2f} TFLOP/s, mfu={mfu:.3f}", file=sys.stderr)
     return rows
 
 
@@ -130,6 +159,10 @@ def main():
     import jax
 
     from bench import chip_peaks
+
+    from bench import pin_cpu_if_requested
+
+    pin_cpu_if_requested()
 
     kind, peak_tflops, _ = chip_peaks()
     print(f"sweep on {kind} ({jax.default_backend()})", file=sys.stderr)

@@ -120,6 +120,9 @@ def v_materialized_oh(bins, stats, num_bins):
 
 
 def main():
+    from bench import pin_cpu_if_requested
+
+    pin_cpu_if_requested()
     print(f"device: {jax.devices()[0].device_kind}")
     bins, stats = make_inputs()
     from mmlspark_tpu.gbdt.hist_kernel import histogram_xla

@@ -19,6 +19,14 @@ cat "$OUT/probe.txt"
 
 rc=0
 
+echo "== dispatch diagnostic (tunnel RTT vs fused scan) =="
+if timeout 600 python -u tools/diag_tunnel.py > "$OUT/diag.txt" 2>&1; then
+  tail -6 "$OUT/diag.txt"
+else
+  echo "DIAG FAILED (rc=$?) — tail of $OUT/diag.txt:"; tail -3 "$OUT/diag.txt"
+  rc=1
+fi
+
 echo "== kernel sweep =="
 if timeout 1200 python -u tools/sweep_hist.py > "$OUT/sweep.txt" 2>&1; then
   tail -12 "$OUT/sweep.txt"

@@ -20,6 +20,7 @@ while [ "$probe_n" -lt "$MAX_PROBES" ]; do
       "import jax; d=jax.devices()[0]; assert d.platform != 'cpu'" \
       >/dev/null 2>&1; then
     echo "$(date -u +%FT%TZ) probe $probe_n OK — firing session" >> "$LOG"
+    probe_n=0   # the budget counts CONSECUTIVE failed probes
     if bash tools/tpu_session.sh >> "$LOG" 2>&1; then
       echo "$(date -u +%FT%TZ) session complete rc=0 — watcher done" >> "$LOG"
       exit 0
