@@ -75,8 +75,10 @@ _FORCE_CPU_ENV = "MMLSPARK_TPU_BENCH_FORCE_CPU"
 # the process (signals only fire between bytecodes), so the watchdog must
 # live in a parent that never touches the device.
 _SKIP_TRAINER_ENV = "MMLSPARK_TPU_BENCH_SKIP_TRAINER"
+_SKIP_TRANSFORMER_ENV = "MMLSPARK_TPU_BENCH_SKIP_TRANSFORMER"
 _CORE_TIMEOUT_ENV = "MMLSPARK_TPU_BENCH_CORE_TIMEOUT"
 _TRAINER_TIMEOUT_ENV = "MMLSPARK_TPU_BENCH_TRAINER_TIMEOUT"
+_TRANSFORMER_TIMEOUT_ENV = "MMLSPARK_TPU_BENCH_TRANSFORMER_TIMEOUT"
 
 
 # --------------------------------------------------------------------- #
@@ -454,6 +456,131 @@ def bench_model_runner(peak_tflops: "float | None") -> dict:
     }
 
 
+def bench_transformer(peak_tflops: "float | None") -> dict:
+    """Transformer encoder throughput (tokens/sec + MFU) — the
+    beyond-reference sequence family (SURVEY.md §5.7: the reference has no
+    sequence models at all). Three measurements:
+
+    * forward, XLA dense attention vs the Pallas flash kernel
+      (nn/attention.py) head-to-head at seq 512 — the kernel's value is a
+      measured claim, not a design claim;
+    * fused-scan training (all steps in ONE dispatch, the DNNLearner
+      dispatch pattern) with the chunked O(T) attention core;
+    * a long-sequence forward (seq 4096) on the flash kernel, where dense
+      attention's (T,T) score materialization starts paying real HBM.
+
+    Transformer MFU is the honest utilization probe: the FLOPs are large
+    matmuls, so achieved/peak here reflects the framework, not conv
+    shapes. CPU runs are tiny smokes and report null throughput, same
+    policy as bench_trainer."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from mmlspark_tpu.nn.models import make_model
+
+    on_cpu = jax.default_backend() == "cpu"
+    if on_cpu:
+        layers, d_model, heads, d_ff, vocab = 2, 64, 4, 128, 512
+        seq, bs_fwd, bs_train, long_seq, long_bs = 64, 8, 4, 256, 1
+        fwd_batches, train_steps = 2, 2
+    else:
+        layers, d_model, heads, d_ff, vocab = 8, 512, 8, 2048, 16384
+        seq, bs_fwd, bs_train, long_seq, long_bs = 512, 64, 32, 4096, 4
+        fwd_batches, train_steps = 16, 8
+
+    rng = np.random.default_rng(11)
+
+    def toks(b, t):
+        return jnp.asarray(rng.integers(0, vocab, size=(b, t)), jnp.int32)
+
+    def model(impl, max_len):
+        return make_model(
+            "transformer", num_layers=layers, d_model=d_model,
+            num_heads=heads, d_ff=d_ff, vocab_size=vocab, num_outputs=8,
+            max_len=max_len, attention_impl=impl, dtype=jnp.bfloat16)
+
+    base = model("dense", max(seq, long_seq))
+    xb = toks(bs_fwd, seq)
+    variables = base.init(jax.random.PRNGKey(0), xb)
+
+    def timed_fwd(impl, x, n_batches, want_flops=False):
+        m = model(impl, max(seq, long_seq))
+        fwd = jax.jit(lambda v, xb_: m.apply(v, xb_))
+        jax.block_until_ready(fwd(variables, x))
+        t0 = time.perf_counter()
+        outs = [fwd(variables, x) for _ in range(n_batches)]
+        jax.block_until_ready(outs[-1])
+        dt = time.perf_counter() - t0
+        tokens = n_batches * x.shape[0] * x.shape[1]
+        # flops_of re-lowers + re-compiles outside the jit cache — only pay
+        # that for the one call whose FLOP count is actually used
+        fl = flops_of(fwd, variables, x) if want_flops else None
+        return tokens / dt, (fl / (x.shape[0] * x.shape[1]) if fl else None)
+
+    fwd_dense_tps, per_tok = timed_fwd("dense", xb, fwd_batches,
+                                       want_flops=True)
+    fwd_flash_tps, _ = timed_fwd("flash", xb, fwd_batches)
+    long_tps, _ = timed_fwd("flash", toks(long_bs, long_seq), fwd_batches)
+
+    # training: chunked attention core, all steps fused in one scan dispatch
+    m_train = model("chunked", seq)
+    xt, yt = toks(bs_train, seq), jnp.asarray(
+        rng.integers(0, 8, size=bs_train), jnp.int32)
+    tvars = m_train.init(jax.random.PRNGKey(1), xt)
+    tx = optax.adamw(1e-4)
+    opt0 = tx.init(tvars["params"])
+
+    def step(params, opt_state):
+        def loss_fn(p):
+            logits = m_train.apply({"params": p}, xt, train=True)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), yt).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    def epoch(params, opt_state):
+        def body(carry, _):
+            p, o = carry
+            p, o, loss = step(p, o)
+            return (p, o), loss
+
+        (p, o), losses = jax.lax.scan(
+            body, (params, opt_state), None, length=train_steps)
+        return p, o, losses[-1]
+
+    ep = jax.jit(epoch)
+    out = ep(tvars["params"], opt0)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = ep(tvars["params"], opt0)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    train_tps = train_steps * bs_train * seq / dt
+    sf = flops_of(ep, tvars["params"], opt0)
+    train_per_tok = (sf / (train_steps * bs_train * seq)) if sf else (
+        3 * per_tok if per_tok else None)
+
+    measurable = not on_cpu
+    fwd_tflops = (fwd_flash_tps * per_tok / 1e12
+                  if measurable and per_tok else None)
+    train_tflops = (train_tps * train_per_tok / 1e12
+                    if measurable and train_per_tok else None)
+    return {
+        "fwd_dense_tokens_per_sec": fwd_dense_tps if measurable else None,
+        "fwd_flash_tokens_per_sec": fwd_flash_tps if measurable else None,
+        "fwd_mfu": _mfu(fwd_tflops, peak_tflops),
+        "longseq_tokens_per_sec": long_tps if measurable else None,
+        "train_tokens_per_sec": train_tps if measurable else None,
+        "train_mfu": _mfu(train_tflops, peak_tflops),
+        "seq_len": seq,
+        "long_seq_len": long_seq,
+        "smoke_only": on_cpu,
+    }
+
+
 def bench_trainer(peak_tflops: "float | None") -> dict:
     """ResNet-50 fine-tune throughput (images/sec) — BASELINE config #4
     (the reference trains out-of-band via mpirun+CNTK,
@@ -608,6 +735,11 @@ def _resolve_kernel_name() -> str:
 # --------------------------------------------------------------------- #
 
 
+def _r1(d: "dict | None", key: str) -> "float | None":
+    v = d.get(key) if d else None
+    return round(v, 1) if v is not None else None
+
+
 def _trainer_extra(trainer: "dict | None") -> dict:
     """Trainer fields of the JSON line — shared by _run_suite and the
     orchestrator's post-hoc merge of the trainer child's output."""
@@ -623,6 +755,27 @@ def _trainer_extra(trainer: "dict | None") -> dict:
         "trainer_mfu": trainer.get("train_mfu") if trainer else None,
         "trainer_image_side": trainer.get("image_side") if trainer else None,
         "trainer_smoke_only": trainer.get("smoke_only") if trainer else None,
+    }
+
+
+def _transformer_extra(transformer: "dict | None") -> dict:
+    """Transformer fields of the JSON line — shared by _run_suite and the
+    orchestrator's post-hoc merge of the transformer child's output."""
+    g = (transformer or {}).get
+    return {
+        "transformer_fwd_dense_tokens_per_sec": _r1(
+            transformer, "fwd_dense_tokens_per_sec"),
+        "transformer_fwd_flash_tokens_per_sec": _r1(
+            transformer, "fwd_flash_tokens_per_sec"),
+        "transformer_fwd_mfu": g("fwd_mfu"),
+        "transformer_longseq_tokens_per_sec": _r1(
+            transformer, "longseq_tokens_per_sec"),
+        "transformer_train_tokens_per_sec": _r1(
+            transformer, "train_tokens_per_sec"),
+        "transformer_train_mfu": g("train_mfu"),
+        "transformer_seq_len": g("seq_len"),
+        "transformer_long_seq_len": g("long_seq_len"),
+        "transformer_smoke_only": g("smoke_only"),
     }
 
 
@@ -664,6 +817,17 @@ def _run_suite(platform: str) -> dict:
         runner = {"images_per_sec": 0.0, "transform_seconds": 0.0,
                   "resident_images_per_sec": 0.0, "resident_tflops": 0.0,
                   "resident_mfu": None, "flops_per_image": 0.0}
+    if os.environ.get(_SKIP_TRANSFORMER_ENV):
+        # orchestrated run: the transformer family (the suite's largest
+        # compiles) runs in its own watched child, like the trainer
+        transformer = None
+    else:
+        try:
+            transformer = bench_transformer(peak_tflops)
+        except Exception as e:  # noqa: BLE001 — beyond-reference family
+            print(f"bench: transformer bench failed ({e!r})", file=sys.stderr)
+            traceback.print_exc()
+            transformer = None
     if os.environ.get(_SKIP_TRAINER_ENV):
         # orchestrated run: the trainer family runs in its own child
         # process (compile-hang watchdog) and is merged in by the parent
@@ -735,6 +899,7 @@ def _run_suite(platform: str) -> dict:
             "model_runner_flops_per_image": round(
                 runner.get("flops_per_image", 0.0)),
             **_trainer_extra(trainer),
+            **_transformer_extra(transformer),
             "serving_p50_ms": round(serving["p50_ms"], 3) if serving else None,
             "serving_p99_ms": round(serving["p99_ms"], 3) if serving else None,
             "serving_client_rtt_p50_ms": round(
@@ -793,10 +958,11 @@ def _family_core_main() -> None:
     print(json.dumps(line))
 
 
-def _family_trainer_main() -> None:
-    """The trainer family alone. Runs in its own process because its
-    224px ResNet-50 backward compile has hung natively (uninterruptible
-    in-process); the orchestrator kills this child on timeout."""
+def _family_solo_main(bench_fn, label: str) -> None:
+    """One heavy family alone (trainer / transformer). Runs in its own
+    process because big backward compiles have hung natively
+    (uninterruptible in-process); the orchestrator kills the child on
+    timeout."""
     backend = _probe_backend()
     import jax
 
@@ -804,10 +970,10 @@ def _family_trainer_main() -> None:
         jax.config.update("jax_platforms", "cpu")
     try:
         _, peak_tflops, _ = chip_peaks()
-        out = bench_trainer(peak_tflops)
+        out = bench_fn(peak_tflops)
     except Exception:
         if not _cpu_fallback_reexec(
-                backend, "bench: trainer family failed on device; CPU "
+                backend, f"bench: {label} family failed on device; CPU "
                 "fallback"):
             raise
     print(json.dumps(out))
@@ -852,20 +1018,27 @@ def main() -> None:
         if family == "core":
             return _family_core_main()
         if family == "trainer":
-            return _family_trainer_main()
+            return _family_solo_main(bench_trainer, "trainer")
+        if family == "transformer":
+            return _family_solo_main(bench_transformer, "transformer")
         raise SystemExit(f"bench: unknown family {family!r}")
 
     # Orchestrator: never imports jax (the tunneled TPU is single-process;
     # holding it here would deadlock the children). Core families first —
-    # they carry the headline metric — then the trainer under its own
-    # compile-hang timeout; a trainer loss costs only null trainer fields.
+    # they carry the headline metric — then each heavy family (largest
+    # compiles) under its own compile-hang timeout; losing one costs only
+    # that family's fields, never the artifact.
     here = os.path.abspath(__file__)
     core_timeout = float(os.environ.get(_CORE_TIMEOUT_ENV, 1800))
-    trainer_timeout = float(os.environ.get(_TRAINER_TIMEOUT_ENV, 900))
+    solo_timeouts = {
+        "transformer": float(os.environ.get(_TRANSFORMER_TIMEOUT_ENV, 900)),
+        "trainer": float(os.environ.get(_TRAINER_TIMEOUT_ENV, 900)),
+    }
 
     line = None
     core_cpu = False
-    core_env = dict(os.environ, **{_SKIP_TRAINER_ENV: "1"})
+    core_env = dict(os.environ, **{_SKIP_TRAINER_ENV: "1",
+                                   _SKIP_TRANSFORMER_ENV: "1"})
     for forced in (False, True):
         env = dict(core_env, **({_FORCE_CPU_ENV: "1"} if forced else {}))
         rc, out, err = _run_watched(
@@ -884,25 +1057,27 @@ def main() -> None:
     if line is None:
         raise SystemExit("bench: core families failed even on CPU fallback")
 
-    trainer_env = dict(os.environ)
+    solo_env = dict(os.environ)
     if core_cpu:
         # the device already proved dead/absent this run — don't let the
-        # trainer child burn its whole timeout re-probing the tunnel
-        trainer_env[_FORCE_CPU_ENV] = "1"
-    # cap the trainer child's probe retries below its own timeout
-    trainer_env.setdefault("MMLSPARK_TPU_BENCH_PROBE_ATTEMPTS", "2")
-    rc, out, err = _run_watched(
-        [sys.executable, here, "--family", "trainer"], trainer_env,
-        trainer_timeout)
-    sys.stderr.write(err[-20000:])
-    trainer = _last_json_line(out) if rc == 0 else None
-    if rc != 0:
-        reason = (f"exceeded {trainer_timeout:.0f}s (compile-hang guard)"
-                  if rc is None else f"rc={rc}")
-        print(f"bench: trainer family {reason}; trainer fields stay null",
-              file=sys.stderr)
-    if trainer is not None:
-        line["extra"].update(_trainer_extra(trainer))
+        # heavy-family children burn their timeouts re-probing the tunnel
+        solo_env[_FORCE_CPU_ENV] = "1"
+    # cap each child's probe retries below its own timeout
+    solo_env.setdefault("MMLSPARK_TPU_BENCH_PROBE_ATTEMPTS", "2")
+    merges = {"transformer": _transformer_extra, "trainer": _trainer_extra}
+    for family, to_extra in merges.items():
+        timeout = solo_timeouts[family]
+        rc, out, err = _run_watched(
+            [sys.executable, here, "--family", family], solo_env, timeout)
+        sys.stderr.write(err[-20000:])
+        result = _last_json_line(out) if rc == 0 else None
+        if rc != 0:
+            reason = (f"exceeded {timeout:.0f}s (compile-hang guard)"
+                      if rc is None else f"rc={rc}")
+            print(f"bench: {family} family {reason}; fields stay null",
+                  file=sys.stderr)
+        if result is not None:
+            line["extra"].update(to_extra(result))
     print(json.dumps(line))
 
 

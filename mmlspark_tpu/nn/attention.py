@@ -1,0 +1,280 @@
+"""Single-device attention implementations: dense, chunked, Pallas flash.
+
+The reference has no sequence-model family at all (SURVEY.md §5.7); this
+module is the single-device half of the beyond-reference attention stack —
+the cross-device half (ring / Ulysses sequence parallelism over the mesh)
+lives in `parallel.ring_attention` and implements identical math.
+
+Three tiers, one contract (inputs (B, T, H, D), output (B, T, H, D)):
+
+- ``dense_attention`` (re-exported from parallel.ring_attention): full
+  (T, T) score matrix. The reference implementation every other tier is
+  tested against; O(T^2) HBM, fine for short sequences.
+- ``chunked_attention``: online-softmax over key/value chunks via
+  `lax.scan` (the Rabe-Staats memory-efficient formulation). O(T) memory,
+  differentiable (XLA derives the backward through the scan), works on
+  every backend — the long-sequence TRAINING path on one device.
+- ``flash_attention``: a Pallas TPU kernel for the forward hot path —
+  the (block_q, block_k) score tile lives only in VMEM, never HBM, with
+  the online-softmax running max / denominator / accumulator carried in
+  VMEM scratch across the sequential key-block grid dimension. Forward
+  only (inference / serving); training uses `chunked_attention`.
+
+The chunked and flash tiers compute scores and the softmax accumulator in
+float32 whatever the input dtype (bf16 inputs stay bf16 through the
+projections; the numerically sensitive reduction is f32 — the standard
+TPU recipe). The dense tier is the unmodified reference math from
+`parallel.ring_attention` and follows the INPUT dtype throughout — with
+bf16 inputs it is the least accurate tier, not the most; prefer chunked
+or flash for bf16 serving.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..parallel.ring_attention import dense_attention
+
+__all__ = ["dense_attention", "chunked_attention", "flash_attention",
+           "SelfAttention"]
+
+_NEG_INF = -1e30  # large-negative instead of -inf: keeps exp/max NaN-free
+
+
+def _pad_seq(x, mult):
+    t = x.shape[1]
+    pad = (-t) % mult
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    return x, t
+
+
+# --------------------------------------------------------------------- #
+# chunked (memory-efficient, differentiable)                            #
+# --------------------------------------------------------------------- #
+
+def chunked_attention(q, k, v, causal: bool = False,
+                      q_chunk: int = 128, k_chunk: int = 128):
+    """Online-softmax attention over k/v chunks; O(T) memory.
+
+    q: (B, Tq, H, D); k, v: (B, Tk, H, D) -> (B, Tq, H, D), matching
+    `dense_attention` (tested bit-close against it). Differentiable —
+    XLA transposes the scan for the backward pass; pair with
+    `jax.checkpoint` on the caller for long sequences.
+    """
+    orig_dtype = q.dtype
+    b, tq_orig, h, d = q.shape
+    tk_orig = k.shape[1]
+    q_chunk = min(q_chunk, max(tq_orig, 1))
+    k_chunk = min(k_chunk, max(tk_orig, 1))
+    q, tq = _pad_seq(q, q_chunk)
+    k, tk = _pad_seq(k, k_chunk)
+    v, _ = _pad_seq(v, k_chunk)
+    nq, nk = q.shape[1] // q_chunk, k.shape[1] // k_chunk
+    scale = d ** -0.5
+
+    # (nq, B, qc, H, D) so scan carries one q-chunk at a time
+    qr = jnp.moveaxis(q.reshape(b, nq, q_chunk, h, d), 1, 0)
+    kr = jnp.moveaxis(k.reshape(b, nk, k_chunk, h, d), 1, 0)
+    vr = jnp.moveaxis(v.reshape(b, nk, k_chunk, h, d), 1, 0)
+
+    kpos = jnp.arange(nk * k_chunk).reshape(nk, k_chunk)
+    k_valid = kpos < tk                                       # pad mask
+
+    def one_q_chunk(qi, qb):
+        qpos = qi * q_chunk + jnp.arange(q_chunk)
+
+        def body(carry, xs):
+            m, l, acc = carry
+            kb, vb, kp, kv_ok = xs
+            s = jnp.einsum("bqhd,bkhd->bhqk", qb, kb,
+                           preferred_element_type=jnp.float32) * scale
+            ok = kv_ok[None, :]
+            if causal:
+                ok = ok & (qpos[:, None] >= kp[None, :])
+            s = jnp.where(ok[None, None], s, _NEG_INF)
+            m_new = jnp.maximum(m, s.max(-1))
+            p = jnp.exp(s - m_new[..., None])
+            # masked entries contribute 0 even when the whole row is
+            # masked (then m_new == _NEG_INF and exp(s - m_new) == 1)
+            p = jnp.where(ok[None, None], p, 0.0)
+            corr = jnp.exp(m - m_new)
+            l = l * corr + p.sum(-1)
+            acc = acc * corr[..., None] + jnp.einsum(
+                "bhqk,bkhd->bhqd", p, vb.astype(jnp.float32),
+                preferred_element_type=jnp.float32)
+            return (m_new, l, acc), None
+
+        m0 = jnp.full((b, h, q_chunk), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((b, h, q_chunk), jnp.float32)
+        a0 = jnp.zeros((b, h, q_chunk, d), jnp.float32)
+        (m, l, acc), _ = jax.lax.scan(body, (m0, l0, a0),
+                                      (kr, vr, kpos, k_valid))
+        out = acc / jnp.maximum(l, 1e-30)[..., None]
+        # rows with no visible key (all masked) -> zeros, as dense does
+        out = jnp.where((l > 0)[..., None], out, 0.0)
+        return jnp.moveaxis(out, 1, 2)                        # (B, qc, H, D)
+
+    outs = jax.lax.map(lambda xs: one_q_chunk(*xs),
+                       (jnp.arange(nq), qr))                  # (nq,B,qc,H,D)
+    out = jnp.moveaxis(outs, 0, 1).reshape(b, nq * q_chunk, h, d)
+    return out[:, :tq].astype(orig_dtype)
+
+
+# --------------------------------------------------------------------- #
+# Pallas flash forward                                                  #
+# --------------------------------------------------------------------- #
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_sc, l_sc, acc_sc, *,
+                  block_q, block_k, num_kv, causal, tk_valid, scale):
+    import jax.experimental.pallas as pl
+
+    qi = pl.program_id(1)
+    kv = pl.program_id(2)
+
+    @pl.when(kv == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[...] = jnp.zeros_like(l_sc)
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+
+    qb = q_ref[0]                                             # (bq, D)
+    kb = k_ref[0]                                             # (bk, D)
+    s = jax.lax.dot_general(
+        qb, kb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale           # (bq, bk)
+
+    kpos = kv * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    ok = kpos < tk_valid
+    if causal:
+        qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        ok = ok & (qpos >= kpos)
+    s = jnp.where(ok, s, _NEG_INF)
+
+    m_prev = m_sc[...]                                        # (bq, 1)
+    m_new = jnp.maximum(m_prev, s.max(-1, keepdims=True))
+    p = jnp.exp(s - m_new)                                    # (bq, bk)
+    # masked entries must contribute 0 even when the whole row is masked
+    # (then m_new == _NEG_INF and exp(s - m_new) == 1, not 0)
+    p = jnp.where(ok, p, 0.0)
+    corr = jnp.exp(m_prev - m_new)                            # (bq, 1)
+    l_sc[...] = l_sc[...] * corr + p.sum(-1, keepdims=True)
+    pv = jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)                   # (bq, D)
+    acc_sc[...] = acc_sc[...] * corr + pv
+    m_sc[...] = m_new
+
+    @pl.when(kv == num_kv - 1)
+    def _finalize():
+        l = l_sc[...]
+        out = acc_sc[...] / jnp.maximum(l, 1e-30)
+        out = jnp.where(l > 0, out, 0.0)
+        o_ref[0] = out.astype(o_ref.dtype)
+
+
+def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
+                    block_k: int = 128, interpret: bool = False):
+    """Pallas TPU flash-attention FORWARD. Same contract as
+    `dense_attention`; not differentiable — use `chunked_attention` for
+    training. `interpret=True` runs the kernel on CPU for tests."""
+    import jax.experimental.pallas as pl
+    import jax.experimental.pallas.tpu as pltpu
+
+    orig_dtype = q.dtype
+    b, tq_orig, h, d = q.shape
+    tk_orig = k.shape[1]
+    block_q = min(block_q, max(tq_orig, 1))
+    block_k = min(block_k, max(tk_orig, 1))
+    q, tq = _pad_seq(q, block_q)
+    k, tk = _pad_seq(k, block_k)
+    v, _ = _pad_seq(v, block_k)
+
+    # (B*H, T, D): one grid row per (batch, head)
+    def bh(x):
+        return jnp.moveaxis(x, 2, 1).reshape(b * h, x.shape[1], d)
+
+    qf, kf, vf = bh(q), bh(k), bh(v)
+    nq, nk = qf.shape[1] // block_q, kf.shape[1] // block_k
+
+    kernel = functools.partial(
+        _flash_kernel, block_q=block_q, block_k=block_k, num_kv=nk,
+        causal=causal, tk_valid=tk, scale=d ** -0.5)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh_, qi, kv: (bh_, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, qi, kv: (bh_, kv, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh_, qi, kv: (bh_, kv, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda bh_, qi, kv: (bh_, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(qf.shape, orig_dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+
+    out = out.reshape(b, h, out.shape[1], d)   # already orig_dtype via
+    return jnp.moveaxis(out, 1, 2)[:, :tq]     # pallas out_shape
+
+
+# --------------------------------------------------------------------- #
+# param-compatible self-attention module                                #
+# --------------------------------------------------------------------- #
+
+class SelfAttention(nn.Module):
+    """Multi-head self-attention with a selectable attention core.
+
+    Parameter tree is IDENTICAL to flax's nn.MultiHeadDotProductAttention
+    (submodules query/key/value/out with the same DenseGeneral layouts) so
+    checkpoints, the serialize registry, and the HF import spec
+    (import_weights.TRANSFORMER_SPEC -> params/attn_i/query/kernel ...)
+    are impl-agnostic.
+
+    impl: "dense" (reference math), "chunked" (O(T) scan, differentiable),
+    "flash" (Pallas forward kernel on TPU; off-TPU it transparently uses
+    the chunked tier so the same model file runs everywhere).
+    """
+
+    num_heads: int
+    dtype: Any = jnp.float32
+    impl: str = "dense"
+    causal: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        d_model = x.shape[-1]
+        if d_model % self.num_heads:
+            raise ValueError(f"d_model={d_model} not divisible by "
+                             f"num_heads={self.num_heads}")
+        head_dim = d_model // self.num_heads
+        proj = functools.partial(
+            nn.DenseGeneral, features=(self.num_heads, head_dim),
+            dtype=self.dtype)
+        q = proj(name="query")(x)
+        k = proj(name="key")(x)
+        v = proj(name="value")(x)
+
+        impl = self.impl
+        if impl == "flash" and jax.default_backend() != "tpu":
+            impl = "chunked"
+        if impl == "dense":
+            out = dense_attention(q, k, v, causal=self.causal)
+        elif impl == "chunked":
+            out = chunked_attention(q, k, v, causal=self.causal)
+        elif impl == "flash":
+            out = flash_attention(q, k, v, causal=self.causal)
+        else:
+            raise ValueError(f"unknown attention impl {self.impl!r}")
+        return nn.DenseGeneral(features=d_model, axis=(-2, -1),
+                               dtype=self.dtype, name="out")(out)

@@ -181,6 +181,12 @@ class TransformerEncoder(nn.Module):
     vocab_size: int = 0             # >0: int token inputs, embed; 0: project
     max_len: int = 512
     dropout_rate: float = 0.0
+    # attention core (nn/attention.py): "dense" (reference math),
+    # "chunked" (O(T) online-softmax scan — long-sequence training),
+    # "flash" (Pallas TPU forward kernel; falls back to chunked off-TPU).
+    # Param trees are identical across impls, so a model trained with one
+    # loads and serves with any other.
+    attention_impl: str = "dense"
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -206,13 +212,26 @@ class TransformerEncoder(nn.Module):
             (self.max_len, self.d_model), jnp.float32,
         )
         h = h + pos[: h.shape[1]][None, :, :].astype(self.dtype)
+        if self.attention_impl != "dense" and self.dropout_rate > 0:
+            raise ValueError(
+                "attention dropout is only implemented for the dense core; "
+                f"got attention_impl={self.attention_impl!r} with "
+                f"dropout_rate={self.dropout_rate}")
         for i in range(self.num_layers):
             y = nn.LayerNorm(dtype=self.dtype, name=f"ln_attn_{i}")(h)
-            y = nn.MultiHeadDotProductAttention(
-                num_heads=self.num_heads, dtype=self.dtype,
-                dropout_rate=self.dropout_rate, deterministic=not train,
-                name=f"attn_{i}",
-            )(y)
+            if self.attention_impl == "dense":
+                y = nn.MultiHeadDotProductAttention(
+                    num_heads=self.num_heads, dtype=self.dtype,
+                    dropout_rate=self.dropout_rate, deterministic=not train,
+                    name=f"attn_{i}",
+                )(y)
+            else:
+                from .attention import SelfAttention
+
+                y = SelfAttention(
+                    num_heads=self.num_heads, dtype=self.dtype,
+                    impl=self.attention_impl, name=f"attn_{i}",
+                )(y, train=train)
             h = h + y
             y = nn.LayerNorm(dtype=self.dtype, name=f"ln_mlp_{i}")(h)
             y = nn.Dense(self.d_ff, dtype=self.dtype, name=f"mlp_up_{i}")(y)

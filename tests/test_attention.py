@@ -1,0 +1,138 @@
+"""Attention stack: dense vs chunked vs Pallas flash (interpret mode).
+
+The reference has no sequence-model family (SURVEY.md §5.7); these gates
+pin the beyond-reference single-device attention tiers against each other
+— the same strategy as the ring/Ulysses tests (test_parallel.py), which
+pin the cross-device tiers against `dense_attention` too.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mmlspark_tpu.nn.attention import (
+    SelfAttention,
+    chunked_attention,
+    dense_attention,
+    flash_attention,
+)
+from mmlspark_tpu.nn.models import make_model
+
+SHAPES = [
+    # (B, Tq, Tk, H, D, causal, chunk)
+    (2, 64, 64, 4, 32, False, 16),
+    (1, 50, 50, 2, 16, True, 16),     # ragged: seq not a chunk multiple
+    (2, 128, 128, 4, 64, True, 128),  # single chunk == full dense
+    (1, 7, 7, 1, 8, False, 16),       # seq smaller than the chunk
+    (1, 24, 40, 2, 16, False, 16),    # cross-attention Tq != Tk
+    (1, 40, 24, 2, 16, True, 16),     # causal with fully-masked... no row
+]
+
+
+def _qkv(b, tq, tk, h, d, seed=0):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(b, tq, h, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, tk, h, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, tk, h, d)), jnp.float32)
+    return q, k, v
+
+
+class TestParity:
+    @pytest.mark.parametrize("b,tq,tk,h,d,causal,chunk", SHAPES)
+    def test_chunked_matches_dense(self, b, tq, tk, h, d, causal, chunk):
+        q, k, v = _qkv(b, tq, tk, h, d)
+        ref = dense_attention(q, k, v, causal=causal)
+        got = chunked_attention(q, k, v, causal=causal,
+                                q_chunk=chunk, k_chunk=chunk)
+        np.testing.assert_allclose(got, ref, atol=2e-5, rtol=1e-5)
+
+    @pytest.mark.parametrize("b,tq,tk,h,d,causal,chunk", SHAPES)
+    def test_flash_matches_dense(self, b, tq, tk, h, d, causal, chunk):
+        q, k, v = _qkv(b, tq, tk, h, d)
+        ref = dense_attention(q, k, v, causal=causal)
+        got = flash_attention(q, k, v, causal=causal, block_q=chunk,
+                              block_k=chunk, interpret=True)
+        np.testing.assert_allclose(got, ref, atol=2e-5, rtol=1e-5)
+
+    def test_chunked_grad_matches_dense(self):
+        q, k, v = _qkv(1, 48, 48, 2, 16, seed=3)
+
+        def loss(fn):
+            return lambda q, k, v: (fn(q, k, v) ** 2).sum()
+
+        gd = jax.grad(loss(lambda q, k, v: dense_attention(
+            q, k, v, causal=True)), argnums=(0, 1, 2))(q, k, v)
+        gc = jax.grad(loss(lambda q, k, v: chunked_attention(
+            q, k, v, causal=True, q_chunk=16, k_chunk=16)),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(gd, gc):
+            np.testing.assert_allclose(a, b_, atol=5e-5, rtol=1e-4)
+
+    def test_bf16_inputs_keep_dtype_and_agree(self):
+        q, k, v = _qkv(2, 32, 32, 2, 16, seed=4)
+        qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+        ref = dense_attention(q, k, v)
+        for fn in (
+            lambda: chunked_attention(qb, kb, vb, q_chunk=16, k_chunk=16),
+            lambda: flash_attention(qb, kb, vb, block_q=16, block_k=16,
+                                    interpret=True),
+        ):
+            got = fn()
+            assert got.dtype == jnp.bfloat16
+            np.testing.assert_allclose(
+                got.astype(jnp.float32), ref, atol=3e-2, rtol=3e-2)
+
+    def test_fully_masked_rows_are_zero(self):
+        # causal cross-attention where late keys start beyond every query
+        # never happens in self-attention; force it with Tk > Tq and an
+        # all-masked construction instead: query block sees no key when
+        # causal and the key positions all exceed the query positions.
+        q, k, v = _qkv(1, 4, 8, 1, 8, seed=5)
+        # dense reference defines masked-row output as exactly zero
+        ref = dense_attention(q, k, v, causal=True)
+        ch = chunked_attention(q, k, v, causal=True, q_chunk=4, k_chunk=4)
+        fl = flash_attention(q, k, v, causal=True, block_q=4, block_k=4,
+                             interpret=True)
+        np.testing.assert_allclose(ch, ref, atol=2e-5)
+        np.testing.assert_allclose(fl, ref, atol=2e-5)
+
+
+class TestSelfAttentionModule:
+    KW = dict(num_layers=2, d_model=32, num_heads=4, d_ff=64,
+              vocab_size=50, num_outputs=3)
+
+    def test_param_tree_identical_across_impls(self):
+        x = jnp.asarray(np.arange(20).reshape(2, 10) % 50)
+        base = make_model("transformer", **self.KW)
+        v0 = base.init(jax.random.PRNGKey(0), x)
+        for impl in ("chunked", "flash"):
+            m = make_model("transformer", attention_impl=impl, **self.KW)
+            v1 = m.init(jax.random.PRNGKey(0), x)
+            assert (jax.tree_util.tree_structure(v0)
+                    == jax.tree_util.tree_structure(v1))
+            assert (jax.tree.map(lambda a: a.shape, v0)
+                    == jax.tree.map(lambda a: a.shape, v1))
+
+    def test_encoder_outputs_agree_across_impls(self):
+        x = jnp.asarray(np.arange(30).reshape(3, 10) % 50)
+        base = make_model("transformer", **self.KW)
+        v0 = base.init(jax.random.PRNGKey(0), x)
+        ref = base.apply(v0, x)
+        for impl in ("chunked", "flash"):  # flash falls back off-TPU
+            m = make_model("transformer", attention_impl=impl, **self.KW)
+            out = m.apply(v0, x)           # same params on purpose
+            np.testing.assert_allclose(out, ref, atol=5e-5, rtol=1e-4)
+
+    def test_dropout_rejected_off_dense(self):
+        m = make_model("transformer", attention_impl="chunked",
+                       dropout_rate=0.1, **self.KW)
+        x = jnp.asarray(np.zeros((1, 4), np.int32))
+        with pytest.raises(ValueError, match="dropout"):
+            m.init(jax.random.PRNGKey(0), x)
+
+    def test_unknown_impl_rejected(self):
+        mod = SelfAttention(num_heads=2, impl="nope")
+        x = jnp.zeros((1, 4, 8), jnp.float32)
+        with pytest.raises(ValueError, match="unknown attention impl"):
+            mod.init(jax.random.PRNGKey(0), x)

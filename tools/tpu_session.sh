@@ -47,8 +47,9 @@ fi
 
 echo "== bench =="
 # worst case inside the orchestrator: device core attempt (1800s) + CPU
-# core retry (1800s) + trainer child (900s) — the outer guard must cover it
-if timeout 4800 python bench.py > "$OUT/bench.json" 2> "$OUT/bench.err"; then
+# core retry (1800s) + transformer child (900s) + trainer child (900s) —
+# the outer guard must cover it
+if timeout 5700 python bench.py > "$OUT/bench.json" 2> "$OUT/bench.err"; then
   tail -1 "$OUT/bench.json"
 else
   echo "BENCH FAILED (rc=$?) — tail of $OUT/bench.err:"; tail -5 "$OUT/bench.err"
