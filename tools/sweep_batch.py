@@ -27,7 +27,7 @@ def sweep_runner(batches, peak_tflops):
     import jax
     import jax.numpy as jnp
 
-    from bench import flops_of
+    from bench import flops_of, flops_sane, median_timed
     from mmlspark_tpu.nn.models import ModelBundle
 
     bundle = ModelBundle.init("resnet20_cifar", input_shape=(32, 32, 3), seed=0)
@@ -49,11 +49,14 @@ def sweep_runner(batches, peak_tflops):
         images = rng.integers(0, 256, size=(n, 32, 32, 3), dtype=np.uint8)
         xd = jax.device_put(images)
         jax.block_until_ready(fwd(bf16_vars, xd[:bs]))
-        t0 = time.perf_counter()
-        outs = [fwd(bf16_vars, xd[i:i + bs]) for i in range(0, n, bs)]
-        jax.block_until_ready(outs[-1])
-        ips = n / (time.perf_counter() - t0)
-        per_img = (flops_of(fwd, bf16_vars, xd[:bs]) or 8.2e7 * bs) / bs
+
+        def one_pass():
+            outs = [fwd(bf16_vars, xd[i:i + bs]) for i in range(0, n, bs)]
+            jax.block_until_ready(outs[-1])
+
+        ips = n / median_timed(one_pass)
+        fl = flops_of(fwd, bf16_vars, xd[:bs])
+        per_img = flops_sane(fl / bs if fl else None, 8.2e7, "runner fwd")
         tflops = ips * per_img / 1e12
         mfu = tflops / peak_tflops if peak_tflops else float("nan")
         rows.append(("runner_fwd_bf16", bs, ips, tflops, mfu))
@@ -75,7 +78,7 @@ def sweep_trainer(batches, peak_tflops, side=224, scan_steps=8):
     import jax.numpy as jnp
     import optax
 
-    from bench import flops_of
+    from bench import flops_of, flops_sane
     from mmlspark_tpu.nn.models import make_model
 
     module = make_model("resnet50", num_outputs=10, dtype=jnp.bfloat16)
@@ -105,8 +108,9 @@ def sweep_trainer(batches, peak_tflops, side=224, scan_steps=8):
             updates, opt_state = tx.update(grads, opt_state, params)
             return optax.apply_updates(params, updates), bst, opt_state, loss
 
-        per_img = (flops_of(jax.jit(step), params, batch_stats, opt_state)
-                   or 3 * 4.1e9 * (side / 224) ** 2 * bs) / bs
+        fl = flops_of(jax.jit(step), params, batch_stats, opt_state)
+        per_img = flops_sane(fl / bs if fl else None,
+                             3 * 4.1e9 * (side / 224) ** 2, "trainer step")
 
         def scan_steps_fn(params, batch_stats, opt_state):
             def body(carry, _):
@@ -158,9 +162,7 @@ def main():
 
     import jax
 
-    from bench import chip_peaks
-
-    from bench import pin_cpu_if_requested
+    from bench import chip_peaks, pin_cpu_if_requested
 
     pin_cpu_if_requested()
 
