@@ -56,13 +56,57 @@ def dense_attention(q, k, v, causal: bool = False,
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
-def _ring_attention_sharded(q, k, v, axis_name: str, causal: bool):
-    """Per-shard body. q/k/v: (B, T_local, H, D), sharded on T."""
+def _online_update(o, l, m, q, k_blk, v_blk, qpos, kpos, causal, scale):
+    """One online-softmax accumulation of a K/V block into (o, l, m).
+    Shared by the per-hop update and the within-hop chunk scan."""
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k_blk,
+        preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = qpos[:, None] >= kpos[None, :]
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    blk_max = scores.max(axis=-1)                           # (B, H, Tq)
+    m_new = jnp.maximum(m, blk_max)
+    # guard: fully-masked block keeps m_new=-inf; exp(-inf - -inf) trap
+    safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    corr = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+    p = jnp.exp(jnp.where(jnp.isfinite(scores),
+                          scores - safe_m[..., None], -jnp.inf))
+    p = jnp.where(jnp.isfinite(scores), p, 0.0)
+    l_new = l * corr + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_blk,
+                    preferred_element_type=jnp.float32)
+    o_new = o * corr.transpose(0, 2, 1)[..., None] + pv
+    return o_new, l_new, m_new
+
+
+def _ring_attention_sharded(q, k, v, axis_name: str, causal: bool,
+                            local_chunk: "int | None" = None):
+    """Per-shard body. q/k/v: (B, T_local, H, D), sharded on T.
+
+    local_chunk bounds the materialized score tile: each hop's K/V block
+    is folded in (T_local/local_chunk) chunks under the SAME online-
+    softmax state, so per-hop scores shrink from (B, H, T_local, T_local)
+    to (B, H, T_local, local_chunk) — the single-device chunked tier
+    (nn/attention.py) composed inside the ring hop. None keeps the
+    one-block-per-hop update."""
     b, t_local, h, d = q.shape
     scale = d ** -0.5
     n_dev = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     q_off = my * t_local
+    qpos = jnp.arange(t_local) + q_off
+
+    if local_chunk is not None and local_chunk < 1:
+        raise ValueError(f"local_chunk={local_chunk} must be >= 1")
+    if local_chunk and local_chunk < t_local:
+        if t_local % local_chunk:
+            raise ValueError(
+                f"local_chunk={local_chunk} must divide the per-device "
+                f"sequence length {t_local}")
+        n_chunks = t_local // local_chunk
+    else:
+        n_chunks = 1
 
     # online-softmax state; derived from q (+0*…) so the scan carry gets
     # the same varying-over-seq-axis type as the rotating kv blocks
@@ -75,31 +119,31 @@ def _ring_attention_sharded(q, k, v, axis_name: str, causal: bool):
         o, l, m, k_blk, v_blk = carry
         src = (my - s) % n_dev          # origin device of the current block
         k_off = src * t_local
-        scores = jnp.einsum(
-            "bqhd,bkhd->bhqk", q, k_blk,
-            preferred_element_type=jnp.float32) * scale
-        if causal:
-            qpos = jnp.arange(t_local) + q_off
+        if n_chunks == 1:
             kpos = jnp.arange(t_local) + k_off
-            mask = qpos[:, None] >= kpos[None, :]
-            scores = jnp.where(mask[None, None], scores, -jnp.inf)
-        blk_max = scores.max(axis=-1)                       # (B, H, Tq)
-        m_new = jnp.maximum(m, blk_max)
-        # guard: fully-masked block keeps m_new=-inf; exp(-inf - -inf) trap
-        safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
-        p = jnp.exp(jnp.where(jnp.isfinite(scores),
-                              scores - safe_m[..., None], -jnp.inf))
-        p = jnp.where(jnp.isfinite(scores), p, 0.0)
-        l_new = l * corr + p.sum(axis=-1)
-        pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_blk,
-                        preferred_element_type=jnp.float32)
-        o_new = o * corr.transpose(0, 2, 1)[..., None] + pv
+            o, l, m = _online_update(o, l, m, q, k_blk, v_blk, qpos, kpos,
+                                     causal, scale)
+        else:
+            c = local_chunk
+            kc = jnp.moveaxis(
+                k_blk.reshape(b, n_chunks, c, h, d), 1, 0)
+            vc = jnp.moveaxis(
+                v_blk.reshape(b, n_chunks, c, h, d), 1, 0)
+
+            def chunk_body(carry2, xs):
+                o2, l2, m2 = carry2
+                k_c, v_c, ci = xs
+                kpos = k_off + ci * c + jnp.arange(c)
+                return _online_update(o2, l2, m2, q, k_c, v_c, qpos, kpos,
+                                      causal, scale), None
+
+            (o, l, m), _ = lax.scan(
+                chunk_body, (o, l, m), (kc, vc, jnp.arange(n_chunks)))
         # rotate kv one hop for the next step (overlaps with next compute)
         perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
         k_next = lax.ppermute(k_blk, axis_name, perm)
         v_next = lax.ppermute(v_blk, axis_name, perm)
-        return (o_new, l_new, m_new, k_next, v_next), None
+        return (o, l, m, k_next, v_next), None
 
     (o, l, m, _, _), _ = lax.scan(
         step, (o, l, m, k.astype(jnp.float32), v.astype(jnp.float32)),
@@ -109,12 +153,15 @@ def _ring_attention_sharded(q, k, v, axis_name: str, causal: bool):
     return (o / denom).astype(q.dtype)
 
 
-def make_ring_attention(mesh: Mesh, seq_axis: str, causal: bool = False):
+def make_ring_attention(mesh: Mesh, seq_axis: str, causal: bool = False,
+                        local_chunk: "int | None" = None):
     """Jitted ring attention over `seq_axis` of `mesh`.
-    Inputs (B, T, H, D) with T sharded over seq_axis."""
+    Inputs (B, T, H, D) with T sharded over seq_axis. `local_chunk`
+    bounds the per-hop score tile (see _ring_attention_sharded) for
+    long-context training where T/n_dev is itself large."""
     fn = shard_map(
         functools.partial(_ring_attention_sharded, axis_name=seq_axis,
-                          causal=causal),
+                          causal=causal, local_chunk=local_chunk),
         mesh=mesh,
         in_specs=(P(None, seq_axis), P(None, seq_axis), P(None, seq_axis)),
         out_specs=P(None, seq_axis),
@@ -122,8 +169,9 @@ def make_ring_attention(mesh: Mesh, seq_axis: str, causal: bool = False):
     return jax.jit(fn)
 
 
-def ring_attention(q, k, v, mesh: Mesh, seq_axis: str, causal: bool = False):
-    return make_ring_attention(mesh, seq_axis, causal)(q, k, v)
+def ring_attention(q, k, v, mesh: Mesh, seq_axis: str, causal: bool = False,
+                   local_chunk: "int | None" = None):
+    return make_ring_attention(mesh, seq_axis, causal, local_chunk)(q, k, v)
 
 
 def _ulysses_sharded(q, k, v, axis_name: str, causal: bool):
