@@ -128,10 +128,10 @@ def _hist_kernel(num_features, num_bins, chunk, bins_ref, stats_ref, out_ref):
 
 
 def _hist_kernel_fused(num_features, num_bins, chunk, bins_ref, stats_ref, out_ref):
-    """Fused variant: ONE (chunk, F·B) one-hot mask in VMEM (bfloat16 — the
-    0/1 values are exact) and ONE dot per grid step, instead of F small dots.
-    Small matmuls leave the MXU idle between issues; the fused dot amortizes
-    that fixed cost over the whole F·B lane axis."""
+    """Fused variant: ONE (chunk, F·B) one-hot mask in VMEM and ONE dot per
+    grid step, instead of F small dots. Small matmuls leave the MXU idle
+    between issues; the fused dot amortizes that fixed cost over the whole
+    F·B lane axis."""
     import jax.experimental.pallas as pl
 
     i = pl.program_id(0)
@@ -145,7 +145,10 @@ def _hist_kernel_fused(num_features, num_bins, chunk, bins_ref, stats_ref, out_r
     iota = jax.lax.broadcasted_iota(
         jnp.int32, (chunk, num_features, num_bins), 2
     )
-    mask = (col[:, :, None] == iota).astype(jnp.bfloat16)
+    # f32, not bf16: Mosaic rejects mixed f32×bf16 tpu.matmul operands on
+    # real hardware ("Bad rhs type", observed v5e), and the 0/1 mask is
+    # exact in either dtype — only the VMEM budget changes (_fused_chunk).
+    mask = (col[:, :, None] == iota).astype(jnp.float32)
     mask = mask.reshape(chunk, num_features * num_bins)         # VMEM-only
     h = jax.lax.dot_general(
         stats, mask, (((0,), (0,)), ((), ())),
@@ -155,16 +158,26 @@ def _hist_kernel_fused(num_features, num_bins, chunk, bins_ref, stats_ref, out_r
     out_ref[:] += h
 
 
-# Budget for the fused kernel's VMEM-resident mask (chunk × F·B bf16). VMEM
+# Budget for the fused kernel's VMEM-resident mask (chunk × F·B f32). VMEM
 # is ~16 MB less double-buffered inputs/outputs; 4 MB leaves ample room.
 _FUSED_MASK_VMEM_BYTES = 4 * 2**20
 
 
 def _fused_chunk(f: int, num_bins: int) -> int:
     """Largest power-of-two chunk whose mask fits the VMEM budget."""
-    limit = _FUSED_MASK_VMEM_BYTES // (f * num_bins * 2)
+    limit = _FUSED_MASK_VMEM_BYTES // (f * num_bins * 4)
     chunk = 1 << max(int(limit).bit_length() - 1, 0)
     return min(chunk, 2048)
+
+
+def _fused_enabled() -> bool:
+    """The fused variant is opt-in (MMLSPARK_TPU_FUSED_HIST=1) until a chip
+    sweep proves it beats the per-feature kernel: the measured v5e session
+    (tpu_session_out/sweep.txt, round 4) had per-feature chunk=1024 as the
+    fastest compiling variant, so that is the default the bench rides."""
+    import os
+
+    return os.environ.get("MMLSPARK_TPU_FUSED_HIST", "0") == "1"
 
 
 def _histogram_pallas(bins, stats, num_bins, interpret):
@@ -174,7 +187,8 @@ def _histogram_pallas(bins, stats, num_bins, interpret):
     c = stats.shape[1]
     # fused needs the lane axis (F·B) 128-aligned and a sublane-aligned chunk
     fused_chunk = _fused_chunk(f, num_bins)
-    use_fused = (f * num_bins) % 128 == 0 and fused_chunk >= 32
+    use_fused = (_fused_enabled()
+                 and (f * num_bins) % 128 == 0 and fused_chunk >= 32)
     # rows pad up to a whole chunk (zero stats land in bin 0 with weight 0),
     # so tiny n still runs the tile-aligned chunk shape
     chunk = fused_chunk if use_fused else min(_PALLAS_CHUNK, max(n, 8))

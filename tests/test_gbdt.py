@@ -701,12 +701,13 @@ class TestHistKernel:
         np.testing.assert_array_equal(
             hs, np.asarray(histogram_xla_scatter(b8, stats, b)))
 
-    def test_fused_variant_agrees(self):
-        # F*B 128-aligned -> the FUSED single-dot pallas kernel (the variant
-        # auto-selected on the real TPU workload, F=14 B=256) must be the one
-        # under test, not the per-feature fallback
+    def test_fused_variant_agrees(self, monkeypatch):
+        # F*B 128-aligned AND the opt-in env set -> the FUSED single-dot
+        # pallas kernel must be the one under test, not the per-feature
+        # fallback (fused is opt-in until a chip sweep proves it faster)
         from mmlspark_tpu.gbdt import hist_kernel as hk
 
+        monkeypatch.setenv("MMLSPARK_TPU_FUSED_HIST", "1")
         rng = np.random.default_rng(1)
         n, f, b, c = 700, 4, 32, 3            # F*B = 128
         assert (f * b) % 128 == 0 and hk._fused_chunk(f, b) >= 32

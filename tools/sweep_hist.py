@@ -82,11 +82,30 @@ def v_current_pallas(chunk, allow_fused=False):
     return fn
 
 
+def _force_fused():
+    """Temporarily set the fused opt-in env var, restoring any prior value."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def cm():
+        old = os.environ.get("MMLSPARK_TPU_FUSED_HIST")
+        os.environ["MMLSPARK_TPU_FUSED_HIST"] = "1"
+        try:
+            yield
+        finally:
+            if old is None:
+                os.environ.pop("MMLSPARK_TPU_FUSED_HIST", None)
+            else:
+                os.environ["MMLSPARK_TPU_FUSED_HIST"] = old
+    return cm()
+
+
 def v_fused_auto():
     from mmlspark_tpu.gbdt import hist_kernel as hk
 
     def fn(bins, stats, num_bins):
-        return hk._histogram_pallas(bins, stats, num_bins, interpret=False)
+        with _force_fused():
+            return hk._histogram_pallas(bins, stats, num_bins, interpret=False)
     return fn
 
 
@@ -97,7 +116,9 @@ def v_fused_budget(budget_mb):
         old = hk._FUSED_MASK_VMEM_BYTES
         hk._FUSED_MASK_VMEM_BYTES = budget_mb * 2**20
         try:
-            return hk._histogram_pallas(bins, stats, num_bins, interpret=False)
+            with _force_fused():
+                return hk._histogram_pallas(bins, stats, num_bins,
+                                            interpret=False)
         finally:
             hk._FUSED_MASK_VMEM_BYTES = old
     return fn
@@ -119,6 +140,18 @@ def v_materialized_oh(bins, stats, num_bins):
     return h.reshape(stats.shape[1], f, num_bins).transpose(1, 2, 0)
 
 
+def _chunk_of(budget_mb: int) -> int:
+    """The fused chunk a given VMEM budget yields at the sweep shape."""
+    from mmlspark_tpu.gbdt import hist_kernel as hk
+
+    old = hk._FUSED_MASK_VMEM_BYTES
+    hk._FUSED_MASK_VMEM_BYTES = budget_mb * 2**20
+    try:
+        return hk._fused_chunk(F, B)
+    finally:
+        hk._FUSED_MASK_VMEM_BYTES = old
+
+
 def main():
     from bench import pin_cpu_if_requested
 
@@ -137,9 +170,9 @@ def main():
          lambda b, s, nb: histogram_xla(b, s, nb), bins),
         ("pallas per-feature chunk=1024", v_current_pallas(1024), bins),
         ("pallas per-feature chunk=2048", v_current_pallas(2048), bins),
-        ("pallas fused auto (4MB->512)", v_fused_auto(), bins),
-        ("pallas fused budget 2MB (256)", v_fused_budget(2), bins),
-        ("pallas fused budget 8MB (1024)", v_fused_budget(8), bins),
+        (f"pallas fused auto (4MB->{_chunk_of(4)})", v_fused_auto(), bins),
+        (f"pallas fused budget 2MB ({_chunk_of(2)})", v_fused_budget(2), bins),
+        (f"pallas fused budget 8MB ({_chunk_of(8)})", v_fused_budget(8), bins),
         ("materialized one-hot bf16 dot", v_materialized_oh, bins),
         ("xla one-hot scan (uint8 bins)",
          lambda b, s, nb: histogram_xla(b, s, nb), bins_u8),
