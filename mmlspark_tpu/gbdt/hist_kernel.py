@@ -173,7 +173,7 @@ def _fused_chunk(f: int, num_bins: int) -> int:
 def _fused_enabled() -> bool:
     """The fused variant is opt-in (MMLSPARK_TPU_FUSED_HIST=1) until a chip
     sweep proves it beats the per-feature kernel: the measured v5e session
-    (tpu_session_out/sweep.txt, round 4) had per-feature chunk=1024 as the
+    (sweeps/r4_window1/sweep.txt) had per-feature chunk=1024 as the
     fastest compiling variant, so that is the default the bench rides."""
     import os
 

@@ -11,6 +11,7 @@ Usage: python tools/sweep_hist.py            # real device
 
 from __future__ import annotations
 
+import contextlib
 import os
 import sys
 import time
@@ -65,39 +66,33 @@ def run(name, hist_fn, bins, stats):
 
 # ---------------------------------------------------------------- variants --
 
-def v_current_pallas(chunk, allow_fused=False):
+def v_current_pallas(chunk):
     from mmlspark_tpu.gbdt import hist_kernel as hk
 
     def fn(bins, stats, num_bins):
         old = hk._PALLAS_CHUNK
-        old_budget = hk._FUSED_MASK_VMEM_BYTES
         hk._PALLAS_CHUNK = chunk
-        if not allow_fused:
-            hk._FUSED_MASK_VMEM_BYTES = 0
         try:
+            # fused is opt-in via MMLSPARK_TPU_FUSED_HIST (unset here), so
+            # this times the per-feature kernel at the given chunk
             return hk._histogram_pallas(bins, stats, num_bins, interpret=False)
         finally:
             hk._PALLAS_CHUNK = old
-            hk._FUSED_MASK_VMEM_BYTES = old_budget
     return fn
 
 
+@contextlib.contextmanager
 def _force_fused():
     """Temporarily set the fused opt-in env var, restoring any prior value."""
-    import contextlib
-
-    @contextlib.contextmanager
-    def cm():
-        old = os.environ.get("MMLSPARK_TPU_FUSED_HIST")
-        os.environ["MMLSPARK_TPU_FUSED_HIST"] = "1"
-        try:
-            yield
-        finally:
-            if old is None:
-                os.environ.pop("MMLSPARK_TPU_FUSED_HIST", None)
-            else:
-                os.environ["MMLSPARK_TPU_FUSED_HIST"] = old
-    return cm()
+    old = os.environ.get("MMLSPARK_TPU_FUSED_HIST")
+    os.environ["MMLSPARK_TPU_FUSED_HIST"] = "1"
+    try:
+        yield
+    finally:
+        if old is None:
+            os.environ.pop("MMLSPARK_TPU_FUSED_HIST", None)
+        else:
+            os.environ["MMLSPARK_TPU_FUSED_HIST"] = old
 
 
 def v_fused_auto():

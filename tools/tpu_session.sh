@@ -2,12 +2,20 @@
 # One-shot TPU measurement session: run everything that needs the real chip
 # while a tunnel window is open. Outputs land in tpu_session_out/.
 #
-#   tools/tpu_session.sh           # probe, then sweep + bench
+# ORDER MATTERS: observed windows last ~30 min (2026-07-30 ~22:45 and
+# 2026-07-31 03:46 sessions both lost the tunnel ~30 min in). The bench —
+# the artifact the round is judged on — runs FIRST; sweeps and diagnostics
+# use whatever window remains.
+#
+#   tools/tpu_session.sh           # probe, then bench + sweeps
 set -uo pipefail
 cd "$(dirname "$0")/.."
 # scripts under tools/ put tools/ at sys.path[0]; the package lives at root
 export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+# fresh $OUT per session: stale files from an earlier window must never be
+# archived under (and misattributed to) this session's timestamp
 OUT=tpu_session_out
+rm -rf "$OUT"
 mkdir -p "$OUT"
 
 echo "== probe =="
@@ -19,37 +27,12 @@ cat "$OUT/probe.txt"
 
 rc=0
 
-echo "== dispatch diagnostic (tunnel RTT vs fused scan) =="
-if timeout 600 python -u tools/diag_tunnel.py > "$OUT/diag.txt" 2>&1; then
-  tail -6 "$OUT/diag.txt"
-else
-  echo "DIAG FAILED (rc=$?) — tail of $OUT/diag.txt:"; tail -3 "$OUT/diag.txt"
-  rc=1
-fi
-
-echo "== kernel sweep =="
-if timeout 1200 python -u tools/sweep_hist.py > "$OUT/sweep.txt" 2>&1; then
-  tail -12 "$OUT/sweep.txt"
-else
-  echo "SWEEP FAILED (rc=$?) — tail of $OUT/sweep.txt:"; tail -5 "$OUT/sweep.txt"
-  rc=1
-fi
-
-echo "== batch sweep (runner fwd + resnet50 trainer step) =="
-if timeout 1800 python -u tools/sweep_batch.py --out "$OUT/batch_sweep.csv" \
-    > "$OUT/batch_sweep.txt" 2>&1; then
-  tail -12 "$OUT/batch_sweep.txt"
-else
-  echo "BATCH SWEEP FAILED (rc=$?) — tail of $OUT/batch_sweep.txt:"
-  tail -5 "$OUT/batch_sweep.txt"
-  rc=1
-fi
-
-echo "== bench =="
+echo "== bench (FIRST — the judged artifact; probes capped: the watcher just proved the tunnel up) =="
 # worst case inside the orchestrator: device core attempt (1800s) + CPU
 # core retry (1800s) + transformer child (900s) + trainer child (900s) —
 # the outer guard must cover it
-if timeout 5700 python bench.py > "$OUT/bench.json" 2> "$OUT/bench.err"; then
+if timeout 5700 env MMLSPARK_TPU_BENCH_PROBE_ATTEMPTS=2 \
+    python bench.py > "$OUT/bench.json" 2> "$OUT/bench.err"; then
   tail -1 "$OUT/bench.json"
 else
   echo "BENCH FAILED (rc=$?) — tail of $OUT/bench.err:"; tail -5 "$OUT/bench.err"
@@ -83,6 +66,32 @@ then
   rc=1
 fi
 
+echo "== kernel sweep (incl. the FIXED fused variant — failed Mosaic in window 1) =="
+if timeout 900 python -u tools/sweep_hist.py > "$OUT/sweep.txt" 2>&1; then
+  tail -12 "$OUT/sweep.txt"
+else
+  echo "SWEEP FAILED (rc=$?) — tail of $OUT/sweep.txt:"; tail -5 "$OUT/sweep.txt"
+  rc=1
+fi
+
+echo "== batch sweep (runner fwd + resnet50 trainer step) =="
+if timeout 1200 python -u tools/sweep_batch.py --out "$OUT/batch_sweep.csv" \
+    > "$OUT/batch_sweep.txt" 2>&1; then
+  tail -12 "$OUT/batch_sweep.txt"
+else
+  echo "BATCH SWEEP FAILED (rc=$?) — tail of $OUT/batch_sweep.txt:"
+  tail -5 "$OUT/batch_sweep.txt"
+  rc=1
+fi
+
+echo "== dispatch diagnostic (tunnel RTT vs fused scan) =="
+if timeout 600 python -u tools/diag_tunnel.py > "$OUT/diag.txt" 2>&1; then
+  tail -6 "$OUT/diag.txt"
+else
+  echo "DIAG FAILED (rc=$?) — tail of $OUT/diag.txt:"; tail -3 "$OUT/diag.txt"
+  rc=1
+fi
+
 echo "== xprof trace of a GBDT fit (for roofline analysis next round) =="
 if timeout 600 env MMLSPARK_TPU_TRACE_DIR="$OUT/xprof" \
     MMLSPARK_TPU_BENCH_PROBE_ATTEMPTS=1 python - > "$OUT/trace.txt" 2>&1 <<'PYEOF'
@@ -104,9 +113,19 @@ else
   echo "TRACE FAILED (non-fatal):"; tail -3 "$OUT/trace.txt"
 fi
 
+# archive this window's capture so a re-fired session (watcher re-arms on
+# rc!=0) can never clobber it; .log -> _log.txt because *.log is gitignored
+STAMP=$(date -u +%Y%m%dT%H%M%SZ)
+ARCHIVE="sweeps/session_$STAMP"
+mkdir -p "$ARCHIVE"
+cp -r "$OUT"/. "$ARCHIVE/" 2>/dev/null || true
+for f in "$ARCHIVE"/*.log; do
+  [ -e "$f" ] && mv "$f" "${f%.log}_log.txt"
+done
+
 if [ "$rc" -eq 0 ]; then
-  echo "== done — outputs in $OUT/ =="
+  echo "== done — outputs in $OUT/ (archived sweeps/session_$STAMP) =="
 else
-  echo "== FINISHED WITH FAILURES — outputs in $OUT/ =="
+  echo "== FINISHED WITH FAILURES — outputs in $OUT/ (archived sweeps/session_$STAMP) =="
 fi
 exit "$rc"
