@@ -127,6 +127,38 @@ def _hist_kernel(num_features, num_bins, chunk, bins_ref, stats_ref, out_ref):
         out_ref[:, f * num_bins : (f + 1) * num_bins] += h
 
 
+def _hist_kernel_grouped(group, num_features, num_bins, chunk,
+                         bins_ref, stats_ref, out_ref):
+    """Middle ground between per-feature and fused: G features share one
+    dot, so each matmul's lane axis is G·B wide (e.g. 1024 at G=4, B=256 —
+    vs 256 per-feature) without the fused variant's full F·B VMEM mask.
+    The round-4 chip sweep (sweeps/r4_window1/sweep.txt) showed per-feature
+    beating both chunk=2048 and the XLA scan; this variant probes whether
+    the win was dot width or VMEM pressure. All-f32 operands — the Mosaic
+    mixed-dtype constraint observed on v5e rules out a bf16 mask."""
+    import jax.experimental.pallas as pl
+
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    stats = stats_ref[:]                                        # (ch, C)
+    for g0 in range(0, num_features, group):
+        g = min(group, num_features - g0)                       # static
+        col = bins_ref[:, g0 : g0 + g].astype(jnp.int32)        # (ch, g)
+        iota = jax.lax.broadcasted_iota(jnp.int32, (chunk, g, num_bins), 2)
+        mask = (col[:, :, None] == iota).astype(jnp.float32)
+        mask = mask.reshape(chunk, g * num_bins)                # VMEM-only
+        h = jax.lax.dot_general(
+            stats, mask, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )                                                       # (C, g·B)
+        out_ref[:, g0 * num_bins : (g0 + g) * num_bins] += h
+
+
 def _hist_kernel_fused(num_features, num_bins, chunk, bins_ref, stats_ref, out_ref):
     """Fused variant: ONE (chunk, F·B) one-hot mask in VMEM and ONE dot per
     grid step, instead of F small dots. Small matmuls leave the MXU idle
@@ -170,6 +202,18 @@ def _fused_chunk(f: int, num_bins: int) -> int:
     return min(chunk, 2048)
 
 
+def _hist_group() -> int:
+    """Feature-group width for the grouped kernel (MMLSPARK_TPU_HIST_GROUP).
+    1 (default) = the proven per-feature kernel; >1 widens each dot's lane
+    axis to G·B. Opt-in until a chip sweep picks a winner."""
+    import os
+
+    try:
+        return max(int(os.environ.get("MMLSPARK_TPU_HIST_GROUP", "1")), 1)
+    except ValueError:
+        return 1
+
+
 def _fused_enabled() -> bool:
     """The fused variant is opt-in (MMLSPARK_TPU_FUSED_HIST=1) until a chip
     sweep proves it beats the per-feature kernel: the measured v5e session
@@ -192,13 +236,25 @@ def _histogram_pallas(bins, stats, num_bins, interpret):
     # rows pad up to a whole chunk (zero stats land in bin 0 with weight 0),
     # so tiny n still runs the tile-aligned chunk shape
     chunk = fused_chunk if use_fused else min(_PALLAS_CHUNK, max(n, 8))
+    group = min(_hist_group(), f)
+    if use_fused:
+        kernel = _hist_kernel_fused
+    elif group > 1:
+        kernel = functools.partial(_hist_kernel_grouped, group)
+        # same VMEM discipline as the fused path: the (chunk, G·B) f32
+        # mask must fit the budget, or Mosaic blows VMEM at fit time
+        mask_limit = _FUSED_MASK_VMEM_BYTES // (group * num_bins * 4)
+        mask_chunk = 1 << max(int(mask_limit).bit_length() - 1, 3)
+        chunk = min(chunk, mask_chunk)
+    else:
+        kernel = _hist_kernel
+
     pad = (-n) % chunk
     if pad:
         bins = jnp.concatenate([bins, jnp.zeros((pad, f), bins.dtype)])
         stats = jnp.concatenate([stats, jnp.zeros((pad, c), stats.dtype)])
     nc = (n + pad) // chunk
 
-    kernel = _hist_kernel_fused if use_fused else _hist_kernel
     out = pl.pallas_call(
         functools.partial(kernel, f, num_bins, chunk),
         grid=(nc,),

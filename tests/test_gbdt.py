@@ -727,6 +727,24 @@ class TestHistKernel:
             bins2.astype(jnp.uint8), stats, b2))
         np.testing.assert_array_equal(hp2, hp2_u8)
 
+    def test_grouped_variant_agrees(self, monkeypatch):
+        # G features per dot (lane axis G·B): must match the XLA reference
+        # for both a divisible and a ragged final group, and for uint8 bins
+        from mmlspark_tpu.gbdt import hist_kernel as hk
+
+        rng = np.random.default_rng(3)
+        n, c, b = 700, 3, 32
+        stats = jnp.asarray(rng.normal(size=(n, c)), jnp.float32)
+        for f, g in ((8, 4), (14, 4), (5, 8)):   # exact, ragged, g > F
+            monkeypatch.setenv("MMLSPARK_TPU_HIST_GROUP", str(g))
+            bins = jnp.asarray(rng.integers(0, b, size=(n, f)), jnp.int32)
+            hx = np.asarray(hk.histogram_xla(bins, stats, b))
+            hp = np.asarray(hk.histogram_pallas_interpret(bins, stats, b))
+            np.testing.assert_allclose(hx, hp, rtol=1e-5, atol=1e-5)
+            hp_u8 = np.asarray(hk.histogram_pallas_interpret(
+                bins.astype(jnp.uint8), stats, b))
+            np.testing.assert_array_equal(hp, hp_u8)
+
     def test_registry_resolution(self):
         from mmlspark_tpu.core import kernels
 

@@ -73,26 +73,33 @@ def v_current_pallas(chunk):
         old = hk._PALLAS_CHUNK
         hk._PALLAS_CHUNK = chunk
         try:
-            # fused is opt-in via MMLSPARK_TPU_FUSED_HIST (unset here), so
-            # this times the per-feature kernel at the given chunk
-            return hk._histogram_pallas(bins, stats, num_bins, interpret=False)
+            # pin BOTH opt-ins off so this row times the per-feature kernel
+            # even if the operator exported the env vars for other rows
+            with _with_env("MMLSPARK_TPU_FUSED_HIST", "0"), \
+                    _with_env("MMLSPARK_TPU_HIST_GROUP", "1"):
+                return hk._histogram_pallas(bins, stats, num_bins,
+                                            interpret=False)
         finally:
             hk._PALLAS_CHUNK = old
     return fn
 
 
 @contextlib.contextmanager
-def _force_fused():
-    """Temporarily set the fused opt-in env var, restoring any prior value."""
-    old = os.environ.get("MMLSPARK_TPU_FUSED_HIST")
-    os.environ["MMLSPARK_TPU_FUSED_HIST"] = "1"
+def _with_env(key, value):
+    """Temporarily set an env var, restoring any prior value."""
+    old = os.environ.get(key)
+    os.environ[key] = value
     try:
         yield
     finally:
         if old is None:
-            os.environ.pop("MMLSPARK_TPU_FUSED_HIST", None)
+            os.environ.pop(key, None)
         else:
-            os.environ["MMLSPARK_TPU_FUSED_HIST"] = old
+            os.environ[key] = old
+
+
+def _force_fused():
+    return _with_env("MMLSPARK_TPU_FUSED_HIST", "1")
 
 
 def v_fused_auto():
@@ -116,6 +123,22 @@ def v_fused_budget(budget_mb):
                                             interpret=False)
         finally:
             hk._FUSED_MASK_VMEM_BYTES = old
+    return fn
+
+
+def v_grouped(group, chunk=1024):
+    from mmlspark_tpu.gbdt import hist_kernel as hk
+
+    def fn(bins, stats, num_bins):
+        old = hk._PALLAS_CHUNK
+        hk._PALLAS_CHUNK = chunk
+        try:
+            with _with_env("MMLSPARK_TPU_FUSED_HIST", "0"), \
+                    _with_env("MMLSPARK_TPU_HIST_GROUP", str(group)):
+                return hk._histogram_pallas(bins, stats, num_bins,
+                                            interpret=False)
+        finally:
+            hk._PALLAS_CHUNK = old
     return fn
 
 
@@ -165,6 +188,10 @@ def main():
          lambda b, s, nb: histogram_xla(b, s, nb), bins),
         ("pallas per-feature chunk=1024", v_current_pallas(1024), bins),
         ("pallas per-feature chunk=2048", v_current_pallas(2048), bins),
+        ("pallas grouped G=2 chunk=1024", v_grouped(2), bins),
+        ("pallas grouped G=4 chunk=1024", v_grouped(4), bins),
+        ("pallas grouped G=7 chunk=1024", v_grouped(7), bins),
+        ("pallas grouped G=4 chunk=512", v_grouped(4, 512), bins),
         (f"pallas fused auto (4MB->{_chunk_of(4)})", v_fused_auto(), bins),
         (f"pallas fused budget 2MB ({_chunk_of(2)})", v_fused_budget(2), bins),
         (f"pallas fused budget 8MB ({_chunk_of(8)})", v_fused_budget(8), bins),

@@ -13,8 +13,19 @@ cd "$(dirname "$0")/.."
 # scripts under tools/ put tools/ at sys.path[0]; the package lives at root
 export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 # fresh $OUT per session: stale files from an earlier window must never be
-# archived under (and misattributed to) this session's timestamp
+# archived under (and misattributed to) this session's timestamp. A session
+# killed mid-run never reaches its own archive step, so rescue any leftover
+# capture FIRST — chip windows are too rare to ever delete one's data.
 OUT=tpu_session_out
+if [ -d "$OUT" ] && [ -n "$(ls -A "$OUT" 2>/dev/null)" ]; then
+  RESCUE="sweeps/rescued_$(date -u +%Y%m%dT%H%M%SZ)"
+  mkdir -p "$RESCUE"
+  cp -r "$OUT"/. "$RESCUE/" 2>/dev/null || true
+  for f in "$RESCUE"/*.log; do
+    [ -e "$f" ] && mv "$f" "${f%.log}_log.txt"
+  done
+  echo "rescued previous session leftovers to $RESCUE"
+fi
 rm -rf "$OUT"
 mkdir -p "$OUT"
 
