@@ -24,12 +24,11 @@ predictions — is carried IN the scan as a (rounds, n) contribution matrix
 and a (rounds,) weight vector. Each round's base prediction is one matvec
 `contribs^T @ (weights * keep)`, an MXU-friendly O(R*n) read instead of a
 host round trip; O(1) dispatches per dart fit. Multiclass dart (plain gbdt
-updates — the drop algebra is single-model) stays on the host-loop path in
-booster.py.
+updates — the drop algebra is single-model) rides the fused gbdt scan in
+booster.py, so EVERY boosting mode is O(1) dispatches per fit.
 
 Randomness is `jax.random` threaded through the scan (fold_in per round and
-per mesh shard), so the fused path is deterministic for a fixed seed but not
-bit-identical to the host-loop path's numpy draws.
+per mesh shard), so the fused path is deterministic for a fixed seed.
 """
 
 from __future__ import annotations

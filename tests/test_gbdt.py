@@ -333,6 +333,29 @@ class TestBooster:
         np.testing.assert_array_equal(
             np.asarray(bd.predict_raw(x)), np.asarray(bg.predict_raw(x)))
 
+    def test_bad_boosting_type_rejected(self):
+        x, y = make_classification(n=200)
+        with pytest.raises(ValueError, match="boosting_type"):
+            Booster.train(x, y, TrainOptions(
+                objective="binary", boosting_type="Dart", num_iterations=2))
+
+    def test_multiclass_dart_rides_fused_path(self):
+        """Multiclass dart performs plain additive updates (the
+        drop/renormalize algebra is single-model only), so it must go
+        through the fused gbdt scan — O(1) dispatches — not a host loop."""
+        rng = np.random.default_rng(9)
+        n = 1200
+        x = rng.normal(size=(n, 6))
+        y = (x[:, 0] + 0.7 * x[:, 1] > np.quantile(
+            x[:, 0] + 0.7 * x[:, 1], [0.33, 0.66])[:, None]).sum(0).astype(float)
+        msgs: list[str] = []
+        b = Booster.train(x, y, TrainOptions(
+            objective="multiclass", num_class=3, boosting_type="dart",
+            num_iterations=6, num_leaves=7), log=msgs.append)
+        assert any("fused boosting" in m for m in msgs), msgs
+        acc = (np.argmax(b.predict(x), 1) == y).mean()
+        assert acc > 0.8, acc
+
     def test_fused_dart_mesh_matches_single_device(self, mesh8):
         """dart under the data mesh: replicated drop decisions + psum
         histograms give the single-device model (same contract as gbdt)."""
