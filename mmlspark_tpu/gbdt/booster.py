@@ -24,8 +24,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from .binning import BinMapper
-from .engine import GrowConfig, TreeArrays, make_grow_fn, pad_rows
-from .objectives import get_objective, get_validation_loss, init_raw_score
+from .engine import GrowConfig, TreeArrays, pad_rows
+from .objectives import (get_leaf_renewal, get_objective,
+                         get_validation_loss, init_raw_score)
 from ..parallel.mesh import DATA_AXIS
 
 __all__ = ["Booster", "TrainOptions"]
@@ -230,7 +231,10 @@ class Booster:
         cat_mask = np.zeros(f, bool)
         for ci in opts.categorical_indexes:
             cat_mask[int(ci)] = True
-        grow = make_grow_fn(f, num_bins, cfg, mapper.num_bins, cat_mask, mesh=mesh)
+        # L1-family leaf renewal (LightGBM RenewTreeOutput) — see
+        # objectives.get_leaf_renewal; applied inside the fused scans
+        renewal = get_leaf_renewal(opts.objective, alpha=opts.alpha)
+        renew_alpha, renew_weighted = renewal if renewal else (None, False)
 
         if opts.objective == "multiclass":
             init = 0.0
@@ -344,6 +348,8 @@ class Booster:
                     early_stopping_round=(
                         opts.early_stopping_round if es_active else 0
                     ),
+                    renew_alpha=renew_alpha,
+                    renew_weighted=renew_weighted,
                 )
                 fused = make_fused_train_fn(
                     f, num_bins, cfg, mapper.num_bins, cat_mask, obj_fn, spec,
@@ -403,6 +409,8 @@ class Booster:
                     bagging_freq=opts.bagging_freq,
                     feature_fraction=opts.feature_fraction,
                     drop_rate=opts.drop_rate,
+                    renew_alpha=renew_alpha,
+                    renew_weighted=renew_weighted,
                 )
                 fused = make_fused_dart_fn(
                     f, num_bins, cfg, mapper.num_bins, cat_mask, obj_fn, spec,

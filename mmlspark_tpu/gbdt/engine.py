@@ -156,7 +156,8 @@ def make_grow_fn(
     """Build the jitted single-tree growth function.
 
     Returns fn(bins(n,F) i32, grad(n,) f32, hess(n,) f32, sample_mask(n,) f32,
-               feature_mask(F,) f32) -> (TreeArrays, per_row_value(n,) f32)
+               feature_mask(F,) f32)
+            -> (TreeArrays, per_row_value(n,) f32, node_of_row(n,) i32)
 
     When `mesh` has a data axis > 1 the function is shard_mapped: row inputs
     sharded on DATA_AXIS, histogram psummed, tree state replicated.
@@ -465,7 +466,9 @@ def make_grow_fn(
         leaf_val = jnp.where(tree.is_leaf, leaf_val * cfg.learning_rate, 0.0)
         tree = tree._replace(value=leaf_val.astype(jnp.float32))
         per_row_value = tree.value[node_of_row]
-        return tree, per_row_value
+        # node_of_row is returned so callers can renew leaf outputs
+        # post-hoc (LightGBM RenewTreeOutput for the L1-family objectives)
+        return tree, per_row_value, node_of_row
 
     if raw:
         return grow
@@ -478,6 +481,7 @@ def make_grow_fn(
             in_specs=(P(DATA_AXIS, None), row, row, row, P()),
             out_specs=(
                 TreeArrays(*([P()] * len(TreeArrays._fields))),
+                row,
                 row,
             ),
         )

@@ -42,6 +42,7 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..parallel.collectives import psum_exact_fixedpoint
 from ..parallel.mesh import DATA_AXIS
 from .engine import GrowConfig, TreeArrays, make_grow_fn, tree_apply
 
@@ -62,10 +63,103 @@ class FusedTrainSpec(NamedTuple):
     other_rate: float = 0.1            # goss
     early_stopping_round: int = 0      # 0: off (gbdt/goss only)
     drop_rate: float = 0.1             # dart
+    # leaf-output renewal (LightGBM RenewTreeOutput, objectives.py
+    # get_leaf_renewal): percentile of in-leaf residuals replacing the
+    # grad/hess leaf value for the L1-family objectives. None = off.
+    renew_alpha: "float | None" = None
+    renew_weighted: bool = False       # mape: weight residuals by 1/max(|y|,1)
 
 
 _FUSED_CACHE: dict = {}
 _FUSED_CACHE_MAX = 8
+
+_RENEW_BINS = 256      # residual-histogram resolution for leaf renewal
+_RENEW_CHUNK = 4096
+
+
+def _renew_tree_values(tree, node_of_row, resid, w, alpha, learning_rate,
+                       axis_name, deterministic=False):
+    """LightGBM RenewTreeOutput, TPU-native: replace each leaf's value with
+    learning_rate x the alpha-percentile of the residuals of its (weighted)
+    rows. Exact per-leaf sorting needs data-dependent gathers; instead the
+    residuals go through a 256-bin histogram per node — one chunked
+    one-hot matmul, psum-able under the data mesh, so all shards renew to
+    the IDENTICAL value (replicated-model guarantee) and mesh == single
+    device bit-wise. Percentile error is bounded by span/256, far below
+    the label scale the renewal exists to restore."""
+    m = tree.value.shape[0]
+    pos = w > 0
+    lo = jnp.min(jnp.where(pos, resid, jnp.inf))
+    hi = jnp.max(jnp.where(pos, resid, -jnp.inf))
+    if axis_name is not None:
+        lo = jax.lax.pmin(lo, axis_name)
+        hi = jax.lax.pmax(hi, axis_name)
+    span = jnp.maximum(hi - lo, 1e-12)
+    rbin = jnp.clip(((resid - lo) / span * _RENEW_BINS).astype(jnp.int32),
+                    0, _RENEW_BINS - 1)
+    n = resid.shape[0]
+    chunk = min(_RENEW_CHUNK, n)
+    pad = (-n) % chunk
+    if pad:
+        node_of_row = jnp.concatenate(
+            [node_of_row, jnp.zeros((pad,), node_of_row.dtype)])
+        rbin = jnp.concatenate([rbin, jnp.zeros((pad,), rbin.dtype)])
+        w = jnp.concatenate([w, jnp.zeros((pad,), w.dtype)])
+    nc = (n + pad) // chunk
+
+    def body(acc, xs):
+        nd, rb, wc = xs
+        oh_n = jax.nn.one_hot(nd, m, dtype=jnp.float32)            # (ch, M)
+        oh_b = jax.nn.one_hot(rb, _RENEW_BINS, dtype=jnp.float32)
+        oh_b = oh_b * wc[:, None]                                  # (ch, B)
+        h = jax.lax.dot_general(
+            oh_n, oh_b, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )                                                          # (M, B)
+        return acc + h, None
+
+    # + 0*resid[0]: carry adopts the shard-varying type under shard_map
+    acc0 = jnp.zeros((m, _RENEW_BINS), jnp.float32) + 0.0 * resid[0]
+    hist, _ = jax.lax.scan(
+        body, acc0,
+        (node_of_row.reshape(nc, chunk), rbin.reshape(nc, chunk),
+         w.reshape(nc, chunk)),
+    )
+    if axis_name is not None:
+        if deterministic:
+            hist = psum_exact_fixedpoint(hist, axis_name)
+        else:
+            hist = jax.lax.psum(hist, axis_name)
+    cum = jnp.cumsum(hist, axis=1)                                 # (M, B)
+    tot = cum[:, -1]
+    idx = jnp.argmax(cum >= (alpha * tot)[:, None], axis=1)
+    centers = lo + (idx.astype(jnp.float32) + 0.5) / _RENEW_BINS * span
+    new_val = jnp.where(
+        tree.is_leaf & (tot > 0),
+        (centers * learning_rate).astype(jnp.float32),
+        tree.value,
+    )
+    return tree._replace(value=new_val)
+
+
+def _apply_renewal(tree, node_row, resid, mask, base_w, y, spec, cfg,
+                   axis_name):
+    """Renew a freshly grown tree's leaves and recompute its row values.
+
+    Renewal weights are BAG MEMBERSHIP x data weight — NOT the grow mask:
+    the goss mask amplifies sampled small-gradient rows by
+    (1-top_rate)/other_rate for the gradient sums, but LightGBM's
+    RenewTreeOutput percentile runs over the partition rows with their
+    original data weights only."""
+    member_w = jnp.where(mask > 0, base_w, 0.0)
+    if spec.renew_weighted:
+        member_w = member_w / jnp.maximum(jnp.abs(y), 1.0)
+    tree = _renew_tree_values(
+        tree, node_row, resid, member_w, spec.renew_alpha,
+        cfg.learning_rate, axis_name, deterministic=cfg.deterministic,
+    )
+    return tree, tree.value[node_row]
 
 
 def _zero_tree(num_leaves: int, num_bins: int) -> TreeArrays:
@@ -223,7 +317,14 @@ def make_fused_train_fn(
                     if spec.feature_fraction < 1.0
                     else jnp.ones((f,), jnp.float32)
                 )
-                tree, rv = grow(bins, gc, hc, mask, fmask, axis_name=axis_name)
+                tree, rv, node_row = grow(
+                    bins, gc, hc, mask, fmask, axis_name=axis_name)
+                if spec.renew_alpha is not None and k == 1:
+                    # L1-family leaf renewal (the objectives are
+                    # single-model regressions, so k is always 1 here)
+                    tree, rv = _apply_renewal(
+                        tree, node_row, y - pred, mask, base_w, y, spec,
+                        cfg, axis_name)
                 trees_k.append(tree)
                 rowvals.append(rv)
 
@@ -443,7 +544,12 @@ def make_fused_dart_fn(
                 if spec.feature_fraction < 1.0
                 else jnp.ones((f,), jnp.float32)
             )
-            tree, rv = grow(bins, g, h, bag, fmask, axis_name=axis_name)
+            tree, rv, node_row = grow(bins, g, h, bag, fmask,
+                                      axis_name=axis_name)
+            if spec.renew_alpha is not None:
+                tree, rv = _apply_renewal(
+                    tree, node_row, y - pred_round, bag, base_w, y, spec,
+                    cfg, axis_name)
 
             # standard DART renormalization (the host loop's algebra):
             # dropped weights shrink by k/(k+1), the new tree enters at

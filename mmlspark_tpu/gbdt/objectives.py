@@ -129,6 +129,29 @@ OBJECTIVES: dict[str, Callable] = {
 }
 
 
+def get_leaf_renewal(name: str, alpha: float = 0.9):
+    """Leaf-output renewal spec for gradient-scale-free objectives, or None.
+
+    LightGBM renews each leaf's output to a percentile of the residuals in
+    the leaf after growing the tree (RenewTreeOutput: the L1 family's
+    sign-scale gradients make sum(g)/sum(h) leaf values step at the
+    learning-rate scale, not the label scale, so unrenewed fits converge
+    pathologically slowly). Returns (percentile_alpha, weighted_by_inv_label)
+    — l1/mae/huber: median (huber's gradient clips to ±alpha, so with a
+    small threshold relative to the label scale it degenerates to L1's
+    sign-scale steps); quantile: the objective's alpha; mape: the
+    1/max(|y|,1)-weighted median. The L2 family needs no renewal (its
+    gradients already carry the label scale)."""
+    key = name.lower()
+    if key in ("l1", "mae", "mean_absolute_error", "regression_l1", "huber"):
+        return 0.5, False
+    if key == "quantile":
+        return float(alpha), False
+    if key == "mape":
+        return 0.5, True
+    return None
+
+
 def get_objective(name: str, **kw) -> Callable:
     """Resolve an objective name to fn(y, raw) -> (grad, hess)."""
     key = name.lower()

@@ -199,6 +199,87 @@ class TestMoreRealDataAnchors:
             assert auc > bar, f"{boosting}: train AUC {auc:.3f} <= {bar}"
 
 
+class TestRealRegressionAnchor:
+    """REAL regression data (the classification anchors' counterpart): the
+    diabetes dataset — 442 genuine clinical records (age/sex/bmi/bp + six
+    serum measurements -> disease progression), vendored from sklearn's
+    bundled copy. Mirrors the reference's regressor gate pattern
+    (benchmarks_VerifyLightGBMRegressor.csv: fixed config, metric within a
+    window) plus an independent-implementation cross-check."""
+
+    def _split(self, seed=0):
+        x, y = _load_real_csv("diabetes")
+        rng = np.random.default_rng(seed)
+        order = rng.permutation(len(y))
+        cut = int(0.8 * len(y))
+        return x, y, order[:cut], order[cut:]
+
+    def test_holdout_rmse_clears_reference_style_gate(self):
+        from mmlspark_tpu.gbdt.booster import Booster, TrainOptions
+
+        x, y, tr, te = self._split()
+        b = Booster.train(x[tr], y[tr], TrainOptions(
+            objective="regression", num_leaves=15, num_iterations=50,
+            min_data_in_leaf=5, learning_rate=0.1,
+        ))
+        pred = np.asarray(b.predict(x[te]))
+        rmse = float(np.sqrt(np.mean((pred - y[te]) ** 2)))
+        # label std is ~77; published GBDT results on this dataset sit
+        # around RMSE 54-60 — the bar is a reference-style window above
+        # the achievable value, far below the constant-predictor baseline
+        assert rmse < 65.0, f"holdout RMSE {rmse:.2f}"
+        const_rmse = float(np.sqrt(np.mean((y[tr].mean() - y[te]) ** 2)))
+        assert rmse < 0.85 * const_rmse, (rmse, const_rmse)
+
+    def test_sklearn_cross_check(self):
+        from sklearn.ensemble import HistGradientBoostingRegressor
+
+        from mmlspark_tpu.gbdt.booster import Booster, TrainOptions
+
+        x, y, tr, te = self._split(seed=1)
+        ours = Booster.train(x[tr], y[tr], TrainOptions(
+            objective="regression", num_leaves=15, num_iterations=50,
+            min_data_in_leaf=5, learning_rate=0.1,
+        ))
+        ours_rmse = float(np.sqrt(np.mean(
+            (np.asarray(ours.predict(x[te])) - y[te]) ** 2)))
+        sk = HistGradientBoostingRegressor(
+            max_iter=50, max_leaf_nodes=15, learning_rate=0.1,
+            min_samples_leaf=5, early_stopping=False,
+        ).fit(x[tr], y[tr])
+        sk_rmse = float(np.sqrt(np.mean((sk.predict(x[te]) - y[te]) ** 2)))
+        # same family, same capacity, identical data: RMSEs must land in
+        # the same neighborhood (window sized like the reference's
+        # per-metric precisions relative to the ~55-60 scale)
+        assert abs(ours_rmse - sk_rmse) < 6.0, (ours_rmse, sk_rmse)
+
+    def test_robust_objectives_on_real_data(self):
+        """l1/huber/quantile learn the real data too (the reference's
+        regressor gates span objectives; quantile checks calibration)."""
+        from mmlspark_tpu.gbdt.booster import Booster, TrainOptions
+
+        x, y, tr, te = self._split(seed=2)
+        for objective in ("l1", "huber"):
+            b = Booster.train(x[tr], y[tr], TrainOptions(
+                objective=objective, num_leaves=15, num_iterations=50,
+                min_data_in_leaf=5, learning_rate=0.1,
+            ))
+            mae = float(np.mean(np.abs(np.asarray(b.predict(x[te])) - y[te])))
+            const_mae = float(np.mean(np.abs(np.median(y[tr]) - y[te])))
+            # l1 rides leaf renewal (RenewTreeOutput): measured ~50 vs the
+            # constant's ~60 — the bar keeps a margin above sklearn's ~51
+            assert mae < 0.93 * const_mae, (objective, mae, const_mae)
+        bq = Booster.train(x[tr], y[tr], TrainOptions(
+            objective="quantile", alpha=0.8, num_leaves=15,
+            num_iterations=50, min_data_in_leaf=5, learning_rate=0.1,
+        ))
+        cover = float((y[te] <= np.asarray(bq.predict(x[te]))).mean())
+        # with renewal the q0.8 holdout coverage is ~0.76; the window is a
+        # calibration gate (unrenewed quantile fits collapse toward the
+        # median and fail it)
+        assert 0.68 <= cover <= 0.92, f"q0.8 coverage {cover:.3f}"
+
+
 # A hand-authored model in LightGBM's native model.txt syntax. Semantics to
 # reproduce by hand below: two trees, raw = leaf0(t0) + leaf(t1), prob =
 # sigmoid(raw).

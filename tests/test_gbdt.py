@@ -333,6 +333,37 @@ class TestBooster:
         np.testing.assert_array_equal(
             np.asarray(bd.predict_raw(x)), np.asarray(bg.predict_raw(x)))
 
+    def test_quantile_leaf_renewal_calibrates(self):
+        """Leaf renewal (LightGBM RenewTreeOutput): on label noise that is
+        independent of x, a quantile fit must converge to the global
+        alpha-quantile — without renewal, leaf steps live on the
+        learning-rate scale and the fit stays pinned near its init."""
+        rng = np.random.default_rng(11)
+        x = rng.normal(size=(4000, 4))
+        y = rng.normal(size=4000)                  # independent of x
+        b = Booster.train(x, y, TrainOptions(
+            objective="quantile", alpha=0.9, num_iterations=60,
+            num_leaves=7, learning_rate=0.1,
+        ))
+        pred = np.asarray(b.predict(x))
+        q = float(np.quantile(y, 0.9))
+        assert abs(float(pred.mean()) - q) < 0.2, (pred.mean(), q)
+        cover = float((y <= pred).mean())
+        assert 0.84 <= cover <= 0.96, cover
+
+    def test_l1_renewal_mesh_matches_single_device(self, mesh8):
+        """The renewal histogram is psummed like the split histograms, so
+        the renewed model must be identical on mesh vs single device."""
+        rng = np.random.default_rng(12)
+        x = rng.normal(size=(1024, 5))
+        y = 10.0 * x[:, 0] + rng.normal(scale=2.0, size=1024)
+        opts = TrainOptions(objective="l1", num_iterations=15, num_leaves=15)
+        b1 = Booster.train(x, y, opts)
+        b2 = Booster.train(x, y, opts, mesh=mesh8)
+        np.testing.assert_allclose(
+            np.asarray(b2.predict_raw(x)), np.asarray(b1.predict_raw(x)),
+            rtol=2e-4, atol=2e-4)
+
     def test_bad_boosting_type_rejected(self):
         x, y = make_classification(n=200)
         with pytest.raises(ValueError, match="boosting_type"):
