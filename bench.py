@@ -568,8 +568,22 @@ def bench_transformer(peak_tflops: "float | None") -> dict:
 
     fwd_dense_tps, per_tok = timed_fwd("dense", xb, fwd_batches,
                                        want_flops=True)
-    fwd_flash_tps, _ = timed_fwd("flash", xb, fwd_batches)
-    long_tps, _ = timed_fwd("flash", toks(long_bs, long_seq), fwd_batches)
+    # flash rows degrade individually: a Mosaic rejection of the Pallas
+    # kernel on real hardware (the interpret-vs-Mosaic gap the histogram
+    # kernel hit on v5e) must cost the flash rows, not the whole family
+    try:
+        fwd_flash_tps, _ = timed_fwd("flash", xb, fwd_batches)
+    except Exception as e:  # noqa: BLE001 — kernel-path insurance
+        print(f"bench: flash fwd failed ({e!r}); row stays null",
+              file=sys.stderr)
+        fwd_flash_tps = None
+    try:
+        long_tps, _ = timed_fwd("flash", toks(long_bs, long_seq),
+                                fwd_batches)
+    except Exception as e:  # noqa: BLE001 — kernel-path insurance
+        print(f"bench: flash long-seq fwd failed ({e!r}); row stays null",
+              file=sys.stderr)
+        long_tps = None
 
     # training: chunked attention core, all steps fused in one scan dispatch
     m_train = model("chunked", seq)
@@ -612,7 +626,7 @@ def bench_transformer(peak_tflops: "float | None") -> dict:
 
     measurable = not on_cpu
     fwd_tflops = (fwd_flash_tps * per_tok / 1e12
-                  if measurable and per_tok else None)
+                  if measurable and per_tok and fwd_flash_tps else None)
     train_tflops = (train_tps * train_per_tok / 1e12
                     if measurable and train_per_tok else None)
     return {

@@ -77,66 +77,109 @@ _RENEW_BINS = 256      # residual-histogram resolution for leaf renewal
 _RENEW_CHUNK = 4096
 
 
+# refinement rounds: each round multiplies percentile resolution by
+# _RENEW_BINS within the node's own residual bracket, so 2 rounds resolve
+# to node-span/65536 — robust when a leaf holds a far outlier (a single
+# global-range pass collapses all normal residuals into one 'span/256'
+# bin and renews every leaf to that bin's center)
+_RENEW_ROUNDS = 2
+
+
 def _renew_tree_values(tree, node_of_row, resid, w, alpha, learning_rate,
                        axis_name, deterministic=False):
     """LightGBM RenewTreeOutput, TPU-native: replace each leaf's value with
     learning_rate x the alpha-percentile of the residuals of its (weighted)
-    rows. Exact per-leaf sorting needs data-dependent gathers; instead the
-    residuals go through a 256-bin histogram per node — one chunked
-    one-hot matmul, psum-able under the data mesh, so all shards renew to
-    the IDENTICAL value (replicated-model guarantee) and mesh == single
-    device bit-wise. Percentile error is bounded by span/256, far below
-    the label scale the renewal exists to restore."""
+    rows. Exact per-leaf sorting needs data-dependent gathers; instead each
+    node keeps its own [lo, hi] residual bracket and the percentile is
+    found by _RENEW_ROUNDS rounds of 256-bin histogram refinement (chunked
+    one-hot matmuls, psum-able under the data mesh, so every shard renews
+    to the IDENTICAL value — replicated-model guarantee, mesh == single
+    device). Resolution: node-span / 256^rounds."""
     m = tree.value.shape[0]
-    pos = w > 0
-    lo = jnp.min(jnp.where(pos, resid, jnp.inf))
-    hi = jnp.max(jnp.where(pos, resid, -jnp.inf))
-    if axis_name is not None:
-        lo = jax.lax.pmin(lo, axis_name)
-        hi = jax.lax.pmax(hi, axis_name)
-    span = jnp.maximum(hi - lo, 1e-12)
-    rbin = jnp.clip(((resid - lo) / span * _RENEW_BINS).astype(jnp.int32),
-                    0, _RENEW_BINS - 1)
+    f32 = jnp.float32
     n = resid.shape[0]
     chunk = min(_RENEW_CHUNK, n)
     pad = (-n) % chunk
     if pad:
         node_of_row = jnp.concatenate(
             [node_of_row, jnp.zeros((pad,), node_of_row.dtype)])
-        rbin = jnp.concatenate([rbin, jnp.zeros((pad,), rbin.dtype)])
+        resid = jnp.concatenate([resid, jnp.zeros((pad,), resid.dtype)])
         w = jnp.concatenate([w, jnp.zeros((pad,), w.dtype)])
     nc = (n + pad) // chunk
+    nd_c = node_of_row.reshape(nc, chunk)
+    r_c = resid.reshape(nc, chunk).astype(f32)
+    w_c = w.reshape(nc, chunk).astype(f32)
 
-    def body(acc, xs):
+    # per-NODE residual bracket: an outlier only widens its own node's span
+    def minmax_body(carry, xs):
+        lo_a, hi_a = carry
         nd, rb, wc = xs
-        oh_n = jax.nn.one_hot(nd, m, dtype=jnp.float32)            # (ch, M)
-        oh_b = jax.nn.one_hot(rb, _RENEW_BINS, dtype=jnp.float32)
-        oh_b = oh_b * wc[:, None]                                  # (ch, B)
-        h = jax.lax.dot_general(
-            oh_n, oh_b, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )                                                          # (M, B)
-        return acc + h, None
+        sel = (jax.nn.one_hot(nd, m, dtype=f32) > 0) & (wc[:, None] > 0)
+        lo_a = jnp.minimum(lo_a, jnp.where(sel, rb[:, None], jnp.inf).min(0))
+        hi_a = jnp.maximum(hi_a, jnp.where(sel, rb[:, None], -jnp.inf).max(0))
+        return (lo_a, hi_a), None
 
-    # + 0*resid[0]: carry adopts the shard-varying type under shard_map
-    acc0 = jnp.zeros((m, _RENEW_BINS), jnp.float32) + 0.0 * resid[0]
-    hist, _ = jax.lax.scan(
-        body, acc0,
-        (node_of_row.reshape(nc, chunk), rbin.reshape(nc, chunk),
-         w.reshape(nc, chunk)),
-    )
+    # + 0*r_c[0,0]: carry adopts the shard-varying type under shard_map
+    init = (jnp.full((m,), jnp.inf, f32) + 0.0 * r_c[0, 0],
+            jnp.full((m,), -jnp.inf, f32) + 0.0 * r_c[0, 0])
+    (lo, hi), _ = jax.lax.scan(minmax_body, init, (nd_c, r_c, w_c))
     if axis_name is not None:
-        if deterministic:
-            hist = psum_exact_fixedpoint(hist, axis_name)
-        else:
-            hist = jax.lax.psum(hist, axis_name)
-    cum = jnp.cumsum(hist, axis=1)                                 # (M, B)
-    tot = cum[:, -1]
-    idx = jnp.argmax(cum >= (alpha * tot)[:, None], axis=1)
-    centers = lo + (idx.astype(jnp.float32) + 0.5) / _RENEW_BINS * span
+        lo = jax.lax.pmin(lo, axis_name)
+        hi = jax.lax.pmax(hi, axis_name)
+    # empty nodes keep inf brackets; neutralize so arithmetic stays finite
+    empty = lo > hi
+    lo = jnp.where(empty, 0.0, lo)
+    hi = jnp.where(empty, 0.0, hi)
+
+    def hist_pass(lo, hi, target, first):
+        span = jnp.maximum(hi - lo, 1e-12)                         # (M,)
+
+        def body(acc, xs):
+            nd, rb, wc = xs
+            lo_r, hi_r = lo[nd], hi[nd]                            # (ch,)
+            bin_f = (rb - lo_r) / span[nd] * _RENEW_BINS
+            bidx = jnp.clip(bin_f.astype(jnp.int32), 0, _RENEW_BINS - 1)
+            # rows outside their node's current bracket carry no weight
+            inw = jnp.where((rb >= lo_r) & (rb <= hi_r), wc, 0.0)
+            oh_n = jax.nn.one_hot(nd, m, dtype=f32)                # (ch, M)
+            oh_b = jax.nn.one_hot(bidx, _RENEW_BINS, dtype=f32)
+            oh_b = oh_b * inw[:, None]                             # (ch, B)
+            h = jax.lax.dot_general(
+                oh_n, oh_b, (((0,), (0,)), ((), ())),
+                preferred_element_type=f32,
+                precision=jax.lax.Precision.HIGHEST,
+            )                                                      # (M, B)
+            return acc + h, None
+
+        acc0 = jnp.zeros((m, _RENEW_BINS), f32) + 0.0 * r_c[0, 0]
+        hist, _ = jax.lax.scan(body, acc0, (nd_c, r_c, w_c))
+        if axis_name is not None:
+            if deterministic:
+                hist = psum_exact_fixedpoint(hist, axis_name)
+            else:
+                hist = jax.lax.psum(hist, axis_name)
+        cum = jnp.cumsum(hist, axis=1)                             # (M, B)
+        tot = cum[:, -1]
+        if first:
+            target = alpha * tot
+        idx = jnp.argmax(cum >= target[:, None], axis=1)
+        below = jnp.take_along_axis(
+            cum, jnp.maximum(idx - 1, 0)[:, None], 1)[:, 0]
+        below = jnp.where(idx > 0, below, 0.0)
+        width = span / _RENEW_BINS
+        new_lo = lo + idx.astype(f32) * width
+        return new_lo, new_lo + width, target - below, tot
+
+    target = jnp.zeros((m,), f32)
+    tot0 = None
+    for rnd in range(_RENEW_ROUNDS):
+        lo, hi, target, tot = hist_pass(lo, hi, target, first=(rnd == 0))
+        if tot0 is None:
+            tot0 = tot
+
+    centers = (lo + hi) * 0.5
     new_val = jnp.where(
-        tree.is_leaf & (tot > 0),
+        tree.is_leaf & (tot0 > 0),
         (centers * learning_rate).astype(jnp.float32),
         tree.value,
     )

@@ -137,13 +137,15 @@ def get_leaf_renewal(name: str, alpha: float = 0.9):
     sign-scale gradients make sum(g)/sum(h) leaf values step at the
     learning-rate scale, not the label scale, so unrenewed fits converge
     pathologically slowly). Returns (percentile_alpha, weighted_by_inv_label)
-    — l1/mae/huber: median (huber's gradient clips to ±alpha, so with a
-    small threshold relative to the label scale it degenerates to L1's
-    sign-scale steps); quantile: the objective's alpha; mape: the
-    1/max(|y|,1)-weighted median. The L2 family needs no renewal (its
-    gradients already carry the label scale)."""
+    — l1/mae: median; quantile: the objective's alpha; mape: the
+    1/max(|y|,1)-weighted median. huber is NOT renewed, matching
+    LightGBM (only l1/quantile/mape renew there): with alpha at the
+    residual scale huber is quadratic almost everywhere and the
+    mean-residual leaf value is already correct — callers on wide-scale
+    labels should raise `alpha`, as with LightGBM itself. The L2 family
+    needs no renewal (its gradients already carry the label scale)."""
     key = name.lower()
-    if key in ("l1", "mae", "mean_absolute_error", "regression_l1", "huber"):
+    if key in ("l1", "mae", "mean_absolute_error", "regression_l1"):
         return 0.5, False
     if key == "quantile":
         return float(alpha), False

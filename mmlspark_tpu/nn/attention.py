@@ -17,8 +17,10 @@ Three tiers, one contract (inputs (B, T, H, D), output (B, T, H, D)):
 - ``flash_attention``: a Pallas TPU kernel for the forward hot path —
   the (block_q, block_k) score tile lives only in VMEM, never HBM, with
   the online-softmax running max / denominator / accumulator carried in
-  VMEM scratch across the sequential key-block grid dimension. Forward
-  only (inference / serving); training uses `chunked_attention`.
+  VMEM scratch across the sequential key-block grid dimension.
+  DIFFERENTIABLE via `jax.custom_vjp`: the kernel also emits the per-row
+  logsumexp, and the backward is the standard flash recomputation as a
+  pure-XLA k-block scan (compiles on every backend; O(T) score memory).
 
 The chunked and flash tiers compute scores and the softmax accumulator in
 float32 whatever the input dtype (bf16 inputs stay bf16 through the
@@ -130,7 +132,7 @@ def chunked_attention(q, k, v, causal: bool = False,
 # Pallas flash forward                                                  #
 # --------------------------------------------------------------------- #
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_sc, l_sc, acc_sc, *,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_sc, l_sc, acc_sc, *,
                   block_q, block_k, num_kv, causal, tk_valid, scale):
     import jax.experimental.pallas as pl
 
@@ -176,13 +178,15 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_sc, l_sc, acc_sc, *,
         out = acc_sc[...] / jnp.maximum(l, 1e-30)
         out = jnp.where(l > 0, out, 0.0)
         o_ref[0] = out.astype(o_ref.dtype)
+        # per-row logsumexp, the backward pass's softmax residual;
+        # +inf on fully-masked rows makes exp(s - lse) vanish there
+        lse = jnp.where(
+            l > 0, m_sc[...] + jnp.log(jnp.maximum(l, 1e-30)), jnp.inf)
+        lse_ref[...] = lse.reshape(1, block_q)
 
 
-def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
-                    block_k: int = 128, interpret: bool = False):
-    """Pallas TPU flash-attention FORWARD. Same contract as
-    `dense_attention`; not differentiable — use `chunked_attention` for
-    training. `interpret=True` runs the kernel on CPU for tests."""
+def _flash_fwd_lse(q, k, v, causal, block_q, block_k, interpret):
+    """Pallas forward; returns (out (B,Tq,H,D), lse (B,H,Tq) f32)."""
     import jax.experimental.pallas as pl
     import jax.experimental.pallas.tpu as pltpu
 
@@ -205,7 +209,7 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_k=block_k, num_kv=nk,
         causal=causal, tk_valid=tk, scale=d ** -0.5)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, nq, nk),
         in_specs=[
@@ -213,9 +217,14 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
             pl.BlockSpec((1, block_k, d), lambda bh_, qi, kv: (bh_, kv, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh_, qi, kv: (bh_, kv, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d),
-                               lambda bh_, qi, kv: (bh_, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct(qf.shape, orig_dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh_, qi, kv: (bh_, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bh_, qi, kv: (bh_, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(qf.shape, orig_dtype),
+            jax.ShapeDtypeStruct(qf.shape[:2], jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -225,7 +234,94 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
     )(qf, kf, vf)
 
     out = out.reshape(b, h, out.shape[1], d)   # already orig_dtype via
-    return jnp.moveaxis(out, 1, 2)[:, :tq]     # pallas out_shape
+    out = jnp.moveaxis(out, 1, 2)[:, :tq]      # pallas out_shape
+    lse = lse.reshape(b, h, -1)[:, :, :tq]     # (B, H, Tq)
+    return out, lse
+
+
+def _flash_bwd_xla(q, k, v, out, lse, do, causal, k_chunk):
+    """Flash-attention backward as a pure-XLA scan over k blocks (the
+    standard dV/dK/dQ recomputation driven by the saved logsumexp).
+    Pure XLA by design: it compiles on every backend and avoids the
+    interpret-vs-Mosaic gap the histogram kernels hit on real v5e, while
+    keeping O(T) score memory like the forward."""
+    f32 = jnp.float32
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    scale = d ** -0.5
+    qf = jnp.moveaxis(q, 2, 1).astype(f32)            # (B, H, Tq, D)
+    dof = jnp.moveaxis(do, 2, 1).astype(f32)
+    of = jnp.moveaxis(out, 2, 1).astype(f32)
+    delta = (dof * of).sum(-1)                        # (B, H, Tq)
+
+    k_chunk = min(k_chunk, max(tk, 1))
+    kp_, _ = _pad_seq(k, k_chunk)
+    vp_, _ = _pad_seq(v, k_chunk)
+    kf = jnp.moveaxis(kp_, 2, 1).astype(f32)          # (B, H, Tk+, D)
+    vf = jnp.moveaxis(vp_, 2, 1).astype(f32)
+    nk = kf.shape[2] // k_chunk
+    kr = jnp.moveaxis(kf.reshape(b, h, nk, k_chunk, d), 2, 0)
+    vr = jnp.moveaxis(vf.reshape(b, h, nk, k_chunk, d), 2, 0)
+    kpos = jnp.arange(nk * k_chunk).reshape(nk, k_chunk)
+    qpos = jnp.arange(tq)
+
+    def body(dq_acc, xs):
+        kb, vb, kp = xs                               # (B,H,kc,D), (kc,)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kb,
+                       preferred_element_type=f32) * scale
+        ok = (kp < tk)[None, None, None, :]
+        if causal:
+            ok = ok & (qpos[:, None] >= kp[None, :])[None, None]
+        # lse is +inf on fully-masked rows -> p = 0 there
+        p = jnp.where(ok, jnp.exp(s - lse[..., None]), 0.0)
+        dv_b = jnp.einsum("bhqk,bhqd->bhkd", p, dof,
+                          preferred_element_type=f32)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vb,
+                        preferred_element_type=f32)
+        ds = p * (dp - delta[..., None])
+        dq_acc = dq_acc + jnp.einsum(
+            "bhqk,bhkd->bhqd", ds, kb, preferred_element_type=f32) * scale
+        dk_b = jnp.einsum("bhqk,bhqd->bhkd", ds, qf,
+                          preferred_element_type=f32) * scale
+        return dq_acc, (dk_b, dv_b)
+
+    dq, (dks, dvs) = jax.lax.scan(
+        body, jnp.zeros_like(qf), (kr, vr, kpos))
+    dk = jnp.moveaxis(dks, 0, 2).reshape(b, h, nk * k_chunk, d)[:, :, :tk]
+    dv = jnp.moveaxis(dvs, 0, 2).reshape(b, h, nk * k_chunk, d)[:, :, :tk]
+    return (jnp.moveaxis(dq, 1, 2).astype(q.dtype),
+            jnp.moveaxis(dk, 1, 2).astype(k.dtype),
+            jnp.moveaxis(dv, 1, 2).astype(v.dtype))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_diff(q, k, v, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd_lse(q, k, v, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_diff_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd_lse(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_diff_bwd(causal, block_q, block_k, interpret, res, do):
+    q, k, v, out, lse = res
+    return _flash_bwd_xla(q, k, v, out, lse, do, causal, block_k)
+
+
+_flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
+                    block_k: int = 128, interpret: bool = False):
+    """Pallas TPU flash attention, DIFFERENTIABLE: the forward is the
+    Pallas online-softmax kernel (score tile only in VMEM) and the
+    backward is the standard flash recomputation as a pure-XLA k-block
+    scan driven by the kernel's saved logsumexp. Same contract as
+    `dense_attention`. `interpret=True` runs the forward kernel on CPU
+    for tests."""
+    return _flash_diff(q, k, v, causal, block_q, block_k, interpret)
 
 
 # --------------------------------------------------------------------- #

@@ -182,8 +182,8 @@ class TransformerEncoder(nn.Module):
     max_len: int = 512
     dropout_rate: float = 0.0
     # attention core (nn/attention.py): "dense" (reference math),
-    # "chunked" (O(T) online-softmax scan — long-sequence training),
-    # "flash" (Pallas TPU forward kernel; falls back to chunked off-TPU).
+    # "chunked" (O(T) online-softmax scan), "flash" (Pallas TPU kernel,
+    # differentiable via custom_vjp; falls back to chunked off-TPU).
     # Param trees are identical across impls, so a model trained with one
     # loads and serves with any other.
     attention_impl: str = "dense"

@@ -351,6 +351,24 @@ class TestBooster:
         cover = float((y <= pred).mean())
         assert 0.84 <= cover <= 0.96, cover
 
+    def test_renewal_robust_to_residual_outliers(self):
+        """A single huge-label outlier must not corrupt other leaves'
+        renewed values: per-node brackets + iterative histogram refinement
+        keep each leaf's percentile on its own residual scale (a global
+        256-bin range would put every normal residual into one bin)."""
+        rng = np.random.default_rng(13)
+        n = 2000
+        x = rng.normal(size=(n, 4))
+        y = 3.0 * x[:, 0] + rng.normal(scale=0.5, size=n)
+        y[0] = 1e6                                 # one absurd outlier
+        b = Booster.train(x, y, TrainOptions(
+            objective="l1", num_iterations=40, num_leaves=15,
+            min_data_in_leaf=5, learning_rate=0.1))
+        pred = np.asarray(b.predict(x))
+        mae = float(np.median(np.abs(pred - y)))   # median: ignore y[0]
+        assert mae < 1.0, mae                      # normal rows still fit
+        assert np.isfinite(pred).all()
+
     def test_l1_renewal_mesh_matches_single_device(self, mesh8):
         """The renewal histogram is psummed like the split histograms, so
         the renewed model must be identical on mesh vs single device."""
