@@ -571,49 +571,55 @@ def bench_transformer(peak_tflops: "float | None") -> dict:
     # flash rows degrade individually: a Mosaic rejection of the Pallas
     # kernel on real hardware (the interpret-vs-Mosaic gap the histogram
     # kernel hit on v5e) must cost the flash rows, not the whole family
-    try:
-        fwd_flash_tps, _ = timed_fwd("flash", xb, fwd_batches)
-    except Exception as e:  # noqa: BLE001 — kernel-path insurance
-        print(f"bench: flash fwd failed ({e!r}); row stays null",
-              file=sys.stderr)
-        fwd_flash_tps = None
-    try:
-        long_tps, _ = timed_fwd("flash", toks(long_bs, long_seq),
-                                fwd_batches)
-    except Exception as e:  # noqa: BLE001 — kernel-path insurance
-        print(f"bench: flash long-seq fwd failed ({e!r}); row stays null",
-              file=sys.stderr)
-        long_tps = None
+    def guarded(label, fn):
+        """Run one flash-kernel measurement; a Mosaic rejection on real
+        hardware (the interpret-vs-Mosaic gap) nulls that row only."""
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — kernel-path insurance
+            print(f"bench: {label} failed ({e!r}); row stays null",
+                  file=sys.stderr)
+            return None
+
+    fwd_flash_tps = guarded(
+        "flash fwd", lambda: timed_fwd("flash", xb, fwd_batches)[0])
+    long_tps = guarded(
+        "flash long-seq fwd",
+        lambda: timed_fwd("flash", toks(long_bs, long_seq), fwd_batches)[0])
 
     # training: chunked attention core, all steps fused in one scan dispatch
     m_train = model("chunked", seq)
+    m_train_flash = model("flash", seq)
     xt, yt = toks(bs_train, seq), jnp.asarray(
         rng.integers(0, 8, size=bs_train), jnp.int32)
     tvars = m_train.init(jax.random.PRNGKey(1), xt)
     tx = optax.adamw(1e-4)
     opt0 = tx.init(tvars["params"])
 
-    def step(params, opt_state):
-        def loss_fn(p):
-            logits = m_train.apply({"params": p}, xt, train=True)
-            return optax.softmax_cross_entropy_with_integer_labels(
-                logits.astype(jnp.float32), yt).mean()
+    def make_epoch(mod):
+        def step(params, opt_state):
+            def loss_fn(p):
+                logits = mod.apply({"params": p}, xt, train=True)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits.astype(jnp.float32), yt).mean()
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
 
-    def epoch(params, opt_state):
-        def body(carry, _):
-            p, o = carry
-            p, o, loss = step(p, o)
-            return (p, o), loss
+        def epoch(params, opt_state):
+            def body(carry, _):
+                p, o = carry
+                p, o, loss = step(p, o)
+                return (p, o), loss
 
-        (p, o), losses = jax.lax.scan(
-            body, (params, opt_state), None, length=train_steps)
-        return p, o, losses[-1]
+            (p, o), losses = jax.lax.scan(
+                body, (params, opt_state), None, length=train_steps)
+            return p, o, losses[-1]
 
-    ep = jax.jit(epoch)
+        return jax.jit(epoch)
+
+    ep = make_epoch(m_train)
     out = ep(tvars["params"], opt0)
     jax.block_until_ready(out)
     dt = median_timed(
@@ -623,6 +629,16 @@ def bench_transformer(peak_tflops: "float | None") -> dict:
     train_per_tok = flops_sane(
         sf / (train_steps * bs_train * seq) if sf else None,
         3 * analytic_per_tok(seq), "transformer train")
+
+    # flash-core training (Pallas fwd + custom_vjp XLA bwd)
+    def flash_train():
+        epf = make_epoch(m_train_flash)
+        jax.block_until_ready(epf(tvars["params"], opt0))
+        dtf = median_timed(
+            lambda: jax.block_until_ready(epf(tvars["params"], opt0)))
+        return train_steps * bs_train * seq / dtf
+
+    train_flash_tps = guarded("flash train", flash_train)
 
     measurable = not on_cpu
     fwd_tflops = (fwd_flash_tps * per_tok / 1e12
@@ -636,6 +652,8 @@ def bench_transformer(peak_tflops: "float | None") -> dict:
         "longseq_tokens_per_sec": long_tps if measurable else None,
         "train_tokens_per_sec": train_tps if measurable else None,
         "train_mfu": _mfu(train_tflops, peak_tflops),
+        "train_flash_tokens_per_sec": (
+            train_flash_tps if measurable else None),
         "seq_len": seq,
         "long_seq_len": long_seq,
         "smoke_only": on_cpu,
@@ -835,6 +853,8 @@ def _transformer_extra(transformer: "dict | None") -> dict:
         "transformer_train_tokens_per_sec": _r1(
             transformer, "train_tokens_per_sec"),
         "transformer_train_mfu": g("train_mfu"),
+        "transformer_train_flash_tokens_per_sec": _r1(
+            transformer, "train_flash_tokens_per_sec"),
         "transformer_seq_len": g("seq_len"),
         "transformer_long_seq_len": g("long_seq_len"),
         "transformer_smoke_only": g("smoke_only"),

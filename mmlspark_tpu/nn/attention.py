@@ -338,8 +338,9 @@ class SelfAttention(nn.Module):
     are impl-agnostic.
 
     impl: "dense" (reference math), "chunked" (O(T) scan, differentiable),
-    "flash" (Pallas forward kernel on TPU; off-TPU it transparently uses
-    the chunked tier so the same model file runs everywhere).
+    "flash" (Pallas kernel on TPU, differentiable via custom_vjp; off-TPU
+    it transparently uses the chunked tier so the same model file runs
+    everywhere).
     """
 
     num_heads: int
