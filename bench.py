@@ -345,22 +345,24 @@ def bench_gbdt_large(hbm_peak_gbps: "float | None") -> "dict | None":
     x_all, y_all = make_dataset_wide(n + n_valid, f)
     x, y = x_all[:n], y_all[:n]
     x_valid, y_valid = x_all[n:], y_all[n:]
-    # uint8 bin storage first (4x narrower histogram HBM read — the
-    # dominant stream at this scale); fall back to int32 if the narrow
-    # path fails to compile/run on this chip
-    bin_dtype = "uint8"
-    try:
-        opts = TrainOptions(objective="binary", num_iterations=iters,
-                            num_leaves=leaves, learning_rate=0.1,
-                            bin_dtype=bin_dtype)
-        Booster.train(x, y, opts)                    # compile warm-up
-    except Exception as e:  # noqa: BLE001 — opt-in fast path, safe default
-        print(f"bench: uint8 bin path failed ({e!r}); using int32",
-              file=sys.stderr)
-        bin_dtype = "int32"
-        opts = TrainOptions(objective="binary", num_iterations=iters,
-                            num_leaves=leaves, learning_rate=0.1)
-        Booster.train(x, y, opts)                    # compile warm-up
+    # fast paths first: uint8 bin storage (4x narrower histogram HBM read)
+    # + on-device binning (the host binary search costs ~2 s at this scale
+    # on a 1-core host); fall back stepwise if either fails on this chip
+    last_exc = None
+    for bin_dtype, dev_bin in (("uint8", True), ("uint8", False),
+                               ("int32", False)):
+        try:
+            opts = TrainOptions(objective="binary", num_iterations=iters,
+                                num_leaves=leaves, learning_rate=0.1,
+                                bin_dtype=bin_dtype, device_binning=dev_bin)
+            Booster.train(x, y, opts)                # compile warm-up
+            break
+        except Exception as e:  # noqa: BLE001 — opt-in fast paths
+            last_exc = e
+            print(f"bench: bin path (dtype={bin_dtype}, device={dev_bin}) "
+                  f"failed ({e!r}); stepping down", file=sys.stderr)
+    else:
+        raise RuntimeError("all binning paths failed") from last_exc
     t0 = time.perf_counter()
     booster = Booster.train(x, y, opts)
     elapsed = time.perf_counter() - t0
@@ -376,6 +378,7 @@ def bench_gbdt_large(hbm_peak_gbps: "float | None") -> "dict | None":
         "acc": acc,
         "valid_auc": valid_auc,
         "bin_dtype": bin_dtype,
+        "device_binning": dev_bin,
         "modeled_hbm_gbps": gbps,
         "modeled_hbm_frac_of_peak": (
             round(gbps / hbm_peak_gbps, 4) if hbm_peak_gbps else None
@@ -965,6 +968,8 @@ def _run_suite(platform: str) -> dict:
                 gbdt_large["modeled_hbm_frac_of_peak"] if gbdt_large else None),
             "gbdt_large_bin_dtype": (
                 gbdt_large.get("bin_dtype") if gbdt_large else None),
+            "gbdt_large_device_binning": (
+                gbdt_large.get("device_binning") if gbdt_large else None),
             "gbdt_dart_rows_per_sec": round(
                 dart["rows_per_sec"], 1) if dart else None,
             "gbdt_dart_fit_seconds": round(

@@ -40,6 +40,12 @@ class BinMapper:
 
     max_bin: int = 255
     categorical_indexes: tuple[int, ...] = ()
+    # LightGBM `bin_construct_sample_cnt` (default 200000): boundaries are
+    # sketched from a deterministic per-column sample once a column exceeds
+    # this many finite values — the sketch cost stops scaling with n.
+    # Categorical frequency maps always use the full column (their cost is
+    # one np.unique, and sampling could drop rare categories entirely).
+    bin_construct_sample_cnt: int = 200_000
     # fitted state
     num_features: int = 0
     num_bins: np.ndarray = field(default_factory=lambda: np.zeros(0, np.int32))
@@ -77,6 +83,13 @@ class BinMapper:
                 self.category_maps[j] = {float(v): i + 1 for i, v in enumerate(kept)}
                 nbins[j] = len(kept) + 1
                 continue
+            sample_cnt = int(self.bin_construct_sample_cnt)
+            if 0 < sample_cnt < len(finite):
+                # deterministic per-column sample: dense and CSR fits see
+                # identical columns, so the sketch stays path-independent
+                idx = np.random.default_rng(1 + j).choice(
+                    len(finite), size=sample_cnt, replace=False)
+                finite = finite[np.sort(idx)]
             # canonicalize -0.0 -> +0.0: CSR inputs drop signed zeros, and
             # boundaries must serialize identically for sparse/dense parity
             uniq = np.unique(finite + 0.0)
@@ -171,6 +184,53 @@ class BinMapper:
     def fit_transform(self, x: np.ndarray) -> np.ndarray:
         return self.fit(x).transform(x)
 
+    def transform_device(self, x: np.ndarray, chunk: int = 8192):
+        """Numeric binning ON DEVICE: a jitted chunked compare-count that
+        returns the (n, F) int32 bin matrix as a device array.
+
+        Rationale: the host transform is a serial binary search per cell
+        (~2 s for 1M x 28 on a single host core — half the end-to-end fit
+        cost at Higgs scale), while the device does the equivalent
+        compare-reduction in microseconds per chunk. Comparisons run in
+        float32 (TPU-native), so values that straddle a boundary only
+        distinguishable in float64 may land one bin off versus the host
+        path — opt-in (`TrainOptions.device_binning`) for exactly that
+        reason. Categorical features are not supported here."""
+        import jax
+        import jax.numpy as jnp
+
+        if self.category_maps:
+            raise ValueError(
+                "device binning does not support categorical features")
+        x = np.asarray(x, dtype=np.float32)
+        n, f = x.shape
+        if f != self.num_features:
+            raise ValueError(f"expected {self.num_features} features, got {f}")
+        nb_max = self.total_bins
+        ub = jnp.asarray(
+            self.upper_bounds[:, 1:max(nb_max, 2)], jnp.float32)  # (F, B-1)
+        nb = jnp.asarray(self.num_bins, jnp.int32)                # (F,)
+        pad = (-n) % chunk
+        if pad:
+            x = np.concatenate([x, np.zeros((pad, f), np.float32)])
+        nc = (n + pad) // chunk
+
+        @jax.jit
+        def bin_all(xd):
+            def body(_, xc):                                      # (ch, F)
+                # searchsorted(ub, v, 'left') == count(ub < v); the inf
+                # padding past each feature's real boundaries never counts
+                cnt = (xc[:, :, None] > ub[None]).sum(-1).astype(jnp.int32)
+                b = jnp.clip(cnt + 1, 1, jnp.maximum(nb[None] - 1, 1))
+                b = jnp.where(nb[None] <= 1, 0, b)
+                b = jnp.where(jnp.isnan(xc), MISSING_BIN, b)
+                return None, b
+
+            _, out = jax.lax.scan(body, None, xd.reshape(nc, chunk, f))
+            return out.reshape(nc * chunk, f)
+
+        return bin_all(jnp.asarray(x))[:n]
+
     def bin_to_value(self, feature: int, bin_idx: int) -> float:
         """Raw-value threshold for 'go left if x <= t' at a numeric bin split.
 
@@ -183,6 +243,7 @@ class BinMapper:
     def to_dict(self) -> dict:
         return {
             "max_bin": self.max_bin,
+            "bin_construct_sample_cnt": self.bin_construct_sample_cnt,
             "categorical_indexes": list(self.categorical_indexes),
             "num_features": self.num_features,
             "num_bins": self.num_bins.tolist(),
@@ -195,6 +256,8 @@ class BinMapper:
         bm = BinMapper(
             max_bin=int(d["max_bin"]),
             categorical_indexes=tuple(d.get("categorical_indexes", ())),
+            bin_construct_sample_cnt=int(
+                d.get("bin_construct_sample_cnt", 200_000)),
         )
         bm.num_features = int(d["num_features"])
         bm.num_bins = np.asarray(d["num_bins"], dtype=np.int32)

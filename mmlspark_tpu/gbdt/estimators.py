@@ -70,6 +70,8 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
     validation_fraction = Param(0.0, "fraction of rows held out for early stopping", ptype=float)
     categorical_slot_indexes = Param((), "indexes of categorical feature slots", ptype=(list, tuple))
     bin_dtype = Param("int32", "device bin-matrix dtype: int32 | uint8 (4x less histogram HBM read)", ptype=str)
+    device_binning = Param(False, "bin the training matrix on device (f32 compares; numeric features only)", ptype=bool)
+    bin_construct_sample_cnt = Param(200_000, "rows sampled per column for bin-boundary construction (0 = all)", ptype=int)
     cat_smooth = Param(10.0, "categorical smoothing for the sorted-subset split order", ptype=float)
     cat_l2 = Param(10.0, "extra L2 regularization on categorical splits", ptype=float)
     max_cat_threshold = Param(32, "max categories on the smaller side of a categorical split", ptype=int)
@@ -121,6 +123,8 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
             early_stopping_round=self.get("early_stopping_round"),
             categorical_indexes=tuple(self.get("categorical_slot_indexes") or ()),
             bin_dtype=self.get("bin_dtype"),
+            device_binning=self.get("device_binning"),
+            bin_construct_sample_cnt=self.get("bin_construct_sample_cnt"),
             cat_smooth=self.get("cat_smooth"),
             cat_l2=self.get("cat_l2"),
             max_cat_threshold=self.get("max_cat_threshold"),

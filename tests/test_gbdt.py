@@ -65,6 +65,36 @@ class TestBinMapper:
             assert (np.diff(b[order, j]) >= 0).all()
         assert b.min() >= 1  # no NaNs -> nothing in the missing bin
 
+    def test_sampled_fit_deterministic_and_close(self):
+        """bin_construct_sample_cnt (LightGBM default 200k): boundaries
+        come from a deterministic per-column sample, so two fits agree
+        bit-wise and stay close to the full-data sketch."""
+        rng = np.random.default_rng(5)
+        x = rng.normal(size=(50_000, 3))
+        a = BinMapper(max_bin=64, bin_construct_sample_cnt=10_000).fit(x)
+        b = BinMapper(max_bin=64, bin_construct_sample_cnt=10_000).fit(x)
+        np.testing.assert_array_equal(a.upper_bounds, b.upper_bounds)
+        full = BinMapper(max_bin=64, bin_construct_sample_cnt=0).fit(x)
+        fin = np.isfinite(full.upper_bounds[:, 1:64])
+        shift = np.abs(a.upper_bounds[:, 1:64] - full.upper_bounds[:, 1:64])
+        assert float(shift[fin].max()) < 0.2  # sketch, not drift
+
+    def test_device_binning_matches_host(self):
+        rng = np.random.default_rng(6)
+        x = rng.normal(size=(5000, 4))
+        x[10, 0], x[11, 1], x[12, 2] = np.nan, np.inf, -np.inf
+        bm = BinMapper(max_bin=32).fit(x)
+        host = bm.transform(x)
+        dev = np.asarray(bm.transform_device(x, chunk=512))
+        # f32 compare may move boundary-straddlers by one bin; semantics
+        # (NaN->0, +/-inf by comparison) must match exactly
+        assert (host == dev).mean() > 0.999
+        assert dev[10, 0] == 0
+        assert dev[11, 1] == host[11, 1] and dev[12, 2] == host[12, 2]
+        with pytest.raises(ValueError, match="categorical"):
+            BinMapper(max_bin=8, categorical_indexes=(0,)).fit(
+                np.abs(x)).transform_device(np.abs(x))
+
     def test_missing_goes_to_bin0(self):
         x = np.array([[1.0], [np.nan], [2.0]])
         bm = BinMapper(max_bin=4).fit(x)
