@@ -369,6 +369,25 @@ def bench_gbdt_large(hbm_peak_gbps: "float | None") -> "dict | None":
     pred = booster.predict(x[:65536])
     acc = float(((pred > 0.5) == (y[:65536] > 0.5)).mean())
     valid_auc = _auc(y_valid, np.asarray(booster.predict(x_valid)))
+
+    # batch scoring throughput — the reference predicts ONE ROW PER JNI
+    # CALL (LightGBMBooster.scala:38-113, SURVEY.md §3.1's named perf
+    # sink); here it is one jitted blocked traversal over all 1M rows.
+    # Two tiers, like the runner family: end-to-end (host binning + h2d +
+    # traversal + d2h; predict_raw synchronizes internally) and
+    # device-resident (binned matrix already on device).
+    import jax.numpy as jnp
+
+    booster.predict_raw(x, device="device")   # compile+warm at this shape
+    dt = median_timed(lambda: booster.predict_raw(x, device="device"))
+    predict_e2e_rows = n / dt
+    binned_dev = jnp.asarray(
+        booster.bin_mapper.transform(x).astype(np.int32))
+    traverse = booster._traverse_fn()
+    jax.block_until_ready(traverse(binned_dev))      # compile + warm
+    dt = median_timed(
+        lambda: jax.block_until_ready(traverse(binned_dev)))
+    predict_resident_rows = n / dt
     bin_bytes = 1 if bin_dtype == "uint8" else 4
     per_pass = n * f * bin_bytes + n * 4 * 2
     gbps = iters * (leaves - 1) * per_pass / 1e9 / elapsed
@@ -379,6 +398,8 @@ def bench_gbdt_large(hbm_peak_gbps: "float | None") -> "dict | None":
         "valid_auc": valid_auc,
         "bin_dtype": bin_dtype,
         "device_binning": dev_bin,
+        "predict_rows_per_sec": predict_e2e_rows,
+        "predict_resident_rows_per_sec": predict_resident_rows,
         "modeled_hbm_gbps": gbps,
         "modeled_hbm_frac_of_peak": (
             round(gbps / hbm_peak_gbps, 4) if hbm_peak_gbps else None
@@ -970,6 +991,10 @@ def _run_suite(platform: str) -> dict:
                 gbdt_large.get("bin_dtype") if gbdt_large else None),
             "gbdt_large_device_binning": (
                 gbdt_large.get("device_binning") if gbdt_large else None),
+            "gbdt_predict_rows_per_sec": _r1(
+                gbdt_large, "predict_rows_per_sec"),
+            "gbdt_predict_resident_rows_per_sec": _r1(
+                gbdt_large, "predict_resident_rows_per_sec"),
             "gbdt_dart_rows_per_sec": round(
                 dart["rows_per_sec"], 1) if dart else None,
             "gbdt_dart_fit_seconds": round(
