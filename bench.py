@@ -75,10 +75,12 @@ _FORCE_CPU_ENV = "MMLSPARK_TPU_BENCH_FORCE_CPU"
 # the process (signals only fire between bytecodes), so the watchdog must
 # live in a parent that never touches the device.
 _SKIP_TRAINER_ENV = "MMLSPARK_TPU_BENCH_SKIP_TRAINER"
+_SKIP_LARGE_ENV = "MMLSPARK_TPU_BENCH_SKIP_GBDT_LARGE"
 _SKIP_TRANSFORMER_ENV = "MMLSPARK_TPU_BENCH_SKIP_TRANSFORMER"
 _CORE_TIMEOUT_ENV = "MMLSPARK_TPU_BENCH_CORE_TIMEOUT"
 _TRAINER_TIMEOUT_ENV = "MMLSPARK_TPU_BENCH_TRAINER_TIMEOUT"
 _TRANSFORMER_TIMEOUT_ENV = "MMLSPARK_TPU_BENCH_TRANSFORMER_TIMEOUT"
+_LARGE_TIMEOUT_ENV = "MMLSPARK_TPU_BENCH_GBDT_LARGE_TIMEOUT"
 
 
 # --------------------------------------------------------------------- #
@@ -862,6 +864,30 @@ def _trainer_extra(trainer: "dict | None") -> dict:
     }
 
 
+def _gbdt_large_extra(gbdt_large: "dict | None") -> dict:
+    """Higgs-scale-family fields of the JSON line — shared by _run_suite
+    and the orchestrator's post-hoc merge of the gbdt_large child."""
+    g = (gbdt_large or {}).get
+    return {
+        "gbdt_large_rows_per_sec": _r1(gbdt_large, "rows_per_sec"),
+        "gbdt_large_fit_seconds": (
+            round(g("fit_seconds"), 3) if g("fit_seconds") else None),
+        "gbdt_large_train_acc": (
+            round(g("acc"), 4) if g("acc") is not None else None),
+        "gbdt_large_valid_auc": (
+            round(g("valid_auc"), 4) if g("valid_auc") is not None else None),
+        "gbdt_large_modeled_hbm_gbps": (
+            round(g("modeled_hbm_gbps"), 2)
+            if g("modeled_hbm_gbps") else None),
+        "gbdt_large_modeled_hbm_frac_of_peak": g("modeled_hbm_frac_of_peak"),
+        "gbdt_large_bin_dtype": g("bin_dtype"),
+        "gbdt_large_device_binning": g("device_binning"),
+        "gbdt_predict_rows_per_sec": _r1(gbdt_large, "predict_rows_per_sec"),
+        "gbdt_predict_resident_rows_per_sec": _r1(
+            gbdt_large, "predict_resident_rows_per_sec"),
+    }
+
+
 def _transformer_extra(transformer: "dict | None") -> dict:
     """Transformer fields of the JSON line — shared by _run_suite and the
     orchestrator's post-hoc merge of the transformer child's output."""
@@ -901,11 +927,17 @@ def _run_suite(platform: str) -> dict:
 
         set_kernel_mode("xla")
         gbdt = bench_gbdt(peak_gbps)
-    try:
-        gbdt_large = bench_gbdt_large(peak_gbps)
-    except Exception as e:  # noqa: BLE001 — scale config is auxiliary
-        print(f"bench: large gbdt bench failed ({e!r})", file=sys.stderr)
+    if os.environ.get(_SKIP_LARGE_ENV):
+        # orchestrated run: the Higgs-scale family (a 1M-row program that
+        # has never compiled on real hardware) runs in its own watched
+        # child so a compile hang cannot cost the headline metric
         gbdt_large = None
+    else:
+        try:
+            gbdt_large = bench_gbdt_large(peak_gbps)
+        except Exception as e:  # noqa: BLE001 — scale config is auxiliary
+            print(f"bench: large gbdt bench failed ({e!r})", file=sys.stderr)
+            gbdt_large = None
     try:
         dart = bench_gbdt_dart()
     except Exception as e:  # noqa: BLE001 — mode family is auxiliary
@@ -973,28 +1005,7 @@ def _run_suite(platform: str) -> dict:
             "gbdt_baseline_rows_per_sec": BASELINE_ROWS_PER_SEC,
             "gbdt_modeled_hbm_gbps": round(gbdt["modeled_hbm_gbps"], 2),
             "gbdt_modeled_hbm_frac_of_peak": gbdt["modeled_hbm_frac_of_peak"],
-            "gbdt_large_rows_per_sec": round(
-                gbdt_large["rows_per_sec"], 1) if gbdt_large else None,
-            "gbdt_large_fit_seconds": round(
-                gbdt_large["fit_seconds"], 3) if gbdt_large else None,
-            "gbdt_large_train_acc": round(
-                gbdt_large["acc"], 4) if gbdt_large else None,
-            "gbdt_large_valid_auc": (
-                round(gbdt_large["valid_auc"], 4)
-                if gbdt_large and gbdt_large.get("valid_auc") is not None
-                else None),
-            "gbdt_large_modeled_hbm_gbps": round(
-                gbdt_large["modeled_hbm_gbps"], 2) if gbdt_large else None,
-            "gbdt_large_modeled_hbm_frac_of_peak": (
-                gbdt_large["modeled_hbm_frac_of_peak"] if gbdt_large else None),
-            "gbdt_large_bin_dtype": (
-                gbdt_large.get("bin_dtype") if gbdt_large else None),
-            "gbdt_large_device_binning": (
-                gbdt_large.get("device_binning") if gbdt_large else None),
-            "gbdt_predict_rows_per_sec": _r1(
-                gbdt_large, "predict_rows_per_sec"),
-            "gbdt_predict_resident_rows_per_sec": _r1(
-                gbdt_large, "predict_resident_rows_per_sec"),
+            **_gbdt_large_extra(gbdt_large),
             "gbdt_dart_rows_per_sec": round(
                 dart["rows_per_sec"], 1) if dart else None,
             "gbdt_dart_fit_seconds": round(
@@ -1091,6 +1102,23 @@ def _family_solo_main(bench_fn, label: str) -> None:
     print(json.dumps(out))
 
 
+def _bench_gbdt_large_solo(_peak_tflops):
+    """Solo-family adapter: the large family keys off HBM peak, not FLOPs.
+    Mirrors the core suite's kernel-mode insurance — if the Pallas
+    histogram kernel fails on this chip, retry under the XLA kernel
+    rather than losing the family."""
+    _, _, peak_gbps = chip_peaks()
+    try:
+        return bench_gbdt_large(peak_gbps)
+    except Exception as e:  # noqa: BLE001 — kernel-mode insurance
+        print(f"bench: gbdt_large failed under auto kernel mode ({e!r}); "
+              "retrying with kernel mode 'xla'", file=sys.stderr)
+        from mmlspark_tpu.core.kernels import set_kernel_mode
+
+        set_kernel_mode("xla")
+        return bench_gbdt_large(peak_gbps)
+
+
 def _run_watched(args: list, env: dict,
                  timeout: float) -> "tuple[int | None, str, str]":
     """Run a child in its own process group and return (rc, stdout, stderr);
@@ -1133,6 +1161,8 @@ def main() -> None:
             return _family_solo_main(bench_trainer, "trainer")
         if family == "transformer":
             return _family_solo_main(bench_transformer, "transformer")
+        if family == "gbdt_large":
+            return _family_solo_main(_bench_gbdt_large_solo, "gbdt_large")
         raise SystemExit(f"bench: unknown family {family!r}")
 
     # Orchestrator: never imports jax (the tunneled TPU is single-process;
@@ -1145,12 +1175,14 @@ def main() -> None:
     solo_timeouts = {
         "transformer": float(os.environ.get(_TRANSFORMER_TIMEOUT_ENV, 900)),
         "trainer": float(os.environ.get(_TRAINER_TIMEOUT_ENV, 900)),
+        "gbdt_large": float(os.environ.get(_LARGE_TIMEOUT_ENV, 1200)),
     }
 
     line = None
     core_cpu = False
     core_env = dict(os.environ, **{_SKIP_TRAINER_ENV: "1",
-                                   _SKIP_TRANSFORMER_ENV: "1"})
+                                   _SKIP_TRANSFORMER_ENV: "1",
+                                   _SKIP_LARGE_ENV: "1"})
     for forced in (False, True):
         env = dict(core_env, **({_FORCE_CPU_ENV: "1"} if forced else {}))
         rc, out, err = _run_watched(
@@ -1176,7 +1208,8 @@ def main() -> None:
         solo_env[_FORCE_CPU_ENV] = "1"
     # cap each child's probe retries below its own timeout
     solo_env.setdefault("MMLSPARK_TPU_BENCH_PROBE_ATTEMPTS", "2")
-    merges = {"transformer": _transformer_extra, "trainer": _trainer_extra}
+    merges = {"transformer": _transformer_extra, "trainer": _trainer_extra,
+              "gbdt_large": _gbdt_large_extra}
     for family, to_extra in merges.items():
         timeout = solo_timeouts[family]
         rc, out, err = _run_watched(
