@@ -269,6 +269,20 @@ def _auc(y_true: np.ndarray, scores: np.ndarray) -> "float | None":
 N_VALID = 8192
 
 
+def _with_xla_kernel_retry(fn, label):
+    """Run a GBDT family; if the Pallas histogram kernel fails on this
+    chip, retry once under the XLA kernel rather than losing the family."""
+    try:
+        return fn()
+    except Exception as e:  # noqa: BLE001 — kernel-mode insurance
+        print(f"bench: {label} failed under auto kernel mode ({e!r}); "
+              "retrying with kernel mode 'xla'", file=sys.stderr)
+        from mmlspark_tpu.core.kernels import set_kernel_mode
+
+        set_kernel_mode("xla")
+        return fn()
+
+
 def bench_gbdt(hbm_peak_gbps: "float | None") -> dict:
     from mmlspark_tpu.gbdt.booster import Booster, TrainOptions
 
@@ -871,14 +885,15 @@ def _gbdt_large_extra(gbdt_large: "dict | None") -> dict:
     return {
         "gbdt_large_rows_per_sec": _r1(gbdt_large, "rows_per_sec"),
         "gbdt_large_fit_seconds": (
-            round(g("fit_seconds"), 3) if g("fit_seconds") else None),
+            round(g("fit_seconds"), 3)
+            if g("fit_seconds") is not None else None),
         "gbdt_large_train_acc": (
             round(g("acc"), 4) if g("acc") is not None else None),
         "gbdt_large_valid_auc": (
             round(g("valid_auc"), 4) if g("valid_auc") is not None else None),
         "gbdt_large_modeled_hbm_gbps": (
             round(g("modeled_hbm_gbps"), 2)
-            if g("modeled_hbm_gbps") else None),
+            if g("modeled_hbm_gbps") is not None else None),
         "gbdt_large_modeled_hbm_frac_of_peak": g("modeled_hbm_frac_of_peak"),
         "gbdt_large_bin_dtype": g("bin_dtype"),
         "gbdt_large_device_binning": g("device_binning"),
@@ -914,19 +929,11 @@ def _transformer_extra(transformer: "dict | None") -> dict:
 def _run_suite(platform: str) -> dict:
     chip, peak_tflops, peak_gbps = chip_peaks()
 
-    try:
-        gbdt = bench_gbdt(peak_gbps)
-    except Exception as e:  # noqa: BLE001 — kernel-mode insurance
-        # the Pallas histogram kernel is selected automatically on TPU; if
-        # it fails to compile/run on this chip, fall back to the XLA kernel
-        # rather than losing the benchmark. (A DEAD backend will fail again
-        # below and trip the whole-suite CPU fallback in main().)
-        print(f"bench: gbdt failed under auto kernel mode ({e!r}); "
-              "retrying with kernel mode 'xla'", file=sys.stderr)
-        from mmlspark_tpu.core.kernels import set_kernel_mode
-
-        set_kernel_mode("xla")
-        gbdt = bench_gbdt(peak_gbps)
+    # the Pallas histogram kernel is selected automatically on TPU; if it
+    # fails to compile/run on this chip, fall back to the XLA kernel
+    # rather than losing the benchmark. (A DEAD backend will fail again
+    # below and trip the whole-suite CPU fallback in main().)
+    gbdt = _with_xla_kernel_retry(lambda: bench_gbdt(peak_gbps), "gbdt")
     if os.environ.get(_SKIP_LARGE_ENV):
         # orchestrated run: the Higgs-scale family (a 1M-row program that
         # has never compiled on real hardware) runs in its own watched
@@ -1108,15 +1115,8 @@ def _bench_gbdt_large_solo(_peak_tflops):
     histogram kernel fails on this chip, retry under the XLA kernel
     rather than losing the family."""
     _, _, peak_gbps = chip_peaks()
-    try:
-        return bench_gbdt_large(peak_gbps)
-    except Exception as e:  # noqa: BLE001 — kernel-mode insurance
-        print(f"bench: gbdt_large failed under auto kernel mode ({e!r}); "
-              "retrying with kernel mode 'xla'", file=sys.stderr)
-        from mmlspark_tpu.core.kernels import set_kernel_mode
-
-        set_kernel_mode("xla")
-        return bench_gbdt_large(peak_gbps)
+    return _with_xla_kernel_retry(
+        lambda: bench_gbdt_large(peak_gbps), "gbdt_large")
 
 
 def _run_watched(args: list, env: dict,

@@ -1,0 +1,114 @@
+"""Unit gates for bench.py's artifact-shaping helpers.
+
+The bench is the round's judged artifact; its orchestration helpers
+(JSON-line extraction, family-field merge, FLOP sanity, timing) must
+behave under every degraded outcome (missing family, null child output,
+inflated cost analysis) — these are pure-python fast checks.
+"""
+
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+
+import bench  # noqa: E402
+
+
+class TestLastJsonLine:
+    def test_picks_last_valid_json(self):
+        out = 'noise\n{"a": 1}\nlog line\n{"b": 2}\n'
+        assert bench._last_json_line(out) == {"b": 2}
+
+    def test_null_child_output_parses_to_none(self):
+        # a CPU-forced solo child prints "null" (family skipped); the
+        # orchestrator must treat that as "no result", not crash
+        assert bench._last_json_line("null\n") is None
+
+    def test_no_json_returns_none(self):
+        assert bench._last_json_line("no json here\n") is None
+        assert bench._last_json_line("") is None
+
+
+class TestFamilyExtras:
+    def test_gbdt_large_extra_none_gives_all_null(self):
+        extra = bench._gbdt_large_extra(None)
+        assert set(k for k in extra) == {
+            "gbdt_large_rows_per_sec", "gbdt_large_fit_seconds",
+            "gbdt_large_train_acc", "gbdt_large_valid_auc",
+            "gbdt_large_modeled_hbm_gbps",
+            "gbdt_large_modeled_hbm_frac_of_peak", "gbdt_large_bin_dtype",
+            "gbdt_large_device_binning", "gbdt_predict_rows_per_sec",
+            "gbdt_predict_resident_rows_per_sec",
+        }
+        assert all(v is None for v in extra.values())
+
+    def test_gbdt_large_extra_populated(self):
+        extra = bench._gbdt_large_extra({
+            "rows_per_sec": 123456.78, "fit_seconds": 4.2, "acc": 0.91,
+            "valid_auc": 0.87, "modeled_hbm_gbps": 55.5,
+            "modeled_hbm_frac_of_peak": 0.068, "bin_dtype": "uint8",
+            "device_binning": True, "predict_rows_per_sec": 1e6,
+            "predict_resident_rows_per_sec": 5e6,
+        })
+        assert extra["gbdt_large_rows_per_sec"] == 123456.8
+        assert extra["gbdt_large_train_acc"] == 0.91
+        assert extra["gbdt_large_bin_dtype"] == "uint8"
+        assert extra["gbdt_predict_resident_rows_per_sec"] == 5e6
+
+    def test_trainer_extra_nulls_on_none(self):
+        extra = bench._trainer_extra(None)
+        assert extra["trainer_images_per_sec"] is None
+        assert extra["trainer_vs_baseline"] is None
+
+    def test_transformer_extra_nulls_on_none(self):
+        extra = bench._transformer_extra(None)
+        assert extra["transformer_train_flash_tokens_per_sec"] is None
+        assert extra["transformer_fwd_mfu"] is None
+
+    def test_merge_overrides_core_nulls(self):
+        line = {"extra": dict(bench._gbdt_large_extra(None))}
+        line["extra"].update(bench._gbdt_large_extra(
+            {"rows_per_sec": 10.0}))
+        assert line["extra"]["gbdt_large_rows_per_sec"] == 10.0
+
+
+class TestMeasurementHonesty:
+    def test_flops_sane_rejects_inflated_count(self, capsys):
+        # an 8x padded-conv inflation must fall back to the analytic count
+        assert bench.flops_sane(8e9, 1e9, "t") == 1e9
+        assert "using analytic" in capsys.readouterr().err
+
+    def test_flops_sane_accepts_close_count(self):
+        assert bench.flops_sane(1.2e9, 1e9) == 1.2e9
+
+    def test_flops_sane_handles_missing_sides(self):
+        assert bench.flops_sane(None, 2.0) == 2.0
+        assert bench.flops_sane(3.0, None) == 3.0
+
+    def test_mfu(self):
+        assert bench._mfu(98.5, 197.0) == 0.5
+        assert bench._mfu(None, 197.0) is None
+        assert bench._mfu(5.0, None) is None
+
+    def test_median_timed_is_median(self, monkeypatch):
+        calls = iter([0.0, 10.0, 10.0, 11.0, 11.0, 11.5])
+        monkeypatch.setattr(bench.time, "perf_counter",
+                            lambda: next(calls))
+        # deltas: 10, 1, 0.5 -> median 1
+        assert bench.median_timed(lambda: None, reps=3) == pytest.approx(1.0)
+
+
+class TestChipModel:
+    def test_chip_peaks_on_cpu(self):
+        kind, tflops, gbps = bench.chip_peaks()
+        assert tflops is None and gbps is None  # tests run on CPU backend
+
+    def test_known_chip_table_order(self):
+        # "v5 lite" must match before the bare "v5" row (v5e vs v5p peaks)
+        keys = [k for k, _ in bench._CHIP_PEAKS]
+        assert keys.index("v5 lite") < keys.index("v5")
+        peaks = dict(bench._CHIP_PEAKS)
+        assert peaks["v5 lite"] == (197.0, 819.0)
+        assert np.isfinite(peaks["v5p"][0])
