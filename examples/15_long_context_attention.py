@@ -1,0 +1,77 @@
+"""Long-context attention, single device to sequence-parallel mesh.
+
+The reference has no sequence models at all (SURVEY.md §5.7); this build
+treats long context as first-class. One checkpoint's worth of q/k/v runs
+through every tier and they all agree:
+
+1. single-device tiers — dense reference math, the chunked O(T)
+   online-softmax scan, and the Pallas flash kernel (differentiable; on
+   this CPU example the kernel runs in interpret mode, on TPU it is the
+   compiled kernel);
+2. sequence-parallel tiers on an 8-virtual-device mesh — ring attention
+   (K/V blocks rotate over the seq axis via ppermute, online-softmax
+   state carried across hops) and Ulysses (two all_to_alls trade seq
+   shards for head shards, exact attention in between);
+3. a gradient through the chunked tier — the O(T)-memory training path
+   whose score tiles never exceed (q_chunk, k_chunk) regardless of T
+   (at this demo's T=512 dense is still fine; the tier exists for the
+   T≫10k regime where a (T, T) score matrix stops fitting).
+"""
+
+import _backend  # noqa: F401 — honors JAX_PLATFORMS=cpu + 8 virtual devices
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from mmlspark_tpu.nn.attention import (  # noqa: E402
+    chunked_attention,
+    dense_attention,
+    flash_attention,
+)
+from mmlspark_tpu.parallel import (  # noqa: E402
+    make_mesh,
+    make_ring_attention,
+    make_ulysses_attention,
+)
+
+
+def main():
+    b, t, h, d = 2, 512, 8, 32
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.normal(size=(b, t, h, d)), jnp.float32)
+               for _ in range(3))
+
+    # 1. single-device tiers agree
+    ref = dense_attention(q, k, v, causal=True)
+    ch = chunked_attention(q, k, v, causal=True, q_chunk=128, k_chunk=128)
+    on_tpu = jax.default_backend() == "tpu"
+    fl = flash_attention(q, k, v, causal=True, interpret=not on_tpu)
+    np.testing.assert_allclose(ch, ref, atol=2e-4, rtol=2e-4)
+    np.testing.assert_allclose(fl, ref, atol=2e-4, rtol=2e-4)
+    print(f"single-device tiers agree at T={t} "
+          f"(flash {'compiled' if on_tpu else 'interpret'})")
+
+    # 2. sequence-parallel tiers: T sharded over the mesh's dedicated
+    # SEQ axis (so real data parallelism can coexist on its own axis)
+    from mmlspark_tpu.parallel.mesh import SEQ_AXIS
+
+    mesh = make_mesh(n_data=1, n_seq=len(jax.devices()))
+    ring = make_ring_attention(mesh, SEQ_AXIS, causal=True, local_chunk=32)
+    uly = make_ulysses_attention(mesh, SEQ_AXIS, causal=True)
+    np.testing.assert_allclose(ring(q, k, v), ref, atol=2e-4, rtol=2e-4)
+    np.testing.assert_allclose(uly(q, k, v), ref, atol=2e-4, rtol=2e-4)
+    print(f"ring + Ulysses agree over a {len(jax.devices())}-device "
+          f"seq mesh (T_local={t // len(jax.devices())})")
+
+    # 3. gradient through the O(T)-memory tier
+    def loss(q):
+        return (chunked_attention(q, k, v, causal=True) ** 2).sum()
+
+    g = jax.grad(loss)(q)
+    assert g.shape == q.shape and bool(jnp.isfinite(g).all())
+    print("gradient through the chunked tier: finite, shape", g.shape)
+
+
+if __name__ == "__main__":
+    main()
