@@ -112,9 +112,13 @@ def chunked_attention(q, k, v, causal: bool = False,
                 preferred_element_type=jnp.float32)
             return (m_new, l, acc), None
 
-        m0 = jnp.full((b, h, q_chunk), _NEG_INF, jnp.float32)
-        l0 = jnp.zeros((b, h, q_chunk), jnp.float32)
-        a0 = jnp.zeros((b, h, q_chunk, d), jnp.float32)
+        # + 0*qb: the carry inherits qb's type — under shard_map (the
+        # Ulysses local core) that includes the varying-over-seq-axis
+        # tag, which a plain zeros/full init would lack
+        zvar = 0.0 * qb.astype(jnp.float32).transpose(0, 2, 1, 3)
+        m0 = zvar[..., 0] + _NEG_INF                      # (B, H, qc)
+        l0 = zvar[..., 0]
+        a0 = zvar                                         # (B, H, qc, D)
         (m, l, acc), _ = jax.lax.scan(body, (m0, l0, a0),
                                       (kr, vr, kpos, k_valid))
         out = acc / jnp.maximum(l, 1e-30)[..., None]

@@ -174,11 +174,20 @@ def ring_attention(q, k, v, mesh: Mesh, seq_axis: str, causal: bool = False,
     return make_ring_attention(mesh, seq_axis, causal, local_chunk)(q, k, v)
 
 
-def _ulysses_sharded(q, k, v, axis_name: str, causal: bool):
+def _ulysses_sharded(q, k, v, axis_name: str, causal: bool,
+                     local_chunk: "int | None" = None):
     """Per-shard body: (B, T_local, H, D) seq-sharded -> exact attention via
-    two all_to_alls (seq shards <-> head shards)."""
-    n = lax.axis_size(axis_name)
-    my = lax.axis_index(axis_name)
+    two all_to_alls (seq shards <-> head shards).
+
+    After the first all_to_all every device holds the FULL sequence for
+    H/n heads, so the attention core is a single-device problem:
+    `local_chunk=None` runs the dense reference math ((T, T) scores —
+    fine for moderate T), and `local_chunk=c` runs the chunked
+    online-softmax core instead (identical result, score tiles bounded
+    at (c, c) — the long-context setting where a (T, T) materialization
+    is exactly what Ulysses users are trying to avoid)."""
+    if local_chunk is not None and local_chunk < 1:
+        raise ValueError(f"local_chunk={local_chunk} must be >= 1")
 
     def to_heads(x):
         # (B, T_local, H, D) -> (B, T_global, H/n, D)
@@ -190,15 +199,26 @@ def _ulysses_sharded(q, k, v, axis_name: str, causal: bool):
                               tiled=True)
 
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
-    out = dense_attention(qh, kh, vh, causal=causal)
+    if local_chunk:
+        # runtime import: nn.attention imports this module's dense tier,
+        # so the dependency must stay one-way at import time
+        from ..nn.attention import chunked_attention
+
+        out = chunked_attention(qh, kh, vh, causal=causal,
+                                q_chunk=local_chunk, k_chunk=local_chunk)
+    else:
+        out = dense_attention(qh, kh, vh, causal=causal)
     return to_seq(out)
 
 
-def make_ulysses_attention(mesh: Mesh, seq_axis: str, causal: bool = False):
+def make_ulysses_attention(mesh: Mesh, seq_axis: str, causal: bool = False,
+                           local_chunk: "int | None" = None):
     """Jitted Ulysses (all-to-all) attention over `seq_axis`. Requires the
-    head count to be divisible by the axis size."""
+    head count to be divisible by the axis size. `local_chunk` bounds the
+    post-all_to_all score tile (see _ulysses_sharded)."""
     fn = shard_map(
-        functools.partial(_ulysses_sharded, axis_name=seq_axis, causal=causal),
+        functools.partial(_ulysses_sharded, axis_name=seq_axis,
+                          causal=causal, local_chunk=local_chunk),
         mesh=mesh,
         in_specs=(P(None, seq_axis), P(None, seq_axis), P(None, seq_axis)),
         out_specs=P(None, seq_axis),
@@ -206,5 +226,6 @@ def make_ulysses_attention(mesh: Mesh, seq_axis: str, causal: bool = False):
     return jax.jit(fn)
 
 
-def ulysses_attention(q, k, v, mesh: Mesh, seq_axis: str, causal: bool = False):
-    return make_ulysses_attention(mesh, seq_axis, causal)(q, k, v)
+def ulysses_attention(q, k, v, mesh: Mesh, seq_axis: str, causal: bool = False,
+                      local_chunk: "int | None" = None):
+    return make_ulysses_attention(mesh, seq_axis, causal, local_chunk)(q, k, v)

@@ -97,6 +97,17 @@ class TestUlysses:
         np.testing.assert_allclose(np.asarray(uly), np.asarray(dense),
                                    rtol=2e-4, atol=2e-5)
 
+    def test_local_chunk_matches_dense(self, seq_mesh):
+        """local_chunk swaps the post-all_to_all dense core for the
+        chunked online-softmax core: identical output, (c, c)-bounded
+        score tiles — the long-context configuration."""
+        q, k, v = qkv(h=8, seed=4)
+        uly = make_ulysses_attention(
+            seq_mesh, SEQ_AXIS, causal=True, local_chunk=8)(q, k, v)
+        dense = dense_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(uly), np.asarray(dense),
+                                   rtol=2e-4, atol=2e-5)
+
 
 class TestTensorParallel:
     def test_tp_mlp_matches_local(self):
