@@ -58,7 +58,10 @@ def main():
 
     mesh = make_mesh(n_data=1, n_seq=len(jax.devices()))
     ring = make_ring_attention(mesh, SEQ_AXIS, causal=True, local_chunk=32)
-    uly = make_ulysses_attention(mesh, SEQ_AXIS, causal=True)
+    # local_chunk on Ulysses too: after its all_to_all each device holds
+    # the FULL sequence, so the chunked core bounds the score tile there
+    uly = make_ulysses_attention(mesh, SEQ_AXIS, causal=True,
+                                 local_chunk=64)
     np.testing.assert_allclose(ring(q, k, v), ref, atol=2e-4, rtol=2e-4)
     np.testing.assert_allclose(uly(q, k, v), ref, atol=2e-4, rtol=2e-4)
     print(f"ring + Ulysses agree over a {len(jax.devices())}-device "
