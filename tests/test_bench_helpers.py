@@ -100,6 +100,35 @@ class TestMeasurementHonesty:
         assert bench.median_timed(lambda: None, reps=3) == pytest.approx(1.0)
 
 
+class TestSessionScriptBudget:
+    def test_outer_timeout_covers_orchestrator_worst_case(self):
+        """tools/tpu_session.sh's bench timeout must cover the
+        orchestrator's worst case (device core + CPU core retry + every
+        solo child), or a hang would kill the session mid-artifact —
+        the script and bench.py must not drift apart."""
+        import pathlib
+        import re
+
+        script = pathlib.Path(__file__).parents[1] / "tools/tpu_session.sh"
+        text = script.read_text()
+        m = re.search(r"timeout (\d+) env [^\n]*python bench\.py", text)
+        assert m, "bench invocation with a timeout not found in the script"
+        outer = int(m.group(1))
+        core = 1800          # _CORE_TIMEOUT_ENV default
+        solos = 900 + 900 + 1200   # transformer + trainer + gbdt_large
+        worst = 2 * core + solos   # device attempt + CPU retry + solos
+        assert outer >= worst, (outer, worst)
+
+    def test_script_is_bash_valid(self):
+        import pathlib
+        import subprocess
+
+        script = pathlib.Path(__file__).parents[1] / "tools/tpu_session.sh"
+        subprocess.run(["bash", "-n", str(script)], check=True)
+        watcher = pathlib.Path(__file__).parents[1] / "tools/tpu_watch.sh"
+        subprocess.run(["bash", "-n", str(watcher)], check=True)
+
+
 class TestChipModel:
     def test_chip_peaks_on_cpu(self):
         kind, tflops, gbps = bench.chip_peaks()
