@@ -111,7 +111,10 @@ class TestSessionScriptBudget:
 
         script = pathlib.Path(__file__).parents[1] / "tools/tpu_session.sh"
         text = script.read_text()
-        m = re.search(r"timeout (\d+) env [^\n]*python bench\.py", text)
+        # the invocation is line-continued: `timeout N env VAR=.. \`
+        # then `python bench.py` on the next line
+        m = re.search(r"timeout (\d+) env (?:[^\n]|\\\n)*python bench\.py",
+                      text)
         assert m, "bench invocation with a timeout not found in the script"
         outer = int(m.group(1))
         core = 1800          # _CORE_TIMEOUT_ENV default
