@@ -271,16 +271,25 @@ N_VALID = 8192
 
 def _with_xla_kernel_retry(fn, label):
     """Run a GBDT family; if the Pallas histogram kernel fails on this
-    chip, retry once under the XLA kernel rather than losing the family."""
+    chip, retry once under the XLA kernel rather than losing the family.
+    The override is scoped to the retry (restored after), and the result
+    dict records the degraded mode so the artifact is attributable."""
+    from mmlspark_tpu.core.kernels import kernel_mode, set_kernel_mode
+
     try:
         return fn()
     except Exception as e:  # noqa: BLE001 — kernel-mode insurance
         print(f"bench: {label} failed under auto kernel mode ({e!r}); "
               "retrying with kernel mode 'xla'", file=sys.stderr)
-        from mmlspark_tpu.core.kernels import set_kernel_mode
-
+        prior = kernel_mode()
         set_kernel_mode("xla")
-        return fn()
+        try:
+            out = fn()
+        finally:
+            set_kernel_mode(prior)
+        if isinstance(out, dict):
+            out[f"{label}_kernel_mode_degraded"] = "xla"
+        return out
 
 
 def bench_gbdt(hbm_peak_gbps: "float | None") -> dict:

@@ -178,6 +178,19 @@ class Booster:
             opts.device_binning and not mapper.category_maps
             and not is_sparse(x)
         )
+        if use_device_bin:
+            # train/serve consistency: the device transform compares in f32,
+            # so snap the mapper's boundaries through f32 up front — predict
+            # (host f64 searchsorted) then routes against the SAME thresholds
+            # the training matrix was binned with, instead of f64 boundaries
+            # that can disagree for values straddling an f32-invisible gap.
+            # Snap a COPY: a warm-start caller's model keeps the boundaries
+            # it was trained/serialized with.
+            import copy as _copy
+
+            mapper = _copy.copy(mapper)
+            mapper.upper_bounds = np.float64(
+                np.float32(mapper.upper_bounds))
         bins_np = None if use_device_bin else mapper.transform(x)
         num_bins = max(int(mapper.num_bins.max(initial=2)), 2)
 

@@ -114,14 +114,22 @@ def _renew_tree_values(tree, node_of_row, resid, w, alpha, learning_rate,
     def minmax_body(carry, xs):
         lo_a, hi_a = carry
         nd, rb, wc = xs
-        sel = (jax.nn.one_hot(nd, m, dtype=f32) > 0) & (wc[:, None] > 0)
+        # non-finite residuals (inf labels, diverged predictions) carry no
+        # weight: one bad row must degrade only itself, not poison its
+        # node's span (span=inf -> 0*inf=NaN cascades through every later
+        # iteration's predictions)
+        sel = ((jax.nn.one_hot(nd, m, dtype=f32) > 0) & (wc[:, None] > 0)
+               & jnp.isfinite(rb)[:, None])
         lo_a = jnp.minimum(lo_a, jnp.where(sel, rb[:, None], jnp.inf).min(0))
         hi_a = jnp.maximum(hi_a, jnp.where(sel, rb[:, None], -jnp.inf).max(0))
         return (lo_a, hi_a), None
 
-    # + 0*r_c[0,0]: carry adopts the shard-varying type under shard_map
-    init = (jnp.full((m,), jnp.inf, f32) + 0.0 * r_c[0, 0],
-            jnp.full((m,), -jnp.inf, f32) + 0.0 * r_c[0, 0])
+    # + 0*tag: carry adopts the shard-varying type under shard_map. The tag
+    # must be finite: 0*inf = NaN would poison every node's bracket and the
+    # histogram accumulator if the shard's first residual diverged.
+    tag = 0.0 * jnp.where(jnp.isfinite(r_c[0, 0]), r_c[0, 0], 0.0)
+    init = (jnp.full((m,), jnp.inf, f32) + tag,
+            jnp.full((m,), -jnp.inf, f32) + tag)
     (lo, hi), _ = jax.lax.scan(minmax_body, init, (nd_c, r_c, w_c))
     if axis_name is not None:
         lo = jax.lax.pmin(lo, axis_name)
@@ -139,8 +147,11 @@ def _renew_tree_values(tree, node_of_row, resid, w, alpha, learning_rate,
             lo_r, hi_r = lo[nd], hi[nd]                            # (ch,)
             bin_f = (rb - lo_r) / span[nd] * _RENEW_BINS
             bidx = jnp.clip(bin_f.astype(jnp.int32), 0, _RENEW_BINS - 1)
-            # rows outside their node's current bracket carry no weight
-            inw = jnp.where((rb >= lo_r) & (rb <= hi_r), wc, 0.0)
+            # rows outside their node's current bracket carry no weight;
+            # non-finite residuals were excluded from the brackets and must
+            # stay excluded here (NaN compares false, but +-inf would not)
+            inw = jnp.where(
+                (rb >= lo_r) & (rb <= hi_r) & jnp.isfinite(rb), wc, 0.0)
             oh_n = jax.nn.one_hot(nd, m, dtype=f32)                # (ch, M)
             oh_b = jax.nn.one_hot(bidx, _RENEW_BINS, dtype=f32)
             oh_b = oh_b * inw[:, None]                             # (ch, B)
@@ -151,7 +162,7 @@ def _renew_tree_values(tree, node_of_row, resid, w, alpha, learning_rate,
             )                                                      # (M, B)
             return acc + h, None
 
-        acc0 = jnp.zeros((m, _RENEW_BINS), f32) + 0.0 * r_c[0, 0]
+        acc0 = jnp.zeros((m, _RENEW_BINS), f32) + tag
         hist, _ = jax.lax.scan(body, acc0, (nd_c, r_c, w_c))
         if axis_name is not None:
             if deterministic:

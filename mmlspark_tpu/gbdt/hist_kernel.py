@@ -237,6 +237,18 @@ def _histogram_pallas(bins, stats, num_bins, interpret):
     # so tiny n still runs the tile-aligned chunk shape
     chunk = fused_chunk if use_fused else min(_PALLAS_CHUNK, max(n, 8))
     group = min(_hist_group(), f)
+    # same lane-alignment discipline as the fused gate: every grouped dot's
+    # lane axis (g·B, including the ragged tail group f%group) must be
+    # 128-aligned or Mosaic can reject the kernel at fit time on real TPU —
+    # fall back to the proven per-feature kernel instead of failing the fit.
+    # Real-Mosaic only: interpret mode has no lane constraint, and the CPU
+    # parity tests rely on it to exercise the ragged-tail grouped path.
+    if group > 1 and not interpret:
+        tail = f % group
+        aligned = (group * num_bins) % 128 == 0 and (
+            tail == 0 or (tail * num_bins) % 128 == 0)
+        if not aligned:
+            group = 1
     if use_fused:
         kernel = _hist_kernel_fused
     elif group > 1:

@@ -399,6 +399,24 @@ class TestBooster:
         assert mae < 1.0, mae                      # normal rows still fit
         assert np.isfinite(pred).all()
 
+    def test_renewal_survives_nonfinite_first_residual(self):
+        """Regression: the shard-varying carry tag is built from the FIRST
+        residual of the shard (fused.py); an inf there must not 0*inf=NaN
+        its way into every node's bracket — only the outlier's own node may
+        degrade, all other leaves must renew to finite values."""
+        rng = np.random.default_rng(7)
+        n = 1024
+        x = rng.normal(size=(n, 4))
+        y = 3.0 * x[:, 0] + rng.normal(scale=0.5, size=n)
+        y[0] = np.inf                              # first residual = inf
+        b = Booster.train(x, y, TrainOptions(
+            objective="l1", num_iterations=20, num_leaves=15,
+            min_data_in_leaf=5, learning_rate=0.1))
+        pred = np.asarray(b.predict(x))
+        assert np.isfinite(pred).all()
+        mae = float(np.median(np.abs(pred - y)))   # median: ignore y[0]
+        assert mae < 1.5, mae
+
     def test_l1_renewal_mesh_matches_single_device(self, mesh8):
         """The renewal histogram is psummed like the split histograms, so
         the renewed model must be identical on mesh vs single device."""
