@@ -6,10 +6,12 @@ Two anchors that do NOT reference this framework's own past outputs:
    Diagnostic Breast Cancer dataset (569 real rows; sklearn's bundled copy,
    written to tests/benchmarks/data/breast_cancer_wdbc.csv) trained with the
    reference suite's exact hyperparameters (numLeaves=5, numIterations=10,
-   objective=binary — VerifyLightGBMClassifier.scala:232-240) must reach the
-   reference's committed train-AUC value within its committed precision
-   window (breast-cancer gbdt 0.99247 ± 0.1,
-   benchmarks_VerifyLightGBMClassifier.csv:22-25).
+   objective=binary — VerifyLightGBMClassifier.scala:232-240) must land
+   within ±0.01 of the reference's committed train-AUC (breast-cancer gbdt
+   0.99247, benchmarks_VerifyLightGBMClassifier.csv:22-25 — the reference
+   commits that dataset at 0.1 but its tightest tier at 0.01; we gate at
+   the tight tier), and the holdout AUC must stay within ±0.01 of this
+   repo's committed value (train-only gates miss overfit regressions).
 2. INDEPENDENT IMPLEMENTATION cross-check: sklearn's histogram GBDT —
    a from-scratch third-party implementation of the same algorithm family —
    must agree with this framework's AUC on identical data within a tight
@@ -30,15 +32,37 @@ import pytest
 DATA = os.path.join(os.path.dirname(__file__), "benchmarks", "data",
                     "breast_cancer_wdbc.csv")
 
-# the reference's committed gates for breast-cancer (train AUC, precision 0.1):
-# benchmarks_VerifyLightGBMClassifier.csv lines 22-25
+# the reference's committed gates for breast-cancer (train AUC),
+# benchmarks_VerifyLightGBMClassifier.csv lines 22-25. The reference's CSV
+# commits breast-cancer at precision 0.1 and its tightest datasets
+# (BreastTissue etc., lines 2-5) at 0.01; this repo gates at the TIGHT
+# tier — measured agreement is within ±0.004, and a ±0.1 window would
+# pass a badly broken model (VERDICT r4 #6).
 REFERENCE_GATES = {
     "gbdt": 0.9924667959194766,
     "rf": 0.9894725398177173,
     "dart": 0.9915381688379931,
     "goss": 0.9924667959194766,
 }
-PRECISION = 0.1
+PRECISION = 0.01
+
+
+def _rf_kwargs(boosting):
+    # the reference sets bagging for rf (VerifyLightGBMClassifier
+    # .scala:228-231); rf without bagging is degenerate
+    return ({"bagging_fraction": 0.9, "bagging_freq": 1}
+            if boosting == "rf" else {})
+
+# this repo's committed HOLDOUT AUC on the same config (seed-0 80/20 split;
+# measured r5) — train-only gates cannot catch an overfit regression. Gated
+# two-sided at the same ±0.01: drift in either direction means the
+# algorithm changed and the committed value must be consciously re-derived.
+HOLDOUT_GATES = {
+    "gbdt": 0.98777,
+    "rf": 0.97904,
+    "dart": 0.97158,
+    "goss": 0.98857,
+}
 
 
 def _auc(y, score):
@@ -69,26 +93,46 @@ def wdbc():
 class TestReferenceGateOnRealData:
     @pytest.mark.parametrize("boosting", ["gbdt", "rf", "dart", "goss"])
     def test_train_auc_within_reference_window(self, wdbc, boosting):
-        """The reference suite's exact config on REAL data must land inside
-        the reference's committed AUC window — same dataset family, same
-        metric, same hyperparameters, the reference's own precision."""
+        """The reference suite's exact config on REAL data must land within
+        ±0.01 of the reference's committed AUC — same dataset family, same
+        metric, same hyperparameters, gated at the reference CSV's tight
+        precision tier (two-sided, like the reference's CI assertion: drift
+        in either direction means the semantics changed)."""
         from mmlspark_tpu.gbdt.booster import Booster, TrainOptions
 
         x, y = wdbc
-        kw = {}
-        if boosting == "rf":
-            # the reference sets bagging for rf (VerifyLightGBMClassifier
-            # .scala:228-231); rf without bagging is degenerate
-            kw = {"bagging_fraction": 0.9, "bagging_freq": 1}
         booster = Booster.train(x, y, TrainOptions(
             objective="binary", boosting_type=boosting,
-            num_leaves=5, num_iterations=10, **kw,
+            num_leaves=5, num_iterations=10, **_rf_kwargs(boosting),
         ))
         auc = _auc(y, np.asarray(booster.predict(x)))
         want = REFERENCE_GATES[boosting]
-        assert auc > want - PRECISION, (
-            f"{boosting}: train AUC {auc:.4f} below the reference gate "
-            f"{want:.4f} - {PRECISION}"
+        assert abs(auc - want) < PRECISION, (
+            f"{boosting}: train AUC {auc:.4f} outside the reference window "
+            f"{want:.4f} ± {PRECISION}"
+        )
+
+    @pytest.mark.parametrize("boosting", ["gbdt", "rf", "dart", "goss"])
+    def test_holdout_auc_within_committed_window(self, wdbc, boosting):
+        """Holdout AUC on the fixed seed-0 80/20 split must stay within
+        ±0.01 of the committed value — the overfit-catching counterpart of
+        the train-AUC gate (VERDICT r4 #6)."""
+        from mmlspark_tpu.gbdt.booster import Booster, TrainOptions
+
+        x, y = wdbc
+        rng = np.random.default_rng(0)
+        order = rng.permutation(len(y))
+        cut = int(0.8 * len(y))
+        tr, te = order[:cut], order[cut:]
+        booster = Booster.train(x[tr], y[tr], TrainOptions(
+            objective="binary", boosting_type=boosting,
+            num_leaves=5, num_iterations=10, **_rf_kwargs(boosting),
+        ))
+        auc = _auc(y[te], np.asarray(booster.predict(x[te])))
+        want = HOLDOUT_GATES[boosting]
+        assert abs(auc - want) < PRECISION, (
+            f"{boosting}: holdout AUC {auc:.4f} outside the committed "
+            f"window {want:.4f} ± {PRECISION}"
         )
 
     def test_sklearn_cross_check(self, wdbc):
@@ -214,23 +258,6 @@ class TestRealRegressionAnchor:
         cut = int(0.8 * len(y))
         return x, y, order[:cut], order[cut:]
 
-    def test_holdout_rmse_clears_reference_style_gate(self):
-        from mmlspark_tpu.gbdt.booster import Booster, TrainOptions
-
-        x, y, tr, te = self._split()
-        b = Booster.train(x[tr], y[tr], TrainOptions(
-            objective="regression", num_leaves=15, num_iterations=50,
-            min_data_in_leaf=5, learning_rate=0.1,
-        ))
-        pred = np.asarray(b.predict(x[te]))
-        rmse = float(np.sqrt(np.mean((pred - y[te]) ** 2)))
-        # label std is ~77; published GBDT results on this dataset sit
-        # around RMSE 54-60 — the bar is a reference-style window above
-        # the achievable value, far below the constant-predictor baseline
-        assert rmse < 65.0, f"holdout RMSE {rmse:.2f}"
-        const_rmse = float(np.sqrt(np.mean((y[tr].mean() - y[te]) ** 2)))
-        assert rmse < 0.85 * const_rmse, (rmse, const_rmse)
-
     def test_sklearn_cross_check(self):
         from sklearn.ensemble import HistGradientBoostingRegressor
 
@@ -252,6 +279,46 @@ class TestRealRegressionAnchor:
         # the same neighborhood (window sized like the reference's
         # per-metric precisions relative to the ~55-60 scale)
         assert abs(ours_rmse - sk_rmse) < 6.0, (ours_rmse, sk_rmse)
+
+    # committed holdout RMSE per boosting type (seed-0 80/20 split,
+    # num_leaves=15, num_iterations=50 — measured r5), gated at ±2.0 in
+    # the style of the reference's regressor CSV windows
+    # (benchmarks_VerifyLightGBMRegressor.csv: value ± per-metric precision)
+    BOOSTING_RMSE_GATES = {
+        "gbdt": 57.58,
+        "rf": 58.07,
+        "dart": 57.98,
+        "goss": 61.04,
+    }
+
+    @pytest.mark.parametrize("boosting", ["gbdt", "rf", "dart", "goss"])
+    def test_boosting_modes_holdout_rmse_within_window(self, boosting):
+        """All four boosting types on REAL regression data, each gated
+        against its committed holdout RMSE — the regression counterpart of
+        the WDBC per-boosting-type windows (the reference's regressor gate
+        table spans boosting types per dataset the same way). The gbdt case
+        also carries the absolute anchors: label std is ~77 and published
+        GBDT results on this dataset sit around RMSE 54-60, so the window
+        sits far below the constant-predictor baseline."""
+        from mmlspark_tpu.gbdt.booster import Booster, TrainOptions
+
+        x, y, tr, te = self._split()
+        b = Booster.train(x[tr], y[tr], TrainOptions(
+            objective="regression", boosting_type=boosting,
+            num_leaves=15, num_iterations=50, min_data_in_leaf=5,
+            learning_rate=0.1, **_rf_kwargs(boosting),
+        ))
+        rmse = float(np.sqrt(np.mean(
+            (np.asarray(b.predict(x[te])) - y[te]) ** 2)))
+        want = self.BOOSTING_RMSE_GATES[boosting]
+        assert abs(rmse - want) < 2.0, (
+            f"{boosting}: holdout RMSE {rmse:.2f} outside the committed "
+            f"window {want:.2f} ± 2.0"
+        )
+        if boosting == "gbdt":
+            const_rmse = float(
+                np.sqrt(np.mean((y[tr].mean() - y[te]) ** 2)))
+            assert rmse < 0.85 * const_rmse, (rmse, const_rmse)
 
     def test_robust_objectives_on_real_data(self):
         """l1/huber/quantile learn the real data too (the reference's

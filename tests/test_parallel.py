@@ -271,6 +271,47 @@ class TestDistributedDeterminism:
         acc = (np.asarray(out["prediction"], np.float64) == y).mean()
         assert acc > 0.9, acc
 
+    def test_voting_parallel_restricted_holdout_auc_tracks_data_parallel(self):
+        """The ACTUAL contract of restricted voting (LightGBM
+        tree_learner=voting_parallel): at top_k ~ F/4 the vote's feature
+        pre-selection approximates the full histogram merge, so holdout
+        QUALITY must track data-parallel within a small epsilon — not
+        merely clear an absolute learning bar (VERDICT r4 #5)."""
+        from mmlspark_tpu.core.schema import Table
+        from mmlspark_tpu.gbdt import GBDTClassifier
+        from mmlspark_tpu.parallel.mesh import set_default_mesh
+
+        rng = np.random.default_rng(9)
+        n_tr, n_te, f_dim = 4096, 1024, 16
+        x = rng.normal(size=(n_tr + n_te, f_dim))
+        # signal spread over 4 features so restricted voting has real work:
+        # the voted 2k set must recover all informative columns each tree
+        logits = (x[:, 0] - 0.8 * x[:, 5] + 0.6 * x[:, 9]
+                  - 0.4 * x[:, 13])
+        y = (logits + rng.normal(scale=0.5, size=n_tr + n_te) > 0
+             ).astype(np.float64)
+        tbl = Table({"features": x[:n_tr], "label": y[:n_tr]})
+        cfg = dict(num_iterations=20, num_leaves=15, min_data_in_leaf=10,
+                   use_mesh=True)
+        set_default_mesh(make_mesh(n_data=8))
+        try:
+            data_par = GBDTClassifier(**cfg).fit(tbl)
+            voting = GBDTClassifier(
+                tree_learner="voting_parallel", top_k=f_dim // 4, **cfg
+            ).fit(tbl)
+        finally:
+            set_default_mesh(None)
+
+        from mmlspark_tpu.automl.metrics import auc
+
+        auc_dp = auc(y[n_tr:], np.asarray(data_par.booster.predict(x[n_tr:])))
+        auc_v = auc(y[n_tr:], np.asarray(voting.booster.predict(x[n_tr:])))
+        assert auc_dp > 0.9, auc_dp          # the baseline itself learned
+        assert auc_v >= auc_dp - 0.02, (
+            f"restricted voting holdout AUC {auc_v:.4f} trails "
+            f"data-parallel {auc_dp:.4f} by more than 0.02"
+        )
+
     @pytest.mark.parametrize("n_devices", [2, 8])
     def test_dnn_step_matches_single_device(self, n_devices):
         """Data-parallel DNN training must match the single-device run on the
