@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Pallas AOT-compile gate: prove every shipped Pallas kernel compiles on
+REAL Mosaic before any timed run (VERDICT r4 #2).
+
+Interpret-mode parity is NOT compile evidence: the fused histogram kernel
+passed interpret for a full round and then failed real Mosaic with
+"Bad rhs type" (sweeps/r4_window1/sweep.txt). This gate AOT-compiles each
+kernel at its SHIPPED tile config via jit(...).lower(...).compile() —
+no input data, no timed execution — and prints one OK/FAIL verdict per
+kernel. The session script runs it right after the probe so a failing
+kernel is a recorded fact, not a mid-bench surprise.
+
+Exit code is always 0: the RECORD is the deliverable (a kernel bug must
+not burn the rare chip window by re-arming the watcher); the session
+archive and BENCH_TPU_MEASURED.md carry the verdicts.
+"""
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+VERDICTS = []
+
+
+def gate(name, build):
+    """build() -> (fn, abstract_args); compile and record the verdict."""
+    t0 = time.time()
+    try:
+        fn, args = build()
+        jax.jit(fn).lower(*args).compile()
+        VERDICTS.append((name, "OK", time.time() - t0, ""))
+        print(f"AOT {name}: OK ({time.time() - t0:.1f}s)", flush=True)
+    except Exception as e:  # noqa: BLE001 — each kernel gets its own verdict
+        first = str(e).strip().splitlines()[0] if str(e).strip() else repr(e)
+        VERDICTS.append((name, "FAIL", time.time() - t0, first))
+        print(f"AOT {name}: FAIL ({time.time() - t0:.1f}s) — {first}",
+              flush=True)
+        traceback.print_exc(limit=3)
+
+
+def sds(shape, dtype):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def hist_build(group=None, fused=False, bins_dtype=jnp.int32):
+    """Histogram kernel at the bench's shipped shape: F=28 (Higgs-family
+    feature count), B=256 bins (max_bin=255), C=2 grad/hess columns."""
+    os.environ.pop("MMLSPARK_TPU_HIST_GROUP", None)
+    os.environ.pop("MMLSPARK_TPU_FUSED_HIST", None)
+    if group:
+        os.environ["MMLSPARK_TPU_HIST_GROUP"] = str(group)
+    if fused:
+        os.environ["MMLSPARK_TPU_FUSED_HIST"] = "1"
+    from mmlspark_tpu.gbdt.hist_kernel import histogram_pallas
+
+    n, f, b, c = 8192, 28, 256, 2
+    return (lambda bins, stats: histogram_pallas(bins, stats, b),
+            (sds((n, f), bins_dtype), sds((n, c), jnp.float32)))
+
+
+def flash_build(t, grad=False):
+    """Flash attention at the bench transformer's shipped head geometry
+    (d_model=512 / 8 heads -> D=64, bf16, block 128). Batch is small: the
+    Mosaic kernel is identical per block; grid count doesn't change it."""
+    from mmlspark_tpu.nn.attention import flash_attention
+
+    q = sds((2, t, 8, 64), jnp.bfloat16)
+    if grad:
+        def loss(q_, k_, v_):
+            return flash_attention(q_, k_, v_, causal=True).astype(
+                jnp.float32).sum()
+
+        return jax.grad(loss, argnums=(0, 1, 2)), (q, q, q)
+    return (lambda q_, k_, v_: flash_attention(q_, k_, v_, causal=True),
+            (q, q, q))
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"device: {dev.platform} {getattr(dev, 'device_kind', '?')}",
+          flush=True)
+    if dev.platform == "cpu":
+        print("AOT gate on CPU proves XLA lowering only, NOT Mosaic — "
+              "run in a chip window for the real verdicts", flush=True)
+
+    gate("hist_per_feature_int32", lambda: hist_build())
+    gate("hist_per_feature_uint8",
+         lambda: hist_build(bins_dtype=jnp.uint8))
+    gate("hist_grouped_g4_uint8",
+         lambda: hist_build(group=4, bins_dtype=jnp.uint8))
+    gate("hist_fused_uint8", lambda: hist_build(fused=True,
+                                                bins_dtype=jnp.uint8))
+    os.environ.pop("MMLSPARK_TPU_HIST_GROUP", None)
+    os.environ.pop("MMLSPARK_TPU_FUSED_HIST", None)
+    gate("flash_fwd_seq512", lambda: flash_build(512))
+    gate("flash_fwd_seq4096", lambda: flash_build(4096))
+    gate("flash_fwd_bwd_seq512", lambda: flash_build(512, grad=True))
+
+    n_fail = sum(1 for _, v, _, _ in VERDICTS if v == "FAIL")
+    print(f"\nAOT GATE SUMMARY: {len(VERDICTS) - n_fail}/{len(VERDICTS)} "
+          f"kernels compile on {dev.platform}", flush=True)
+    for name, verdict, secs, err in VERDICTS:
+        print(f"  {name:28s} {verdict:4s} {secs:6.1f}s {err}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
