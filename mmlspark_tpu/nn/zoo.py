@@ -186,6 +186,13 @@ class ModelDownloader:
 
         self.sweep_orphan_tmps()
 
+        # a COMMITTED index must work from any checkout path, so schema.uri
+        # may be repo-relative: resolve scheme-less relative uris against
+        # the repo directory
+        uri = schema.uri
+        if "://" not in uri and not os.path.isabs(uri):
+            uri = os.path.join(self.local_repo, uri)
+
         def copy():
             fd, tmp = tempfile.mkstemp(
                 prefix=f".{schema.name}.", suffix=suffix,
@@ -198,7 +205,7 @@ class ModelDownloader:
                 # HadoopUtils/remote-repo analogue)
                 from ..utils.storage import copy_to_local
 
-                copy_to_local(schema.uri, tmp)
+                copy_to_local(uri, tmp)
             except BaseException:
                 if os.path.exists(tmp):
                     os.unlink(tmp)
@@ -239,8 +246,25 @@ class ModelDownloader:
         self._register(schema)
         return dest
 
+    def _verify_sha(self, name: str, path: str) -> None:
+        """Verify an indexed artifact's committed hash before serving it;
+        un-indexed names (ad-hoc files) are served unverified."""
+        try:
+            schema = self.get_model(name)
+        except KeyError:
+            return
+        if schema.sha256:
+            got = _sha256(path)
+            if got != schema.sha256:
+                raise IOError(
+                    f"hash mismatch for {name}: got {got[:12]}…, "
+                    f"want {schema.sha256[:12]}…"
+                )
+
     def load_bundle(self, name: str) -> ModelBundle:
-        return ModelBundle.load(self.local_path(name))
+        path = self.local_path(name)
+        self._verify_sha(name, path)
+        return ModelBundle.load(path)
 
     def import_external(self, schema: ModelSchema, force: bool = False) -> str:
         """Fetch EXTERNAL-format pretrained weights (torch-layout
@@ -283,17 +307,58 @@ class ModelDownloader:
     # -- publish (the reference's uploader role) ------------------------- #
 
     def publish(self, bundle: ModelBundle, name: str,
-                class_labels: list | None = None) -> ModelSchema:
+                class_labels: list | None = None,
+                relative_uri: bool = False,
+                extra: dict | None = None) -> ModelSchema:
+        """`relative_uri=True` writes a repo-relative uri so the index can
+        be COMMITTED and served from any checkout path (the stocked-zoo
+        story, ModelDownloader.scala:209+)."""
         path = self.local_path(name)
         bundle.save(path)
         schema = ModelSchema(
-            name=name, uri="file://" + path, sha256=_sha256(path),
+            name=name,
+            uri=(os.path.basename(path) if relative_uri
+                 else "file://" + path),
+            sha256=_sha256(path),
             architecture=bundle.architecture,
             input_shape=bundle.input_shape,
             num_outputs=bundle.config.get("num_outputs"),
             class_labels=class_labels or bundle.class_labels,
+            extra=dict(extra or {}),
         )
-        schemas = [s for s in self.models() if s.name != name]
-        schemas.append(schema)
-        self._write_index(schemas)
+        self._register(schema)
         return schema
+
+    # -- GBDT artifacts: the zoo serves boosters too --------------------- #
+    # The reference's zoo is CNTK-only because its GBDT rides Spark MLlib
+    # persistence; here the booster's LightGBM-format model.txt IS the
+    # interchange artifact (docs/scope.md), so the same repo stocks both.
+
+    def publish_booster(self, booster, name: str,
+                        extra: dict | None = None) -> ModelSchema:
+        path = self.local_path(name)
+        txt = booster.to_lightgbm_text()
+        with open(path, "w") as fh:
+            fh.write(txt)
+        schema = ModelSchema(
+            name=name, uri=os.path.basename(path), sha256=_sha256(path),
+            architecture="gbdt",
+            extra={"format": "lightgbm_model_txt", **(extra or {})},
+        )
+        self._register(schema)
+        return schema
+
+    def load_booster(self, name: str):
+        """Load a published GBDT artifact (LightGBM model.txt format —
+        `Booster.load_native_model` autodetects)."""
+        from ..gbdt.booster import Booster
+
+        schema = self.get_model(name)
+        if schema.architecture != "gbdt":
+            raise ValueError(
+                f"{name!r} is a {schema.architecture!r} bundle, not a "
+                "gbdt artifact — use load_bundle"
+            )
+        path = self.local_path(name)
+        self._verify_sha(name, path)
+        return Booster.load_native_model(path)

@@ -15,7 +15,7 @@ import numpy as np
 
 from ..core.schema import CATEGORY_VALUES, Table
 
-__all__ = ["ColumnSpec", "generate_table", "random_specs"]
+__all__ = ["ColumnSpec", "digits_to_images", "generate_table", "random_specs"]
 
 _KINDS = ("double", "int", "bool", "string", "category", "vector")
 
@@ -112,3 +112,14 @@ def random_specs(n_cols: int, seed: int = 0,
             length=int(rng.integers(2, 10)),
         ))
     return specs
+
+
+def digits_to_images(x) -> np.ndarray:
+    """The 8x8 digits feature matrix (N, 64; ink strength 0-16) as
+    (N, 8, 8, 3) float32 images in [0, 255] — the INPUT CONTRACT of the
+    zoo's resnet20_digits bundle (tools/build_zoo.py trains on exactly
+    this conversion; change it there and here together, or the stocked
+    weights silently score garbage)."""
+    img = np.repeat(
+        np.asarray(x, np.float64).reshape(-1, 8, 8)[..., None], 3, axis=-1)
+    return (img * (255.0 / 16.0)).astype(np.float32)
