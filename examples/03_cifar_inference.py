@@ -23,19 +23,16 @@ ZOO = os.path.join(os.path.dirname(__file__), os.pardir, "model_zoo")
 
 
 def real_digits_holdout():
-    """The seed-0 20% holdout — rows the zoo model NEVER trained on
-    (tools/build_zoo.py trains on the other 80% of this split)."""
-    from mmlspark_tpu.core.table_io import read_csv
-    from mmlspark_tpu.utils.datagen import digits_to_images
+    """The shared holdout contract (utils.datagen.holdout_split) — rows
+    the zoo model NEVER trained on (tools/build_zoo.py trains on the
+    complementary 80%)."""
+    from mmlspark_tpu.utils.datagen import (
+        digits_to_images, holdout_split, load_label_csv)
 
-    t = read_csv(os.path.join(
+    x, y = load_label_csv(os.path.join(
         os.path.dirname(__file__), os.pardir, "tests", "benchmarks",
         "data", "digits.csv"))
-    y = np.asarray(t["Label"], np.float64)
-    x = np.stack([np.asarray(t[c], np.float64)
-                  for c in t.columns if c != "Label"], axis=1)
-    order = np.random.default_rng(0).permutation(len(y))
-    te = order[int(0.8 * len(y)):]
+    _tr, te = holdout_split(len(y))
     return digits_to_images(x[te]), y[te]
 
 

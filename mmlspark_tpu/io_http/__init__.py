@@ -23,6 +23,7 @@ from .transformer import (
     CustomInputParser,
     CustomOutputParser,
 )
+from .forwarding import ForwardingOptions, PortForward, establish_forward
 from .journal import ServingJournal
 from .serving import MicroBatchQuery, ServingFleet, ServingServer, serve_model
 from .consolidator import PartitionConsolidator

@@ -518,23 +518,38 @@ def serve_model(
 class ServiceInfo:
     """One serving replica's coordinates — the reference's
     `ServiceInfo{name, host, port, partitionId, localIp, publicIp}`
-    collected by the driver rendezvous service (HTTPSourceV2.scala:118-165)."""
+    collected by the driver rendezvous service (HTTPSourceV2.scala:118-165).
+
+    `public_host`/`public_port` are the NAT-traversing coordinates when a
+    reverse tunnel is attached (io_http.forwarding — the reference's
+    PortForwarding path); clients outside the boundary route there, the
+    rendezvous keeps polling the direct host:port."""
 
     name: str
     host: str
     port: int
     partition_id: int
     pid: int
+    local_ip: str | None = None
+    public_host: str | None = None
+    public_port: int | None = None
 
     def to_dict(self) -> dict:
         return {"name": self.name, "host": self.host, "port": self.port,
-                "partition_id": self.partition_id, "pid": self.pid}
+                "partition_id": self.partition_id, "pid": self.pid,
+                "local_ip": self.local_ip, "public_host": self.public_host,
+                "public_port": self.public_port}
 
     @staticmethod
     def from_dict(d: dict) -> "ServiceInfo":
+        pub_port = d.get("public_port")
         return ServiceInfo(name=d["name"], host=d["host"], port=int(d["port"]),
                            partition_id=int(d["partition_id"]),
-                           pid=int(d.get("pid", 0)))
+                           pid=int(d.get("pid", 0)),
+                           local_ip=d.get("local_ip"),
+                           public_host=d.get("public_host"),
+                           public_port=(int(pub_port)
+                                        if pub_port is not None else None))
 
 
 class FleetRendezvous:
@@ -678,21 +693,35 @@ def _register_with_rendezvous(rendezvous_url: str, info: ServiceInfo) -> None:
 
 
 def _fleet_worker(handler_factory, conn, server_kw, partition_id=0,
-                  rendezvous_url=None) -> None:
+                  rendezvous_url=None, forwarding=None) -> None:
     """Child-process entry: build the handler locally (models must not cross
     the process boundary — the reference re-creates per-JVM servers the same
-    way, DistributedHTTPSource.scala:244-291), announce ServiceInfo to the
-    driver rendezvous, and serve until terminated."""
+    way, DistributedHTTPSource.scala:244-291), optionally open a reverse
+    tunnel to the public gateway (the HTTPSourceV2 `forwarding.*` path,
+    HTTPSourceV2.scala:363-372), announce ServiceInfo to the driver
+    rendezvous, and serve until terminated."""
     import os
 
+    from .forwarding import establish_forward, get_local_ip
+
     srv = ServingServer(handler_factory(), **server_kw).start()
+    fwd = None
+    if forwarding is not None:
+        fwd = establish_forward(srv.port, forwarding, local_host=srv.host)
     if rendezvous_url:
         _register_with_rendezvous(rendezvous_url, ServiceInfo(
             name="mmlspark_tpu.serving", host=srv.host, port=srv.port,
             partition_id=partition_id, pid=os.getpid(),
+            local_ip=get_local_ip(),
+            public_host=fwd.remote_host if fwd else None,
+            public_port=fwd.remote_port if fwd else None,
         ))
     conn.send((srv.host, srv.port))
-    srv._stop.wait()
+    try:
+        srv._stop.wait()
+    finally:
+        if fwd is not None:
+            fwd.close()
 
 
 class ServingFleet:
@@ -714,11 +743,15 @@ class ServingFleet:
 
     def __init__(self, handler_factory: Callable[[], Callable[[Table], Table]],
                  n_hosts: int = 2, start_timeout_s: float = 60.0,
-                 rendezvous: bool = True, **server_kw):
+                 rendezvous: bool = True, forwarding=None, **server_kw):
         self.handler_factory = handler_factory
         self.n_hosts = n_hosts
         self.start_timeout_s = start_timeout_s
         self.server_kw = server_kw
+        # io_http.forwarding.ForwardingOptions: every replica opens its own
+        # reverse tunnel to the gateway and registers the public coords
+        # (HTTPSourceV2's forwarding.enabled path)
+        self.forwarding = forwarding
         self._procs: list[multiprocessing.Process] = []
         self.urls: list[str] = []
         self.rendezvous: FleetRendezvous | None = (
@@ -735,16 +768,32 @@ class ServingFleet:
             p = ctx.Process(
                 target=_fleet_worker,
                 args=(self.handler_factory, child, self.server_kw, pid,
-                      self.rendezvous.url if self.rendezvous else None),
+                      self.rendezvous.url if self.rendezvous else None,
+                      self.forwarding),
                 daemon=True,
             )
             p.start()
             self._procs.append(p)
             conns.append(parent)
-        for parent in conns:
-            if not parent.poll(self.start_timeout_s):
-                self.stop()
-                raise TimeoutError("serving host failed to start")
+        import time as _time
+
+        for i, parent in enumerate(conns):
+            # fail FAST on a dead child (e.g. establish_forward raised on
+            # bad credentials/exhausted ports): waiting out the full
+            # timeout would mask the real error with a generic one
+            deadline = _time.monotonic() + self.start_timeout_s
+            while not parent.poll(0.5):
+                if not self._procs[i].is_alive():
+                    self.stop()
+                    raise RuntimeError(
+                        f"serving host {i} died during startup (exitcode "
+                        f"{self._procs[i].exitcode}) — see the child's "
+                        "stderr; with forwarding enabled this is usually "
+                        "the reverse tunnel failing to establish"
+                    )
+                if _time.monotonic() > deadline:
+                    self.stop()
+                    raise TimeoutError("serving host failed to start")
             host, port = parent.recv()
             self.urls.append(f"http://{host}:{port}/")
         return self

@@ -15,7 +15,7 @@ import numpy as np
 
 from ..core.schema import CATEGORY_VALUES, Table
 
-__all__ = ["ColumnSpec", "digits_to_images", "generate_table", "random_specs"]
+__all__ = ["ColumnSpec", "digits_to_images", "generate_table", "holdout_split", "load_label_csv", "random_specs"]
 
 _KINDS = ("double", "int", "bool", "string", "category", "vector")
 
@@ -123,3 +123,27 @@ def digits_to_images(x) -> np.ndarray:
     img = np.repeat(
         np.asarray(x, np.float64).reshape(-1, 8, 8)[..., None], 3, axis=-1)
     return (img * (255.0 / 16.0)).astype(np.float32)
+
+
+def load_label_csv(path: str) -> tuple[np.ndarray, np.ndarray]:
+    """A vendored benchmark CSV (feature columns + 'Label') as (x, y)."""
+    from ..core.table_io import read_csv
+
+    t = read_csv(path)
+    y = np.asarray(t["Label"], np.float64)
+    x = np.stack([np.asarray(t[c], np.float64)
+                  for c in t.columns if c != "Label"], axis=1)
+    return x, y
+
+
+def holdout_split(n: int, seed: int = 0,
+                  frac: float = 0.8) -> tuple[np.ndarray, np.ndarray]:
+    """THE train/holdout contract of the stocked zoo and its gates:
+    tools/build_zoo.py trains on the first 80% of seed-0's permutation,
+    and every consumer (examples 03/04, tests/test_zoo_content.py) must
+    evaluate on the complementary rows — re-deriving this split locally
+    risks silently scoring training rows as 'holdout'."""
+    rng = np.random.default_rng(seed)
+    order = rng.permutation(n)
+    cut = int(frac * n)
+    return order[:cut], order[cut:]

@@ -671,3 +671,125 @@ class TestServeModelLatency:
         print(f"model serving p50={stats['p50_ms']:.2f}ms "
               f"p99={stats['p99_ms']:.2f}ms")
         assert stats["p50_ms"] < 25.0, stats
+
+
+class TestPortForwarding:
+    """The NAT/tunnel path (reference PortForwarding.scala:16-66 +
+    HTTPSourceV2.scala:363-372): reverse-forward command contract, the
+    listen-port scan loop, and ServiceInfo's public coordinates — all
+    driven through an injected launcher (zero-egress environment)."""
+
+    def _opts(self, **kw):
+        from mmlspark_tpu.io_http.forwarding import ForwardingOptions
+
+        base = dict(username="svc", ssh_host="gw.example.com")
+        base.update(kw)
+        return ForwardingOptions(**base)
+
+    def test_ssh_command_contract(self):
+        from mmlspark_tpu.io_http.forwarding import build_ssh_command
+
+        cmd = build_ssh_command(
+            self._opts(ssh_port=2222, key_file="/k/id_ed25519"),
+            remote_port=8900, local_host="127.0.0.1", local_port=8898)
+        assert cmd[0] == "ssh" and "-N" in cmd
+        # listen-port-busy must exit (the scan signal), not warn-and-stay
+        assert "ExitOnForwardFailure=yes" in cmd
+        assert cmd[cmd.index("-p") + 1] == "2222"
+        assert cmd[cmd.index("-i") + 1] == "/k/id_ed25519"
+        assert cmd[cmd.index("-R") + 1] == "*:8900:127.0.0.1:8898"
+        assert cmd[-1] == "svc@gw.example.com"
+
+    def test_bind_address_prefixes_listen_spec(self):
+        from mmlspark_tpu.io_http.forwarding import build_ssh_command
+
+        cmd = build_ssh_command(
+            self._opts(bind_address="0.0.0.0"), 9000, "10.0.0.5", 8898)
+        assert cmd[cmd.index("-R") + 1] == "0.0.0.0:9000:10.0.0.5:8898"
+        # the default "*" (all interfaces) must be EXPLICIT in the -R
+        # spec: a prefix-less spec binds the gateway's loopback only,
+        # which would advertise unreachable public coordinates
+        cmd = build_ssh_command(self._opts(), 9000, "10.0.0.5", 8898)
+        assert cmd[cmd.index("-R") + 1] == "*:9000:10.0.0.5:8898"
+        # "" opts into loopback-only deliberately
+        cmd = build_ssh_command(
+            self._opts(bind_address=""), 9000, "10.0.0.5", 8898)
+        assert cmd[cmd.index("-R") + 1] == "9000:10.0.0.5:8898"
+
+    class _FakeProc:
+        def __init__(self, dies: bool):
+            self._dies = dies
+            self.terminated = False
+
+        def poll(self):
+            return 255 if self._dies else None
+
+        def terminate(self):
+            self.terminated = True
+
+        def wait(self, timeout=None):
+            return 0
+
+    def test_port_scan_skips_busy_listen_ports(self):
+        """First two candidate ports exit immediately (busy), the third
+        survives the settle window — the reference's remotePortStart +
+        attempt loop (PortForwarding.scala:46-62)."""
+        from mmlspark_tpu.io_http.forwarding import establish_forward
+
+        attempts = []
+
+        def launcher(cmd):
+            attempts.append(cmd[cmd.index("-R") + 1])
+            return self._FakeProc(dies=len(attempts) <= 2)
+
+        fwd = establish_forward(
+            8898, self._opts(remote_port_start=9000), launcher=launcher,
+            settle_s=0.15)
+        assert fwd.remote_port == 9002 and fwd.public_address == (
+            "gw.example.com", 9002)
+        assert [a.split(":")[1] for a in attempts] == ["9000", "9001", "9002"]
+        assert fwd.alive()
+        fwd.close()
+        assert fwd._proc.terminated
+
+    def test_exhausted_scan_raises(self):
+        from mmlspark_tpu.io_http.forwarding import establish_forward
+
+        with pytest.raises(RuntimeError, match="could not establish"):
+            establish_forward(
+                8898, self._opts(max_retries=2),
+                launcher=lambda cmd: self._FakeProc(dies=True),
+                settle_s=0.05)
+
+    def test_remote_port_start_defaults_to_local_port(self):
+        from mmlspark_tpu.io_http.forwarding import establish_forward
+
+        seen = []
+
+        def launcher(cmd):
+            seen.append(cmd[cmd.index("-R") + 1])
+            return self._FakeProc(dies=False)
+
+        establish_forward(8123, self._opts(), launcher=launcher,
+                          settle_s=0.05)
+        assert seen == ["*:8123:127.0.0.1:8123"]
+
+    def test_service_info_carries_public_coords(self):
+        from mmlspark_tpu.io_http.serving import ServiceInfo
+
+        info = ServiceInfo(name="s", host="127.0.0.1", port=8898,
+                           partition_id=3, pid=42, local_ip="10.0.0.7",
+                           public_host="gw.example.com", public_port=9002)
+        again = ServiceInfo.from_dict(info.to_dict())
+        assert again == info
+        # registrations from replicas without forwarding stay loadable
+        legacy = ServiceInfo.from_dict(
+            {"name": "s", "host": "h", "port": 1, "partition_id": 0})
+        assert legacy.public_host is None and legacy.public_port is None
+
+    def test_get_local_ip_returns_address(self):
+        import ipaddress
+
+        from mmlspark_tpu.io_http.forwarding import get_local_ip
+
+        ipaddress.ip_address(get_local_ip())  # parses or raises

@@ -34,21 +34,18 @@ def zoo():
 
 
 def _load_csv(name):
-    from mmlspark_tpu.core.table_io import read_csv
+    from mmlspark_tpu.utils.datagen import load_label_csv
 
-    t = read_csv(os.path.join(os.path.dirname(__file__), "benchmarks",
-                              "data", f"{name}.csv"))
-    y = np.asarray(t["Label"], np.float64)
-    x = np.stack([np.asarray(t[c], np.float64)
-                  for c in t.columns if c != "Label"], axis=1)
-    return x, y
+    return load_label_csv(os.path.join(
+        os.path.dirname(__file__), "benchmarks", "data", f"{name}.csv"))
 
 
 def _split(y, seed=0):
-    rng = np.random.default_rng(seed)
-    order = rng.permutation(len(y))
-    cut = int(0.8 * len(y))
-    return order[:cut], order[cut:]
+    # the stocked zoo's shared train/holdout contract — evaluating on any
+    # other split would silently score training rows
+    from mmlspark_tpu.utils.datagen import holdout_split
+
+    return holdout_split(len(y), seed=seed)
 
 
 class TestIndexIntegrity:

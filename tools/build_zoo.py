@@ -34,20 +34,17 @@ DATA = os.path.join(REPO, "tests", "benchmarks", "data")
 
 
 def load_csv(name):
-    from mmlspark_tpu.core.table_io import read_csv
+    from mmlspark_tpu.utils.datagen import load_label_csv
 
-    t = read_csv(os.path.join(DATA, f"{name}.csv"))
-    y = np.asarray(t["Label"], np.float64)
-    x = np.stack([np.asarray(t[c], np.float64)
-                  for c in t.columns if c != "Label"], axis=1)
-    return x, y
+    return load_label_csv(os.path.join(DATA, f"{name}.csv"))
 
 
 def split(y, seed=0, frac=0.8):
-    rng = np.random.default_rng(seed)
-    order = rng.permutation(len(y))
-    cut = int(frac * len(y))
-    return order[:cut], order[cut:]
+    # the SHARED contract (utils.datagen.holdout_split): examples and
+    # tests evaluate on exactly the rows this builder holds out
+    from mmlspark_tpu.utils.datagen import holdout_split
+
+    return holdout_split(len(y), seed=seed, frac=frac)
 
 
 def digits_images():
