@@ -1,0 +1,29 @@
+#' AnalyzeImage (Transformer)
+#'
+#' Reference: AnalyzeImage (ComputerVision.scala:300-360).
+#'
+#' @param x a data.frame or tpu_table
+#' @param output_col parsed output column
+#' @param url service endpoint URL
+#' @param subscription_key api key (header)
+#' @param error_col error column (None = raise)
+#' @param concurrency in-flight requests
+#' @param timeout request timeout (s)
+#' @param image_url image URL (scalar or column)
+#' @param image_bytes raw image bytes (column)
+#' @param visual_features feature list
+#' @export
+ml_analyze_image <- function(x, output_col = "response", url, subscription_key = NULL, error_col = NULL, concurrency = 1L, timeout = 60.0, image_url = NULL, image_bytes = NULL, visual_features = NULL)
+{
+  params <- list()
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  if (!is.null(url)) params$url <- as.character(url)
+  if (!is.null(subscription_key)) params$subscription_key <- as.character(subscription_key)
+  if (!is.null(error_col)) params$error_col <- as.character(error_col)
+  if (!is.null(concurrency)) params$concurrency <- as.integer(concurrency)
+  if (!is.null(timeout)) params$timeout <- as.double(timeout)
+  if (!is.null(image_url)) params$image_url <- image_url
+  if (!is.null(image_bytes)) params$image_bytes <- image_bytes
+  if (!is.null(visual_features)) params$visual_features <- visual_features
+  .tpu_apply_stage("mmlspark_tpu.io_http.cognitive.AnalyzeImage", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,24 @@
+#' AssembleFeatures (Estimator)
+#'
+#' Assemble chosen columns into one dense feature matrix column.
+#'
+#' @param x a data.frame or tpu_table
+#' @param columns_to_featurize input columns (default: all)
+#' @param features_col output features column
+#' @param number_of_features hash buckets for string columns
+#' @param one_hot_encode_categoricals one-hot categorical columns
+#' @param max_one_hot_cardinality string columns with <= this many distinct values one-hot instead of hash (0 = always hash)
+#' @param allow_images kept for API parity (images via ImageFeaturizer)
+#' @param only.model return the fitted model without transforming x (the reference's unfit.model)
+#' @export
+ml_assemble_features <- function(x, columns_to_featurize = NULL, features_col = "features", number_of_features = 4096L, one_hot_encode_categoricals = TRUE, max_one_hot_cardinality = 100L, allow_images = FALSE, only.model = FALSE)
+{
+  params <- list()
+  if (!is.null(columns_to_featurize)) params$columns_to_featurize <- as.list(columns_to_featurize)
+  if (!is.null(features_col)) params$features_col <- as.character(features_col)
+  if (!is.null(number_of_features)) params$number_of_features <- as.integer(number_of_features)
+  if (!is.null(one_hot_encode_categoricals)) params$one_hot_encode_categoricals <- as.logical(one_hot_encode_categoricals)
+  if (!is.null(max_one_hot_cardinality)) params$max_one_hot_cardinality <- as.integer(max_one_hot_cardinality)
+  if (!is.null(allow_images)) params$allow_images <- as.logical(allow_images)
+  .tpu_apply_stage("mmlspark_tpu.ops.featurize.AssembleFeatures", params, x, is_estimator = TRUE, only.model = only.model)
+}

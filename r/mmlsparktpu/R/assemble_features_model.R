@@ -1,0 +1,13 @@
+#' AssembleFeaturesModel (Model)
+#'
+#' AssembleFeaturesModel
+#'
+#' @param x a data.frame or tpu_table
+#' @param features_col output features column
+#' @export
+ml_assemble_features_model <- function(x, features_col = "features")
+{
+  params <- list()
+  if (!is.null(features_col)) params$features_col <- as.character(features_col)
+  .tpu_apply_stage("mmlspark_tpu.ops.featurize.AssembleFeaturesModel", params, x, is_estimator = FALSE)
+}

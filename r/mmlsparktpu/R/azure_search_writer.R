@@ -1,0 +1,25 @@
+#' AzureSearchWriter (Transformer)
+#'
+#' Write table rows as documents into a search index (sink stage: the output table is the input, unchanged).
+#'
+#' @param x a data.frame or tpu_table
+#' @param service_url search service base url
+#' @param index_definition index schema dict: {name, fields:[...]}
+#' @param api_key admin api key (api-key header)
+#' @param action upload | merge | mergeOrUpload | delete
+#' @param action_col column overriding the action per row
+#' @param batch_size documents per upload batch
+#' @param columns columns to index (default: all non-action columns)
+#' @export
+ml_azure_search_writer <- function(x, service_url, index_definition, api_key = NULL, action = "upload", action_col = NULL, batch_size = 100L, columns = NULL)
+{
+  params <- list()
+  if (!is.null(service_url)) params$service_url <- as.character(service_url)
+  if (!is.null(index_definition)) params$index_definition <- as.list(index_definition)
+  if (!is.null(api_key)) params$api_key <- as.character(api_key)
+  if (!is.null(action)) params$action <- as.character(action)
+  if (!is.null(action_col)) params$action_col <- as.character(action_col)
+  if (!is.null(batch_size)) params$batch_size <- as.integer(batch_size)
+  if (!is.null(columns)) params$columns <- as.list(columns)
+  .tpu_apply_stage("mmlspark_tpu.io_http.search.AzureSearchWriter", params, x, is_estimator = FALSE)
+}

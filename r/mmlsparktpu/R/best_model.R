@@ -1,0 +1,11 @@
+#' BestModel (Model)
+#'
+#' Reference: FindBestModel.scala:149-195.
+#'
+#' @param x a data.frame or tpu_table
+#' @export
+ml_best_model <- function(x)
+{
+  params <- list()
+  .tpu_apply_stage("mmlspark_tpu.automl.find_best.BestModel", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,31 @@
+#' BingImageSearch (Transformer)
+#'
+#' Bing image search (reference: ImageSearch.scala:23-296). Output: the `value` list of image results (contentUrl etc.).
+#'
+#' @param x a data.frame or tpu_table
+#' @param output_col parsed output column
+#' @param url service endpoint URL
+#' @param subscription_key api key (header)
+#' @param error_col error column (None = raise)
+#' @param concurrency in-flight requests
+#' @param timeout request timeout (s)
+#' @param query search query (scalar or column)
+#' @param count results per query
+#' @param offset result offset (paging)
+#' @param market market code, e.g. en-US
+#' @export
+ml_bing_image_search <- function(x, output_col = "response", url, subscription_key = NULL, error_col = NULL, concurrency = 1L, timeout = 60.0, query = NULL, count = 10L, offset = 0L, market = NULL)
+{
+  params <- list()
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  if (!is.null(url)) params$url <- as.character(url)
+  if (!is.null(subscription_key)) params$subscription_key <- as.character(subscription_key)
+  if (!is.null(error_col)) params$error_col <- as.character(error_col)
+  if (!is.null(concurrency)) params$concurrency <- as.integer(concurrency)
+  if (!is.null(timeout)) params$timeout <- as.double(timeout)
+  if (!is.null(query)) params$query <- query
+  if (!is.null(count)) params$count <- as.integer(count)
+  if (!is.null(offset)) params$offset <- as.integer(offset)
+  if (!is.null(market)) params$market <- as.character(market)
+  .tpu_apply_stage("mmlspark_tpu.io_http.cognitive.BingImageSearch", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,13 @@
+#' Cacher (Transformer)
+#'
+#' Materialize numeric columns as device-resident jax.Arrays so downstream compute stages skip the host->device transfer. Reference: pipeline-stages/Cacher.scala:12 (Spark .cache()); the TPU analogue of a hot cached Dataset is buffers already resident in HBM.
+#'
+#' @param x a data.frame or tpu_table
+#' @param disable skip caching
+#' @export
+ml_cacher <- function(x, disable = FALSE)
+{
+  params <- list()
+  if (!is.null(disable)) params$disable <- as.logical(disable)
+  .tpu_apply_stage("mmlspark_tpu.ops.stages.Cacher", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,17 @@
+#' CheckpointData (Transformer)
+#'
+#' Persist the table to host storage and continue from the materialized copy. Reference: checkpoint-data/CheckpointData.scala:49-78 (MEMORY_ONLY vs MEMORY_AND_DISK persist).
+#'
+#' @param x a data.frame or tpu_table
+#' @param to_disk write a npz snapshot to disk
+#' @param path snapshot path when to_disk
+#' @param remove_checkpoint delete a prior snapshot at path first
+#' @export
+ml_checkpoint_data <- function(x, to_disk = FALSE, path = NULL, remove_checkpoint = FALSE)
+{
+  params <- list()
+  if (!is.null(to_disk)) params$to_disk <- as.logical(to_disk)
+  if (!is.null(path)) params$path <- as.character(path)
+  if (!is.null(remove_checkpoint)) params$remove_checkpoint <- as.logical(remove_checkpoint)
+  .tpu_apply_stage("mmlspark_tpu.ops.stages.CheckpointData", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,18 @@
+#' ClassBalancer (Estimator)
+#'
+#' Compute inverse-frequency instance weights for label balance. Reference: pipeline-stages/ClassBalancer.scala:25-81.
+#'
+#' @param x a data.frame or tpu_table
+#' @param input_col label column
+#' @param output_col weight output column
+#' @param broadcast_join kept for API parity (ignored)
+#' @param only.model return the fitted model without transforming x (the reference's unfit.model)
+#' @export
+ml_class_balancer <- function(x, input_col, output_col = "weight", broadcast_join = TRUE, only.model = FALSE)
+{
+  params <- list()
+  if (!is.null(input_col)) params$input_col <- as.character(input_col)
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  if (!is.null(broadcast_join)) params$broadcast_join <- as.logical(broadcast_join)
+  .tpu_apply_stage("mmlspark_tpu.ops.stages.ClassBalancer", params, x, is_estimator = TRUE, only.model = only.model)
+}

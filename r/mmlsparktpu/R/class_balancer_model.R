@@ -1,0 +1,15 @@
+#' ClassBalancerModel (Model)
+#'
+#' ClassBalancerModel
+#'
+#' @param x a data.frame or tpu_table
+#' @param input_col label column
+#' @param output_col weight output column
+#' @export
+ml_class_balancer_model <- function(x, input_col, output_col = "weight")
+{
+  params <- list()
+  if (!is.null(input_col)) params$input_col <- as.character(input_col)
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  .tpu_apply_stage("mmlspark_tpu.ops.stages.ClassBalancerModel", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,20 @@
+#' CleanMissingData (Estimator)
+#'
+#' CleanMissingData
+#'
+#' @param x a data.frame or tpu_table
+#' @param input_cols columns to clean
+#' @param output_cols output columns
+#' @param cleaning_mode Mean | Median | Custom
+#' @param custom_value fill value for Custom mode
+#' @param only.model return the fitted model without transforming x (the reference's unfit.model)
+#' @export
+ml_clean_missing_data <- function(x, input_cols, output_cols, cleaning_mode = "Mean", custom_value = NULL, only.model = FALSE)
+{
+  params <- list()
+  if (!is.null(input_cols)) params$input_cols <- as.list(input_cols)
+  if (!is.null(output_cols)) params$output_cols <- as.list(output_cols)
+  if (!is.null(cleaning_mode)) params$cleaning_mode <- as.character(cleaning_mode)
+  if (!is.null(custom_value)) params$custom_value <- as.double(custom_value)
+  .tpu_apply_stage("mmlspark_tpu.ops.missing.CleanMissingData", params, x, is_estimator = TRUE, only.model = only.model)
+}

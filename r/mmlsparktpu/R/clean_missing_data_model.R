@@ -1,0 +1,15 @@
+#' CleanMissingDataModel (Model)
+#'
+#' CleanMissingDataModel
+#'
+#' @param x a data.frame or tpu_table
+#' @param input_cols columns to clean
+#' @param output_cols output columns
+#' @export
+ml_clean_missing_data_model <- function(x, input_cols, output_cols)
+{
+  params <- list()
+  if (!is.null(input_cols)) params$input_cols <- as.list(input_cols)
+  if (!is.null(output_cols)) params$output_cols <- as.list(output_cols)
+  .tpu_apply_stage("mmlspark_tpu.ops.missing.CleanMissingDataModel", params, x, is_estimator = FALSE)
+}

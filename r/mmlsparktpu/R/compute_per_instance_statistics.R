@@ -1,0 +1,19 @@
+#' ComputePerInstanceStatistics (Transformer)
+#'
+#' Per-row metrics: L1/L2 loss for regression, log-loss for classification. Reference ComputePerInstanceStatistics.scala:42+.
+#'
+#' @param x a data.frame or tpu_table
+#' @param label_col true-label column
+#' @param scores_col probability column (classification)
+#' @param scored_labels_col prediction column
+#' @param evaluation_metric classification | regression | all
+#' @export
+ml_compute_per_instance_statistics <- function(x, label_col = "label", scores_col = NULL, scored_labels_col = "scored_labels", evaluation_metric = "all")
+{
+  params <- list()
+  if (!is.null(label_col)) params$label_col <- as.character(label_col)
+  if (!is.null(scores_col)) params$scores_col <- as.character(scores_col)
+  if (!is.null(scored_labels_col)) params$scored_labels_col <- as.character(scored_labels_col)
+  if (!is.null(evaluation_metric)) params$evaluation_metric <- as.character(evaluation_metric)
+  .tpu_apply_stage("mmlspark_tpu.automl.metrics.ComputePerInstanceStatistics", params, x, is_estimator = FALSE)
+}

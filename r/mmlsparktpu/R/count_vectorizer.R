@@ -1,0 +1,20 @@
+#' CountVectorizer (Estimator)
+#'
+#' CountVectorizer
+#'
+#' @param x a data.frame or tpu_table
+#' @param output_col term-frequency vector column
+#' @param input_col token list column
+#' @param vocab_size max vocabulary size
+#' @param min_df min documents per term (count if >=1, fraction if <1)
+#' @param only.model return the fitted model without transforming x (the reference's unfit.model)
+#' @export
+ml_count_vectorizer <- function(x, output_col = "tf", input_col = "tokens", vocab_size = 262144L, min_df = 1.0, only.model = FALSE)
+{
+  params <- list()
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  if (!is.null(input_col)) params$input_col <- as.character(input_col)
+  if (!is.null(vocab_size)) params$vocab_size <- as.integer(vocab_size)
+  if (!is.null(min_df)) params$min_df <- as.double(min_df)
+  .tpu_apply_stage("mmlspark_tpu.text.featurizer.CountVectorizer", params, x, is_estimator = TRUE, only.model = only.model)
+}

@@ -1,0 +1,15 @@
+#' CountVectorizerModel (Model)
+#'
+#' CountVectorizerModel
+#'
+#' @param x a data.frame or tpu_table
+#' @param output_col term-frequency vector column
+#' @param input_col token list column
+#' @export
+ml_count_vectorizer_model <- function(x, output_col = "tf", input_col = "tokens")
+{
+  params <- list()
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  if (!is.null(input_col)) params$input_col <- as.character(input_col)
+  .tpu_apply_stage("mmlspark_tpu.text.featurizer.CountVectorizerModel", params, x, is_estimator = FALSE)
+}

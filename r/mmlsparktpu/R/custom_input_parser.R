@@ -1,0 +1,15 @@
+#' CustomInputParser (Transformer)
+#'
+#' udf column -> request (Parsers.scala:91-108).
+#'
+#' @param x a data.frame or tpu_table
+#' @param output_col request output column
+#' @param input_col input column
+#' @export
+ml_custom_input_parser <- function(x, output_col = "request", input_col = "input")
+{
+  params <- list()
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  if (!is.null(input_col)) params$input_col <- as.character(input_col)
+  .tpu_apply_stage("mmlspark_tpu.io_http.transformer.CustomInputParser", params, x, is_estimator = FALSE)
+}

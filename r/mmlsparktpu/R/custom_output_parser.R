@@ -1,0 +1,15 @@
+#' CustomOutputParser (Transformer)
+#'
+#' udf response -> value (Parsers.scala:182-199).
+#'
+#' @param x a data.frame or tpu_table
+#' @param output_col output column
+#' @param input_col HTTPResponseData column
+#' @export
+ml_custom_output_parser <- function(x, output_col = "output", input_col = "response")
+{
+  params <- list()
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  if (!is.null(input_col)) params$input_col <- as.character(input_col)
+  .tpu_apply_stage("mmlspark_tpu.io_http.transformer.CustomOutputParser", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,17 @@
+#' DataConversion (Transformer)
+#'
+#' DataConversion
+#'
+#' @param x a data.frame or tpu_table
+#' @param cols columns to convert
+#' @param convert_to target type: boolean|byte|short|integer|long|float|double|string|date
+#' @param date_time_format format for date conversion
+#' @export
+ml_data_conversion <- function(x, cols, convert_to, date_time_format = "%Y-%m-%d %H:%M:%S")
+{
+  params <- list()
+  if (!is.null(cols)) params$cols <- as.list(cols)
+  if (!is.null(convert_to)) params$convert_to <- as.character(convert_to)
+  if (!is.null(date_time_format)) params$date_time_format <- as.character(date_time_format)
+  .tpu_apply_stage("mmlspark_tpu.ops.conversion.DataConversion", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,48 @@
+#' DNNLearner (Estimator)
+#'
+#' Fit a deep model on a Table (the CNTKLearner surface, in-process).
+#'
+#' @param x a data.frame or tpu_table
+#' @param label_col name of the label column
+#' @param features_col name of the features column
+#' @param architecture architecture name (nn.models.ARCHITECTURES)
+#' @param model_config architecture config kwargs
+#' @param loss softmax_ce | mse
+#' @param optimizer adam|adamw|sgd|momentum|rmsprop
+#' @param learning_rate base learning rate
+#' @param epochs epochs over the table
+#' @param batch_size global batch size
+#' @param use_mesh data-parallel over the mesh data axis
+#' @param seed init + shuffle seed
+#' @param checkpoint_dir epoch checkpoint directory (resume if present)
+#' @param init_bundle_path warm start from a saved ModelBundle
+#' @param bfloat16 compute in bfloat16 (f32 params)
+#' @param remat rematerialize the forward in the backward pass
+#' @param trainable_prefixes list of param path prefixes to train (None=all)
+#' @param fused_epochs scan a whole epoch in one dispatch
+#' @param fused_epoch_budget_mb max table MB resident on device for the fused epoch path
+#' @param only.model return the fitted model without transforming x (the reference's unfit.model)
+#' @export
+ml_dnn_learner <- function(x, label_col = "label", features_col = "features", architecture = "mlp", model_config = NULL, loss = "softmax_ce", optimizer = "adam", learning_rate = 0.001, epochs = 5L, batch_size = 128L, use_mesh = TRUE, seed = 0L, checkpoint_dir = NULL, init_bundle_path = NULL, bfloat16 = TRUE, remat = FALSE, trainable_prefixes = NULL, fused_epochs = TRUE, fused_epoch_budget_mb = 512L, only.model = FALSE)
+{
+  params <- list()
+  if (!is.null(label_col)) params$label_col <- as.character(label_col)
+  if (!is.null(features_col)) params$features_col <- as.character(features_col)
+  if (!is.null(architecture)) params$architecture <- as.character(architecture)
+  if (!is.null(model_config)) params$model_config <- model_config
+  if (!is.null(loss)) params$loss <- as.character(loss)
+  if (!is.null(optimizer)) params$optimizer <- as.character(optimizer)
+  if (!is.null(learning_rate)) params$learning_rate <- as.double(learning_rate)
+  if (!is.null(epochs)) params$epochs <- as.integer(epochs)
+  if (!is.null(batch_size)) params$batch_size <- as.integer(batch_size)
+  if (!is.null(use_mesh)) params$use_mesh <- as.logical(use_mesh)
+  if (!is.null(seed)) params$seed <- as.integer(seed)
+  if (!is.null(checkpoint_dir)) params$checkpoint_dir <- as.character(checkpoint_dir)
+  if (!is.null(init_bundle_path)) params$init_bundle_path <- as.character(init_bundle_path)
+  if (!is.null(bfloat16)) params$bfloat16 <- as.logical(bfloat16)
+  if (!is.null(remat)) params$remat <- as.logical(remat)
+  if (!is.null(trainable_prefixes)) params$trainable_prefixes <- trainable_prefixes
+  if (!is.null(fused_epochs)) params$fused_epochs <- as.logical(fused_epochs)
+  if (!is.null(fused_epoch_budget_mb)) params$fused_epoch_budget_mb <- as.integer(fused_epoch_budget_mb)
+  .tpu_apply_stage("mmlspark_tpu.nn.trainer.DNNLearner", params, x, is_estimator = TRUE, only.model = only.model)
+}

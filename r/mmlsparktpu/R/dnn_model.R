@@ -1,0 +1,31 @@
+#' DNNModel (Model)
+#'
+#' Fitted DNNLearner output: DeepModelTransformer + argmax prediction.
+#'
+#' @param x a data.frame or tpu_table
+#' @param input_col input column (stacked to (n, ...))
+#' @param fetch_dict output column -> logits|probability|<layer path>
+#' @param mini_batch_size rows per compiled device batch
+#' @param use_mesh shard batches over the data mesh axis
+#' @param fused_dispatch scan all minibatches in one dispatch
+#' @param fused_dispatch_budget_mb max input MB eligible for the fused single-dispatch path
+#' @param bfloat16 run the forward in bfloat16 (MXU-native; outputs stay float32)
+#' @param prediction_col predicted label column
+#' @param classifier argmax labels (vs raw regression output)
+#' @param features_col input features column
+#' @export
+ml_dnn_model <- function(x, input_col = "features", fetch_dict = NULL, mini_batch_size = 64L, use_mesh = FALSE, fused_dispatch = TRUE, fused_dispatch_budget_mb = 512L, bfloat16 = FALSE, prediction_col = "prediction", classifier = TRUE, features_col = "features")
+{
+  params <- list()
+  if (!is.null(input_col)) params$input_col <- as.character(input_col)
+  if (!is.null(fetch_dict)) params$fetch_dict <- fetch_dict
+  if (!is.null(mini_batch_size)) params$mini_batch_size <- as.integer(mini_batch_size)
+  if (!is.null(use_mesh)) params$use_mesh <- as.logical(use_mesh)
+  if (!is.null(fused_dispatch)) params$fused_dispatch <- as.logical(fused_dispatch)
+  if (!is.null(fused_dispatch_budget_mb)) params$fused_dispatch_budget_mb <- as.integer(fused_dispatch_budget_mb)
+  if (!is.null(bfloat16)) params$bfloat16 <- as.logical(bfloat16)
+  if (!is.null(prediction_col)) params$prediction_col <- as.character(prediction_col)
+  if (!is.null(classifier)) params$classifier <- as.logical(classifier)
+  if (!is.null(features_col)) params$features_col <- as.character(features_col)
+  .tpu_apply_stage("mmlspark_tpu.nn.trainer.DNNModel", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,15 @@
+#' DropColumns (Transformer)
+#'
+#' Reference: pipeline-stages/DropColumns.scala:19.
+#'
+#' @param x a data.frame or tpu_table
+#' @param cols columns to drop
+#' @param ignore_missing skip absent columns silently
+#' @export
+ml_drop_columns <- function(x, cols, ignore_missing = FALSE)
+{
+  params <- list()
+  if (!is.null(cols)) params$cols <- as.list(cols)
+  if (!is.null(ignore_missing)) params$ignore_missing <- as.logical(ignore_missing)
+  .tpu_apply_stage("mmlspark_tpu.ops.stages.DropColumns", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,13 @@
+#' DynamicMiniBatchTransformer (Transformer)
+#'
+#' Batch whatever is available at once (MiniBatchTransformer.scala:42-63). On a materialized Table all rows are 'available', so this emits one batch — matching the reference's behavior for a fully-buffered partition.
+#'
+#' @param x a data.frame or tpu_table
+#' @param max_batch_size cap on batch size
+#' @export
+ml_dynamic_mini_batch_transformer <- function(x, max_batch_size = NULL)
+{
+  params <- list()
+  if (!is.null(max_batch_size)) params$max_batch_size <- as.integer(max_batch_size)
+  .tpu_apply_stage("mmlspark_tpu.ops.minibatch.DynamicMiniBatchTransformer", params, x, is_estimator = FALSE)
+}

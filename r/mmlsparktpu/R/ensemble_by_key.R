@@ -1,0 +1,23 @@
+#' EnsembleByKey (Transformer)
+#'
+#' EnsembleByKey
+#'
+#' @param x a data.frame or tpu_table
+#' @param keys key columns
+#' @param cols columns to aggregate
+#' @param col_names output names (default '<agg>(col)')
+#' @param strategy aggregation: mean | collect
+#' @param collapse_group one row per key (else broadcast back)
+#' @param vector_dims kept for API parity (unused)
+#' @export
+ml_ensemble_by_key <- function(x, keys, cols, col_names = NULL, strategy = "mean", collapse_group = TRUE, vector_dims = NULL)
+{
+  params <- list()
+  if (!is.null(keys)) params$keys <- as.list(keys)
+  if (!is.null(cols)) params$cols <- as.list(cols)
+  if (!is.null(col_names)) params$col_names <- as.list(col_names)
+  if (!is.null(strategy)) params$strategy <- as.character(strategy)
+  if (!is.null(collapse_group)) params$collapse_group <- as.logical(collapse_group)
+  if (!is.null(vector_dims)) params$vector_dims <- as.list(vector_dims)
+  .tpu_apply_stage("mmlspark_tpu.ops.ensemble.EnsembleByKey", params, x, is_estimator = FALSE)
+}

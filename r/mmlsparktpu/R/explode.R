@@ -1,0 +1,15 @@
+#' Explode (Transformer)
+#'
+#' Explode a list/array column into one row per element. Reference: pipeline-stages/Explode.scala:15.
+#'
+#' @param x a data.frame or tpu_table
+#' @param input_col column holding sequences
+#' @param output_col output column (default: input col)
+#' @export
+ml_explode <- function(x, input_col, output_col = NULL)
+{
+  params <- list()
+  if (!is.null(input_col)) params$input_col <- as.character(input_col)
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  .tpu_apply_stage("mmlspark_tpu.ops.stages.Explode", params, x, is_estimator = FALSE)
+}

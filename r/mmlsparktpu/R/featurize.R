@@ -1,0 +1,22 @@
+#' Featurize (Estimator)
+#'
+#' Auto-featurize columns into feature vector column(s). Reference: featurize/Featurize.scala:24-100 (feature_columns maps each output column to the set of input columns assembled into it).
+#'
+#' @param x a data.frame or tpu_table
+#' @param feature_columns dict: output features col -> list of input cols
+#' @param number_of_features hash buckets
+#' @param one_hot_encode_categoricals one-hot categoricals
+#' @param max_one_hot_cardinality low-cardinality string columns one-hot instead of hash
+#' @param allow_images kept for API parity
+#' @param only.model return the fitted model without transforming x (the reference's unfit.model)
+#' @export
+ml_featurize <- function(x, feature_columns, number_of_features = 4096L, one_hot_encode_categoricals = TRUE, max_one_hot_cardinality = 100L, allow_images = FALSE, only.model = FALSE)
+{
+  params <- list()
+  if (!is.null(feature_columns)) params$feature_columns <- as.list(feature_columns)
+  if (!is.null(number_of_features)) params$number_of_features <- as.integer(number_of_features)
+  if (!is.null(one_hot_encode_categoricals)) params$one_hot_encode_categoricals <- as.logical(one_hot_encode_categoricals)
+  if (!is.null(max_one_hot_cardinality)) params$max_one_hot_cardinality <- as.integer(max_one_hot_cardinality)
+  if (!is.null(allow_images)) params$allow_images <- as.logical(allow_images)
+  .tpu_apply_stage("mmlspark_tpu.ops.featurize.Featurize", params, x, is_estimator = TRUE, only.model = only.model)
+}

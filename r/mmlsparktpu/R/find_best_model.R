@@ -1,0 +1,18 @@
+#' FindBestModel (Estimator)
+#'
+#' FindBestModel
+#'
+#' @param x a data.frame or tpu_table
+#' @param label_col name of the label column
+#' @param models list of FITTED transformers to compare
+#' @param evaluation_metric metric to rank by
+#' @param only.model return the fitted model without transforming x (the reference's unfit.model)
+#' @export
+ml_find_best_model <- function(x, label_col = "label", models, evaluation_metric = "accuracy", only.model = FALSE)
+{
+  params <- list()
+  if (!is.null(label_col)) params$label_col <- as.character(label_col)
+  if (!is.null(models)) params$models <- models
+  if (!is.null(evaluation_metric)) params$evaluation_metric <- as.character(evaluation_metric)
+  .tpu_apply_stage("mmlspark_tpu.automl.find_best.FindBestModel", params, x, is_estimator = TRUE, only.model = only.model)
+}

@@ -1,0 +1,31 @@
+#' FindSimilarFace (Transformer)
+#'
+#' Find faces similar to a query face (Face.scala:120-180).
+#'
+#' @param x a data.frame or tpu_table
+#' @param output_col parsed output column
+#' @param url service endpoint URL
+#' @param subscription_key api key (header)
+#' @param error_col error column (None = raise)
+#' @param concurrency in-flight requests
+#' @param timeout request timeout (s)
+#' @param face_id query face id (scalar or column)
+#' @param face_ids candidate face id list (scalar or column)
+#' @param max_candidates max matches returned
+#' @param mode matchPerson | matchFace
+#' @export
+ml_find_similar_face <- function(x, output_col = "response", url, subscription_key = NULL, error_col = NULL, concurrency = 1L, timeout = 60.0, face_id = NULL, face_ids = NULL, max_candidates = 20L, mode = "matchPerson")
+{
+  params <- list()
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  if (!is.null(url)) params$url <- as.character(url)
+  if (!is.null(subscription_key)) params$subscription_key <- as.character(subscription_key)
+  if (!is.null(error_col)) params$error_col <- as.character(error_col)
+  if (!is.null(concurrency)) params$concurrency <- as.integer(concurrency)
+  if (!is.null(timeout)) params$timeout <- as.double(timeout)
+  if (!is.null(face_id)) params$face_id <- face_id
+  if (!is.null(face_ids)) params$face_ids <- face_ids
+  if (!is.null(max_candidates)) params$max_candidates <- as.integer(max_candidates)
+  if (!is.null(mode)) params$mode <- as.character(mode)
+  .tpu_apply_stage("mmlspark_tpu.io_http.cognitive.FindSimilarFace", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,17 @@
+#' FixedMiniBatchTransformer (Transformer)
+#'
+#' Group rows into fixed-size batches (MiniBatchTransformer.scala:138-169).
+#'
+#' @param x a data.frame or tpu_table
+#' @param batch_size rows per batch
+#' @param max_buffer_size kept for API parity (unused)
+#' @param buffered kept for API parity (unused)
+#' @export
+ml_fixed_mini_batch_transformer <- function(x, batch_size, max_buffer_size = NULL, buffered = FALSE)
+{
+  params <- list()
+  if (!is.null(batch_size)) params$batch_size <- as.integer(batch_size)
+  if (!is.null(max_buffer_size)) params$max_buffer_size <- as.integer(max_buffer_size)
+  if (!is.null(buffered)) params$buffered <- as.logical(buffered)
+  .tpu_apply_stage("mmlspark_tpu.ops.minibatch.FixedMiniBatchTransformer", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,11 @@
+#' FlattenBatch (Transformer)
+#'
+#' Invert batching: one row per element (MiniBatchTransformer.scala:173-203).
+#'
+#' @param x a data.frame or tpu_table
+#' @export
+ml_flatten_batch <- function(x)
+{
+  params <- list()
+  .tpu_apply_stage("mmlspark_tpu.ops.minibatch.FlattenBatch", params, x, is_estimator = FALSE)
+}

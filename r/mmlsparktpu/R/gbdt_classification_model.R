@@ -1,0 +1,19 @@
+#' GBDTClassificationModel (Model)
+#'
+#' Reference: LightGBMClassificationModel (LightGBMClassifier.scala:98-158) — but scoring is one jitted batched traversal, not per-row JNI calls.
+#'
+#' @param x a data.frame or tpu_table
+#' @param prediction_col name of the prediction column
+#' @param features_col name of the features column
+#' @param raw_prediction_col margin scores output column
+#' @param probability_col probability output column
+#' @export
+ml_gbdt_classification_model <- function(x, prediction_col = "prediction", features_col = "features", raw_prediction_col = "raw_prediction", probability_col = "probability")
+{
+  params <- list()
+  if (!is.null(prediction_col)) params$prediction_col <- as.character(prediction_col)
+  if (!is.null(features_col)) params$features_col <- as.character(features_col)
+  if (!is.null(raw_prediction_col)) params$raw_prediction_col <- as.character(raw_prediction_col)
+  if (!is.null(probability_col)) params$probability_col <- as.character(probability_col)
+  .tpu_apply_stage("mmlspark_tpu.gbdt.estimators.GBDTClassificationModel", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,92 @@
+#' GBDTClassifier (Estimator)
+#'
+#' Distributed histogram-GBDT classifier (reference LightGBMClassifier, src/lightgbm/src/main/scala/LightGBMClassifier.scala:27-94).
+#'
+#' @param x a data.frame or tpu_table
+#' @param prediction_col name of the prediction column
+#' @param weight_col name of the instance-weight column
+#' @param label_col name of the label column
+#' @param features_col name of the features column
+#' @param boosting_type gbdt|rf|dart|goss
+#' @param num_iterations number of boosting rounds
+#' @param learning_rate shrinkage rate
+#' @param num_leaves max leaves per tree
+#' @param max_bin max histogram bins per feature
+#' @param max_depth max tree depth (<=0 unlimited)
+#' @param min_data_in_leaf min rows per leaf
+#' @param min_sum_hessian_in_leaf min hessian sum per leaf
+#' @param lambda_l1 L1 regularization
+#' @param lambda_l2 L2 regularization
+#' @param min_gain_to_split min split gain
+#' @param bagging_fraction row subsample fraction
+#' @param bagging_freq bagging frequency (0=off)
+#' @param bagging_seed bagging rng seed
+#' @param feature_fraction feature subsample fraction per tree
+#' @param early_stopping_round stop if no val improvement for N rounds
+#' @param validation_fraction fraction of rows held out for early stopping
+#' @param categorical_slot_indexes indexes of categorical feature slots
+#' @param bin_dtype device bin-matrix dtype: int32 | uint8 (4x less histogram HBM read)
+#' @param device_binning bin the training matrix on device (f32 compares; numeric features only)
+#' @param bin_construct_sample_cnt rows sampled per column for bin-boundary construction (0 = all)
+#' @param cat_smooth categorical smoothing for the sorted-subset split order
+#' @param cat_l2 extra L2 regularization on categorical splits
+#' @param max_cat_threshold max categories on the smaller side of a categorical split
+#' @param model_string warm-start model text (reference modelString)
+#' @param boost_from_average init score from label average
+#' @param use_mesh shard rows over the data mesh axis (psum histograms)
+#' @param tree_learner data_parallel | voting_parallel (LightGBMParams.scala:12-14)
+#' @param top_k voting-parallel local candidate count
+#' @param deterministic bit-exact histogram merge under any reduction order / device permutation (LightGBM's deterministic flag; parallel/collectives.py)
+#' @param verbosity logging verbosity
+#' @param seed master rng seed
+#' @param raw_prediction_col margin scores output column
+#' @param probability_col probability output column
+#' @param is_unbalance reweight classes by inverse frequency
+#' @param objective binary|multiclass (auto-upgraded by label arity)
+#' @param only.model return the fitted model without transforming x (the reference's unfit.model)
+#' @export
+ml_gbdt_classifier <- function(x, prediction_col = "prediction", weight_col = NULL, label_col = "label", features_col = "features", boosting_type = "gbdt", num_iterations = 100L, learning_rate = 0.1, num_leaves = 31L, max_bin = 255L, max_depth = -1L, min_data_in_leaf = 20L, min_sum_hessian_in_leaf = 0.001, lambda_l1 = 0.0, lambda_l2 = 0.0, min_gain_to_split = 0.0, bagging_fraction = 1.0, bagging_freq = 0L, bagging_seed = 3L, feature_fraction = 1.0, early_stopping_round = 0L, validation_fraction = 0.0, categorical_slot_indexes = NULL, bin_dtype = "int32", device_binning = FALSE, bin_construct_sample_cnt = 200000L, cat_smooth = 10.0, cat_l2 = 10.0, max_cat_threshold = 32L, model_string = NULL, boost_from_average = TRUE, use_mesh = FALSE, tree_learner = "data_parallel", top_k = 20L, deterministic = FALSE, verbosity = 1L, seed = 0L, raw_prediction_col = "raw_prediction", probability_col = "probability", is_unbalance = FALSE, objective = "binary", only.model = FALSE)
+{
+  params <- list()
+  if (!is.null(prediction_col)) params$prediction_col <- as.character(prediction_col)
+  if (!is.null(weight_col)) params$weight_col <- as.character(weight_col)
+  if (!is.null(label_col)) params$label_col <- as.character(label_col)
+  if (!is.null(features_col)) params$features_col <- as.character(features_col)
+  if (!is.null(boosting_type)) params$boosting_type <- as.character(boosting_type)
+  if (!is.null(num_iterations)) params$num_iterations <- as.integer(num_iterations)
+  if (!is.null(learning_rate)) params$learning_rate <- as.double(learning_rate)
+  if (!is.null(num_leaves)) params$num_leaves <- as.integer(num_leaves)
+  if (!is.null(max_bin)) params$max_bin <- as.integer(max_bin)
+  if (!is.null(max_depth)) params$max_depth <- as.integer(max_depth)
+  if (!is.null(min_data_in_leaf)) params$min_data_in_leaf <- as.integer(min_data_in_leaf)
+  if (!is.null(min_sum_hessian_in_leaf)) params$min_sum_hessian_in_leaf <- as.double(min_sum_hessian_in_leaf)
+  if (!is.null(lambda_l1)) params$lambda_l1 <- as.double(lambda_l1)
+  if (!is.null(lambda_l2)) params$lambda_l2 <- as.double(lambda_l2)
+  if (!is.null(min_gain_to_split)) params$min_gain_to_split <- as.double(min_gain_to_split)
+  if (!is.null(bagging_fraction)) params$bagging_fraction <- as.double(bagging_fraction)
+  if (!is.null(bagging_freq)) params$bagging_freq <- as.integer(bagging_freq)
+  if (!is.null(bagging_seed)) params$bagging_seed <- as.integer(bagging_seed)
+  if (!is.null(feature_fraction)) params$feature_fraction <- as.double(feature_fraction)
+  if (!is.null(early_stopping_round)) params$early_stopping_round <- as.integer(early_stopping_round)
+  if (!is.null(validation_fraction)) params$validation_fraction <- as.double(validation_fraction)
+  if (!is.null(categorical_slot_indexes)) params$categorical_slot_indexes <- as.list(categorical_slot_indexes)
+  if (!is.null(bin_dtype)) params$bin_dtype <- as.character(bin_dtype)
+  if (!is.null(device_binning)) params$device_binning <- as.logical(device_binning)
+  if (!is.null(bin_construct_sample_cnt)) params$bin_construct_sample_cnt <- as.integer(bin_construct_sample_cnt)
+  if (!is.null(cat_smooth)) params$cat_smooth <- as.double(cat_smooth)
+  if (!is.null(cat_l2)) params$cat_l2 <- as.double(cat_l2)
+  if (!is.null(max_cat_threshold)) params$max_cat_threshold <- as.integer(max_cat_threshold)
+  if (!is.null(model_string)) params$model_string <- as.character(model_string)
+  if (!is.null(boost_from_average)) params$boost_from_average <- as.logical(boost_from_average)
+  if (!is.null(use_mesh)) params$use_mesh <- as.logical(use_mesh)
+  if (!is.null(tree_learner)) params$tree_learner <- as.character(tree_learner)
+  if (!is.null(top_k)) params$top_k <- as.integer(top_k)
+  if (!is.null(deterministic)) params$deterministic <- as.logical(deterministic)
+  if (!is.null(verbosity)) params$verbosity <- as.integer(verbosity)
+  if (!is.null(seed)) params$seed <- as.integer(seed)
+  if (!is.null(raw_prediction_col)) params$raw_prediction_col <- as.character(raw_prediction_col)
+  if (!is.null(probability_col)) params$probability_col <- as.character(probability_col)
+  if (!is.null(is_unbalance)) params$is_unbalance <- as.logical(is_unbalance)
+  if (!is.null(objective)) params$objective <- as.character(objective)
+  .tpu_apply_stage("mmlspark_tpu.gbdt.estimators.GBDTClassifier", params, x, is_estimator = TRUE, only.model = only.model)
+}

@@ -1,0 +1,15 @@
+#' GBDTRegressionModel (Model)
+#'
+#' Reference: LightGBMRegressionModel (LightGBMRegressor.scala:103-156).
+#'
+#' @param x a data.frame or tpu_table
+#' @param prediction_col name of the prediction column
+#' @param features_col name of the features column
+#' @export
+ml_gbdt_regression_model <- function(x, prediction_col = "prediction", features_col = "features")
+{
+  params <- list()
+  if (!is.null(prediction_col)) params$prediction_col <- as.character(prediction_col)
+  if (!is.null(features_col)) params$features_col <- as.character(features_col)
+  .tpu_apply_stage("mmlspark_tpu.gbdt.estimators.GBDTRegressionModel", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,25 @@
+#' GroupFaces (Transformer)
+#'
+#' Partition faces into similarity groups (Face.scala:182-220).
+#'
+#' @param x a data.frame or tpu_table
+#' @param output_col parsed output column
+#' @param url service endpoint URL
+#' @param subscription_key api key (header)
+#' @param error_col error column (None = raise)
+#' @param concurrency in-flight requests
+#' @param timeout request timeout (s)
+#' @param face_ids face id list (scalar or column)
+#' @export
+ml_group_faces <- function(x, output_col = "response", url, subscription_key = NULL, error_col = NULL, concurrency = 1L, timeout = 60.0, face_ids = NULL)
+{
+  params <- list()
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  if (!is.null(url)) params$url <- as.character(url)
+  if (!is.null(subscription_key)) params$subscription_key <- as.character(subscription_key)
+  if (!is.null(error_col)) params$error_col <- as.character(error_col)
+  if (!is.null(concurrency)) params$concurrency <- as.integer(concurrency)
+  if (!is.null(timeout)) params$timeout <- as.double(timeout)
+  if (!is.null(face_ids)) params$face_ids <- face_ids
+  .tpu_apply_stage("mmlspark_tpu.io_http.cognitive.GroupFaces", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,19 @@
+#' HashingTF (Transformer)
+#'
+#' Default buckets: 2^12 (the reference's tree-learner default, Featurize.scala:13-19) — NOT the reference text default of 2^18, because Table columns are dense: 2^18 float64 costs 2 MB/doc. Raise num_features explicitly for large vocabularies.
+#'
+#' @param x a data.frame or tpu_table
+#' @param output_col term-frequency vector column
+#' @param input_col token list column
+#' @param num_features hash buckets
+#' @param binary presence instead of counts
+#' @export
+ml_hashing_tf <- function(x, output_col = "tf", input_col = "tokens", num_features = 4096L, binary = FALSE)
+{
+  params <- list()
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  if (!is.null(input_col)) params$input_col <- as.character(input_col)
+  if (!is.null(num_features)) params$num_features <- as.integer(num_features)
+  if (!is.null(binary)) params$binary <- as.logical(binary)
+  .tpu_apply_stage("mmlspark_tpu.text.featurizer.HashingTF", params, x, is_estimator = FALSE)
+}

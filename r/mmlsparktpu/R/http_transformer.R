@@ -1,0 +1,21 @@
+#' HTTPTransformer (Transformer)
+#'
+#' Request column -> response column (HTTPTransformer.scala:78-128).
+#'
+#' @param x a data.frame or tpu_table
+#' @param output_col HTTPResponseData column
+#' @param input_col HTTPRequestData column
+#' @param concurrency in-flight requests per call
+#' @param timeout per-request timeout (s)
+#' @param retries retry attempts (429/5xx/conn)
+#' @export
+ml_http_transformer <- function(x, output_col = "response", input_col = "request", concurrency = 1L, timeout = 60.0, retries = 3L)
+{
+  params <- list()
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  if (!is.null(input_col)) params$input_col <- as.character(input_col)
+  if (!is.null(concurrency)) params$concurrency <- as.integer(concurrency)
+  if (!is.null(timeout)) params$timeout <- as.double(timeout)
+  if (!is.null(retries)) params$retries <- as.integer(retries)
+  .tpu_apply_stage("mmlspark_tpu.io_http.transformer.HTTPTransformer", params, x, is_estimator = FALSE)
+}

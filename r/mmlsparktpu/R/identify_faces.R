@@ -1,0 +1,31 @@
+#' IdentifyFaces (Transformer)
+#'
+#' Identify faces against a person group (Face.scala:222-280).
+#'
+#' @param x a data.frame or tpu_table
+#' @param output_col parsed output column
+#' @param url service endpoint URL
+#' @param subscription_key api key (header)
+#' @param error_col error column (None = raise)
+#' @param concurrency in-flight requests
+#' @param timeout request timeout (s)
+#' @param person_group_id person group id (scalar or column)
+#' @param face_ids face id list (scalar or column)
+#' @param max_candidates candidates per face
+#' @param confidence_threshold identification confidence floor
+#' @export
+ml_identify_faces <- function(x, output_col = "response", url, subscription_key = NULL, error_col = NULL, concurrency = 1L, timeout = 60.0, person_group_id = NULL, face_ids = NULL, max_candidates = 1L, confidence_threshold = NULL)
+{
+  params <- list()
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  if (!is.null(url)) params$url <- as.character(url)
+  if (!is.null(subscription_key)) params$subscription_key <- as.character(subscription_key)
+  if (!is.null(error_col)) params$error_col <- as.character(error_col)
+  if (!is.null(concurrency)) params$concurrency <- as.integer(concurrency)
+  if (!is.null(timeout)) params$timeout <- as.double(timeout)
+  if (!is.null(person_group_id)) params$person_group_id <- person_group_id
+  if (!is.null(face_ids)) params$face_ids <- face_ids
+  if (!is.null(max_candidates)) params$max_candidates <- as.integer(max_candidates)
+  if (!is.null(confidence_threshold)) params$confidence_threshold <- as.double(confidence_threshold)
+  .tpu_apply_stage("mmlspark_tpu.io_http.cognitive.IdentifyFaces", params, x, is_estimator = FALSE)
+}

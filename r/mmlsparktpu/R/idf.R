@@ -1,0 +1,18 @@
+#' IDF (Estimator)
+#'
+#' IDF
+#'
+#' @param x a data.frame or tpu_table
+#' @param output_col tf-idf vectors
+#' @param input_col term-frequency vectors
+#' @param min_doc_freq zero out terms in fewer docs
+#' @param only.model return the fitted model without transforming x (the reference's unfit.model)
+#' @export
+ml_idf <- function(x, output_col = "tfidf", input_col = "tf", min_doc_freq = 0L, only.model = FALSE)
+{
+  params <- list()
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  if (!is.null(input_col)) params$input_col <- as.character(input_col)
+  if (!is.null(min_doc_freq)) params$min_doc_freq <- as.integer(min_doc_freq)
+  .tpu_apply_stage("mmlspark_tpu.text.featurizer.IDF", params, x, is_estimator = TRUE, only.model = only.model)
+}

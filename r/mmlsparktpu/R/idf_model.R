@@ -1,0 +1,15 @@
+#' IDFModel (Model)
+#'
+#' IDFModel
+#'
+#' @param x a data.frame or tpu_table
+#' @param output_col tf-idf vectors
+#' @param input_col term-frequency vectors
+#' @export
+ml_idf_model <- function(x, output_col = "tfidf", input_col = "tf")
+{
+  params <- list()
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  if (!is.null(input_col)) params$input_col <- as.character(input_col)
+  .tpu_apply_stage("mmlspark_tpu.text.featurizer.IDFModel", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,35 @@
+#' ImageLIME (Transformer)
+#'
+#' Local linear explanation of an image model (reference ImageLIME.scala:27-120).
+#'
+#' @param x a data.frame or tpu_table
+#' @param output_col per-superpixel importance column
+#' @param input_col image column
+#' @param model fitted Transformer scoring the image column
+#' @param superpixel_col emitted superpixel labels column
+#' @param prediction_col model output column to explain
+#' @param target_class class index to explain (default: argmax)
+#' @param num_samples perturbed copies per image
+#' @param sampling_fraction P(keep superpixel)
+#' @param regularization ridge lambda
+#' @param cell_size superpixel cell size
+#' @param fill_value censored-pixel fill value
+#' @param seed mask sampling seed
+#' @export
+ml_image_lime <- function(x, output_col = "weights", input_col = "image", model, superpixel_col = "superpixels", prediction_col = "probability", target_class = NULL, num_samples = 300L, sampling_fraction = 0.7, regularization = 0.001, cell_size = 16L, fill_value = 0.0, seed = 0L)
+{
+  params <- list()
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  if (!is.null(input_col)) params$input_col <- as.character(input_col)
+  if (!is.null(model)) params$model <- model
+  if (!is.null(superpixel_col)) params$superpixel_col <- as.character(superpixel_col)
+  if (!is.null(prediction_col)) params$prediction_col <- as.character(prediction_col)
+  if (!is.null(target_class)) params$target_class <- as.integer(target_class)
+  if (!is.null(num_samples)) params$num_samples <- as.integer(num_samples)
+  if (!is.null(sampling_fraction)) params$sampling_fraction <- as.double(sampling_fraction)
+  if (!is.null(regularization)) params$regularization <- as.double(regularization)
+  if (!is.null(cell_size)) params$cell_size <- as.integer(cell_size)
+  if (!is.null(fill_value)) params$fill_value <- as.double(fill_value)
+  if (!is.null(seed)) params$seed <- as.integer(seed)
+  .tpu_apply_stage("mmlspark_tpu.automl.lime.ImageLIME", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,19 @@
+#' ImageSetAugmenter (Transformer)
+#'
+#' ImageSetAugmenter
+#'
+#' @param x a data.frame or tpu_table
+#' @param output_col output image column
+#' @param input_col image column
+#' @param flip_left_right add horizontally flipped copies
+#' @param flip_up_down add vertically flipped copies
+#' @export
+ml_image_set_augmenter <- function(x, output_col = "image", input_col = "image", flip_left_right = TRUE, flip_up_down = FALSE)
+{
+  params <- list()
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  if (!is.null(input_col)) params$input_col <- as.character(input_col)
+  if (!is.null(flip_left_right)) params$flip_left_right <- as.logical(flip_left_right)
+  if (!is.null(flip_up_down)) params$flip_up_down <- as.logical(flip_up_down)
+  .tpu_apply_stage("mmlspark_tpu.image.augmenter.ImageSetAugmenter", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,17 @@
+#' ImageTransformer (Transformer)
+#'
+#' Apply a chain of pixel ops to an image column.
+#'
+#' @param x a data.frame or tpu_table
+#' @param output_col output image column
+#' @param input_col input image column
+#' @param stages list of {'op': ..., **params} op descriptors
+#' @export
+ml_image_transformer <- function(x, output_col = "image_out", input_col = "image", stages = NULL)
+{
+  params <- list()
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  if (!is.null(input_col)) params$input_col <- as.character(input_col)
+  if (!is.null(stages)) params$stages <- stages
+  .tpu_apply_stage("mmlspark_tpu.image.transformer.ImageTransformer", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,15 @@
+#' IndexToValue (Transformer)
+#'
+#' Invert an indexed column back to original values using CATEGORY_VALUES metadata. Reference: value-indexer/IndexToValue.scala:26+.
+#'
+#' @param x a data.frame or tpu_table
+#' @param input_col indexed column
+#' @param output_col output column
+#' @export
+ml_index_to_value <- function(x, input_col, output_col)
+{
+  params <- list()
+  if (!is.null(input_col)) params$input_col <- as.character(input_col)
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  .tpu_apply_stage("mmlspark_tpu.ops.indexer.IndexToValue", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,21 @@
+#' JSONInputParser (Transformer)
+#'
+#' Column value -> JSON POST request (Parsers.scala:60-89).
+#'
+#' @param x a data.frame or tpu_table
+#' @param output_col HTTPRequestData output column
+#' @param input_col column with JSON-able payloads
+#' @param url target URL
+#' @param method HTTP method
+#' @param headers extra headers
+#' @export
+ml_json_input_parser <- function(x, output_col = "request", input_col = "input", url, method = "POST", headers = NULL)
+{
+  params <- list()
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  if (!is.null(input_col)) params$input_col <- as.character(input_col)
+  if (!is.null(url)) params$url <- as.character(url)
+  if (!is.null(method)) params$method <- as.character(method)
+  if (!is.null(headers)) params$headers <- headers
+  .tpu_apply_stage("mmlspark_tpu.io_http.transformer.JSONInputParser", params, x, is_estimator = FALSE)
+}

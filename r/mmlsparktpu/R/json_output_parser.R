@@ -1,0 +1,17 @@
+#' JSONOutputParser (Transformer)
+#'
+#' Response -> parsed JSON body (Parsers.scala:110-162).
+#'
+#' @param x a data.frame or tpu_table
+#' @param output_col parsed output column
+#' @param input_col HTTPResponseData column
+#' @param field_path dotted path into the JSON body
+#' @export
+ml_json_output_parser <- function(x, output_col = "output", input_col = "response", field_path = NULL)
+{
+  params <- list()
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  if (!is.null(input_col)) params$input_col <- as.character(input_col)
+  if (!is.null(field_path)) params$field_path <- as.character(field_path)
+  .tpu_apply_stage("mmlspark_tpu.io_http.transformer.JSONOutputParser", params, x, is_estimator = FALSE)
+}

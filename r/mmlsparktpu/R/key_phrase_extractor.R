@@ -1,0 +1,27 @@
+#' KeyPhraseExtractor (Transformer)
+#'
+#' Reference: KeyPhraseExtractor (TextAnalytics.scala:192-212).
+#'
+#' @param x a data.frame or tpu_table
+#' @param output_col parsed output column
+#' @param url service endpoint URL
+#' @param subscription_key api key (header)
+#' @param error_col error column (None = raise)
+#' @param concurrency in-flight requests
+#' @param timeout request timeout (s)
+#' @param text text to analyze (scalar or column)
+#' @param language language hint
+#' @export
+ml_key_phrase_extractor <- function(x, output_col = "response", url, subscription_key = NULL, error_col = NULL, concurrency = 1L, timeout = 60.0, text = NULL, language = "en")
+{
+  params <- list()
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  if (!is.null(url)) params$url <- as.character(url)
+  if (!is.null(subscription_key)) params$subscription_key <- as.character(subscription_key)
+  if (!is.null(error_col)) params$error_col <- as.character(error_col)
+  if (!is.null(concurrency)) params$concurrency <- as.integer(concurrency)
+  if (!is.null(timeout)) params$timeout <- as.double(timeout)
+  if (!is.null(text)) params$text <- text
+  if (!is.null(language)) params$language <- language
+  .tpu_apply_stage("mmlspark_tpu.io_http.cognitive.KeyPhraseExtractor", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,13 @@
+#' Lambda (Transformer)
+#'
+#' Arbitrary Table -> Table function as a stage. Reference: pipeline-stages/Lambda.scala:20. Not serializable unless the function is importable (saved by dotted path).
+#'
+#' @param x a data.frame or tpu_table
+#' @param fn callable Table -> Table
+#' @export
+ml_lambda <- function(x, fn)
+{
+  params <- list()
+  if (!is.null(fn)) params$fn <- fn
+  .tpu_apply_stage("mmlspark_tpu.ops.stages.Lambda", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,18 @@
+#' MultiColumnAdapter (Estimator)
+#'
+#' MultiColumnAdapter
+#'
+#' @param x a data.frame or tpu_table
+#' @param base_stage single-column stage to replicate
+#' @param input_cols input columns
+#' @param output_cols output columns
+#' @param only.model return the fitted model without transforming x (the reference's unfit.model)
+#' @export
+ml_multi_column_adapter <- function(x, base_stage, input_cols, output_cols, only.model = FALSE)
+{
+  params <- list()
+  if (!is.null(base_stage)) params$base_stage <- base_stage
+  if (!is.null(input_cols)) params$input_cols <- as.list(input_cols)
+  if (!is.null(output_cols)) params$output_cols <- as.list(output_cols)
+  .tpu_apply_stage("mmlspark_tpu.ops.adapter.MultiColumnAdapter", params, x, is_estimator = TRUE, only.model = only.model)
+}

@@ -1,0 +1,13 @@
+#' MultiColumnAdapterModel (Model)
+#'
+#' MultiColumnAdapterModel
+#'
+#' @param x a data.frame or tpu_table
+#' @param stages fitted per-column stages
+#' @export
+ml_multi_column_adapter_model <- function(x, stages = NULL)
+{
+  params <- list()
+  if (!is.null(stages)) params$stages <- as.list(stages)
+  .tpu_apply_stage("mmlspark_tpu.ops.adapter.MultiColumnAdapterModel", params, x, is_estimator = FALSE)
+}

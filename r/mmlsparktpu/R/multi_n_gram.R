@@ -1,0 +1,17 @@
+#' MultiNGram (Transformer)
+#'
+#' MultiNGram
+#'
+#' @param x a data.frame or tpu_table
+#' @param output_col combined ngram column
+#' @param input_col token list column
+#' @param lengths ngram lengths to concatenate
+#' @export
+ml_multi_n_gram <- function(x, output_col = "ngrams", input_col = "tokens", lengths = NULL)
+{
+  params <- list()
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  if (!is.null(input_col)) params$input_col <- as.character(input_col)
+  if (!is.null(lengths)) params$lengths <- lengths
+  .tpu_apply_stage("mmlspark_tpu.text.multi_ngram.MultiNGram", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,17 @@
+#' NGram (Transformer)
+#'
+#' NGram
+#'
+#' @param x a data.frame or tpu_table
+#' @param output_col ngram list column
+#' @param input_col token list column
+#' @param n ngram length
+#' @export
+ml_n_gram <- function(x, output_col = "ngrams", input_col = "tokens", n = 2L)
+{
+  params <- list()
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  if (!is.null(input_col)) params$input_col <- as.character(input_col)
+  if (!is.null(n)) params$n <- as.integer(n)
+  .tpu_apply_stage("mmlspark_tpu.text.featurizer.NGram", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,63 @@
+# Bridge runtime for the generated wrappers (the sparklyr-connection
+# analogue, SparklyRWrapper.scala:30-52 — here the "connection" is an
+# embedded Python interpreter via reticulate).
+
+.tpu_env <- new.env(parent = emptyenv())
+
+.tpu <- function() {
+  if (is.null(.tpu_env$pkg)) {
+    .tpu_env$pkg <- reticulate::import("mmlspark_tpu")
+    for (sub in c("core", "gbdt", "nn", "image", "ops", "text", "automl", "recommendation", "io_http", "plot", "parallel", "utils")) {
+      reticulate::import(paste0("mmlspark_tpu.", sub))
+    }
+  }
+  .tpu_env$pkg
+}
+
+#' Convert a data.frame (or named list of columns) to a Table
+#' @param df a data.frame or named list
+#' @export
+tpu_table <- function(df) {
+  .tpu()
+  schema <- reticulate::import("mmlspark_tpu.core.schema")
+  # per-column as.list: a length-1 R vector would otherwise convert to a
+  # Python SCALAR and break Table's column-length check on 1-row inputs
+  cols <- lapply(as.list(df), as.list)
+  schema$Table(reticulate::r_to_py(cols))
+}
+
+#' Collect a Table back into a data.frame
+#' @param tbl a Table
+#' @export
+tpu_collect <- function(tbl) {
+  cols <- list()
+  for (name in tbl$columns) {
+    # tbl[name] auto-converts (the module is imported with convert=TRUE);
+    # py_to_r here would error on the already-converted R object
+    cols[[name]] <- tbl[name]
+  }
+  as.data.frame(cols, stringsAsFactors = FALSE)
+}
+
+.tpu_resolve_class <- function(qualified) {
+  parts <- strsplit(qualified, ".", fixed = TRUE)[[1]]
+  module <- paste(parts[-length(parts)], collapse = ".")
+  cls_name <- parts[length(parts)]
+  reticulate::import(module)[[cls_name]]
+}
+
+.tpu_apply_stage <- function(qualified, params, x,
+                             is_estimator = FALSE, only.model = FALSE) {
+  .tpu()
+  tbl <- if (inherits(x, "python.builtin.object")) x else tpu_table(x)
+  cls <- .tpu_resolve_class(qualified)
+  stage <- do.call(cls, params)
+  if (is_estimator) {
+    model <- stage$fit(tbl)
+    if (isTRUE(only.model)) {
+      return(model)
+    }
+    return(model$transform(tbl))
+  }
+  stage$transform(tbl)
+}

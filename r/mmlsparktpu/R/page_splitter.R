@@ -1,0 +1,21 @@
+#' PageSplitter (Transformer)
+#'
+#' PageSplitter
+#'
+#' @param x a data.frame or tpu_table
+#' @param output_col list-of-pages column
+#' @param input_col string column
+#' @param max_page_length max chars per page
+#' @param min_page_length min chars before a soft break
+#' @param explode one row per page instead of list column
+#' @export
+ml_page_splitter <- function(x, output_col = "pages", input_col = "text", max_page_length = 5000L, min_page_length = 500L, explode = FALSE)
+{
+  params <- list()
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  if (!is.null(input_col)) params$input_col <- as.character(input_col)
+  if (!is.null(max_page_length)) params$max_page_length <- as.integer(max_page_length)
+  if (!is.null(min_page_length)) params$min_page_length <- as.integer(min_page_length)
+  if (!is.null(explode)) params$explode <- as.logical(explode)
+  .tpu_apply_stage("mmlspark_tpu.text.page_splitter.PageSplitter", params, x, is_estimator = FALSE)
+}

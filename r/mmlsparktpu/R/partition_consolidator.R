@@ -1,0 +1,19 @@
+#' PartitionConsolidator (Transformer)
+#'
+#' Apply `fn` over a column through `num_lanes` workers at most `requests_per_second` calls/s (reference: one-consolidated-worker-per- host for rate-limited services).
+#'
+#' @param x a data.frame or tpu_table
+#' @param output_col output column
+#' @param input_col input column
+#' @param num_lanes concurrent lanes (reference: 1 per host)
+#' @param requests_per_second global rate limit
+#' @export
+ml_partition_consolidator <- function(x, output_col = "output", input_col = "input", num_lanes = 1L, requests_per_second = NULL)
+{
+  params <- list()
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  if (!is.null(input_col)) params$input_col <- as.character(input_col)
+  if (!is.null(num_lanes)) params$num_lanes <- as.integer(num_lanes)
+  if (!is.null(requests_per_second)) params$requests_per_second <- as.double(requests_per_second)
+  .tpu_apply_stage("mmlspark_tpu.io_http.consolidator.PartitionConsolidator", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,23 @@
+#' PartitionSample (Transformer)
+#'
+#' PartitionSample
+#'
+#' @param x a data.frame or tpu_table
+#' @param mode Head | RandomSample | AssignToPartition
+#' @param count rows for Head mode
+#' @param percent sample rate for RandomSample
+#' @param seed random seed
+#' @param new_col_name bucket column for AssignToPartition
+#' @param num_parts bucket count for AssignToPartition
+#' @export
+ml_partition_sample <- function(x, mode = "RandomSample", count = 1000L, percent = 0.1, seed = 0L, new_col_name = "Partition", num_parts = 10L)
+{
+  params <- list()
+  if (!is.null(mode)) params$mode <- as.character(mode)
+  if (!is.null(count)) params$count <- as.integer(count)
+  if (!is.null(percent)) params$percent <- as.double(percent)
+  if (!is.null(seed)) params$seed <- as.integer(seed)
+  if (!is.null(new_col_name)) params$new_col_name <- as.character(new_col_name)
+  if (!is.null(num_parts)) params$num_parts <- as.integer(num_parts)
+  .tpu_apply_stage("mmlspark_tpu.ops.sample.PartitionSample", params, x, is_estimator = FALSE)
+}

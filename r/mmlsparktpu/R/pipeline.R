@@ -1,0 +1,14 @@
+#' Pipeline (Estimator)
+#'
+#' Sequence of stages; `fit` fits estimators in order, transforming the running table through each fitted stage (Spark ML Pipeline semantics).
+#'
+#' @param x a data.frame or tpu_table
+#' @param stages list of pipeline stages
+#' @param only.model return the fitted model without transforming x (the reference's unfit.model)
+#' @export
+ml_pipeline <- function(x, stages = NULL, only.model = FALSE)
+{
+  params <- list()
+  if (!is.null(stages)) params$stages <- as.list(stages)
+  .tpu_apply_stage("mmlspark_tpu.core.pipeline.Pipeline", params, x, is_estimator = TRUE, only.model = only.model)
+}

@@ -1,0 +1,13 @@
+#' PipelineModel (Model)
+#'
+#' PipelineModel
+#'
+#' @param x a data.frame or tpu_table
+#' @param stages list of fitted transformer stages
+#' @export
+ml_pipeline_model <- function(x, stages = NULL)
+{
+  params <- list()
+  if (!is.null(stages)) params$stages <- as.list(stages)
+  .tpu_apply_stage("mmlspark_tpu.core.pipeline.PipelineModel", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,20 @@
+#' RankingAdapter (Estimator)
+#'
+#' Wrap a recommender estimator so its output evaluates like a ranking problem (RankingAdapter.scala:66-151).
+#'
+#' @param x a data.frame or tpu_table
+#' @param recommender estimator producing a SARModel-like model
+#' @param k recommendations per user
+#' @param user_col user id column
+#' @param item_col item id column
+#' @param only.model return the fitted model without transforming x (the reference's unfit.model)
+#' @export
+ml_ranking_adapter <- function(x, recommender, k = 10L, user_col = "user", item_col = "item", only.model = FALSE)
+{
+  params <- list()
+  if (!is.null(recommender)) params$recommender <- recommender
+  if (!is.null(k)) params$k <- as.integer(k)
+  if (!is.null(user_col)) params$user_col <- as.character(user_col)
+  if (!is.null(item_col)) params$item_col <- as.character(item_col)
+  .tpu_apply_stage("mmlspark_tpu.recommendation.ranking.RankingAdapter", params, x, is_estimator = TRUE, only.model = only.model)
+}

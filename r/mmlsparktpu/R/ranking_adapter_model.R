@@ -1,0 +1,17 @@
+#' RankingAdapterModel (Model)
+#'
+#' RankingAdapterModel
+#'
+#' @param x a data.frame or tpu_table
+#' @param k recommendations per user
+#' @param user_col user id column
+#' @param item_col item id column
+#' @export
+ml_ranking_adapter_model <- function(x, k = 10L, user_col = "user", item_col = "item")
+{
+  params <- list()
+  if (!is.null(k)) params$k <- as.integer(k)
+  if (!is.null(user_col)) params$user_col <- as.character(user_col)
+  if (!is.null(item_col)) params$item_col <- as.character(item_col)
+  .tpu_apply_stage("mmlspark_tpu.recommendation.ranking.RankingAdapterModel", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,21 @@
+#' RankingEvaluator (Transformer)
+#'
+#' Table{prediction: id lists, label: id lists} -> one-row metric table (RankingEvaluator.scala:14-151).
+#'
+#' @param x a data.frame or tpu_table
+#' @param k cutoff
+#' @param metric_name metric to report
+#' @param prediction_col recommended id list column
+#' @param label_col relevant id list column
+#' @param n_items item count (enables diversity metrics)
+#' @export
+ml_ranking_evaluator <- function(x, k = 10L, metric_name = "ndcgAt", prediction_col = "prediction", label_col = "label", n_items = NULL)
+{
+  params <- list()
+  if (!is.null(k)) params$k <- as.integer(k)
+  if (!is.null(metric_name)) params$metric_name <- as.character(metric_name)
+  if (!is.null(prediction_col)) params$prediction_col <- as.character(prediction_col)
+  if (!is.null(label_col)) params$label_col <- as.character(label_col)
+  if (!is.null(n_items)) params$n_items <- as.integer(n_items)
+  .tpu_apply_stage("mmlspark_tpu.recommendation.ranking.RankingEvaluator", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,30 @@
+#' RankingTrainValidationSplit (Estimator)
+#'
+#' Per-user stratified split + grid evaluation (RankingTrainValidationSplit.scala:22-337).
+#'
+#' @param x a data.frame or tpu_table
+#' @param recommender recommender estimator
+#' @param user_col user id column
+#' @param item_col item id column
+#' @param train_ratio per-user train fraction
+#' @param min_ratings_per_user drop users with fewer events
+#' @param k evaluation cutoff
+#' @param metric_name selection metric
+#' @param param_maps list of param dicts to evaluate (None = [{}])
+#' @param seed shuffle seed
+#' @param only.model return the fitted model without transforming x (the reference's unfit.model)
+#' @export
+ml_ranking_train_validation_split <- function(x, recommender, user_col = "user", item_col = "item", train_ratio = 0.75, min_ratings_per_user = 1L, k = 10L, metric_name = "ndcgAt", param_maps = NULL, seed = 0L, only.model = FALSE)
+{
+  params <- list()
+  if (!is.null(recommender)) params$recommender <- recommender
+  if (!is.null(user_col)) params$user_col <- as.character(user_col)
+  if (!is.null(item_col)) params$item_col <- as.character(item_col)
+  if (!is.null(train_ratio)) params$train_ratio <- as.double(train_ratio)
+  if (!is.null(min_ratings_per_user)) params$min_ratings_per_user <- as.integer(min_ratings_per_user)
+  if (!is.null(k)) params$k <- as.integer(k)
+  if (!is.null(metric_name)) params$metric_name <- as.character(metric_name)
+  if (!is.null(param_maps)) params$param_maps <- param_maps
+  if (!is.null(seed)) params$seed <- as.integer(seed)
+  .tpu_apply_stage("mmlspark_tpu.recommendation.ranking.RankingTrainValidationSplit", params, x, is_estimator = TRUE, only.model = only.model)
+}

@@ -1,0 +1,11 @@
+#' RankingTrainValidationSplitModel (Model)
+#'
+#' RankingTrainValidationSplitModel
+#'
+#' @param x a data.frame or tpu_table
+#' @export
+ml_ranking_train_validation_split_model <- function(x)
+{
+  params <- list()
+  .tpu_apply_stage("mmlspark_tpu.recommendation.ranking.RankingTrainValidationSplitModel", params, x, is_estimator = FALSE)
+}

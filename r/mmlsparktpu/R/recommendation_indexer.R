@@ -1,0 +1,22 @@
+#' RecommendationIndexer (Estimator)
+#'
+#' RecommendationIndexer
+#'
+#' @param x a data.frame or tpu_table
+#' @param user_input_col raw user column
+#' @param user_output_col indexed user column
+#' @param item_input_col raw item column
+#' @param item_output_col indexed item column
+#' @param rating_col rating column (passed through)
+#' @param only.model return the fitted model without transforming x (the reference's unfit.model)
+#' @export
+ml_recommendation_indexer <- function(x, user_input_col, user_output_col, item_input_col, item_output_col, rating_col = NULL, only.model = FALSE)
+{
+  params <- list()
+  if (!is.null(user_input_col)) params$user_input_col <- as.character(user_input_col)
+  if (!is.null(user_output_col)) params$user_output_col <- as.character(user_output_col)
+  if (!is.null(item_input_col)) params$item_input_col <- as.character(item_input_col)
+  if (!is.null(item_output_col)) params$item_output_col <- as.character(item_output_col)
+  if (!is.null(rating_col)) params$rating_col <- as.character(rating_col)
+  .tpu_apply_stage("mmlspark_tpu.recommendation.indexer.RecommendationIndexer", params, x, is_estimator = TRUE, only.model = only.model)
+}

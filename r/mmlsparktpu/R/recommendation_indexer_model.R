@@ -1,0 +1,19 @@
+#' RecommendationIndexerModel (Model)
+#'
+#' RecommendationIndexerModel
+#'
+#' @param x a data.frame or tpu_table
+#' @param user_input_col raw user column
+#' @param user_output_col indexed user column
+#' @param item_input_col raw item column
+#' @param item_output_col indexed item column
+#' @export
+ml_recommendation_indexer_model <- function(x, user_input_col, user_output_col, item_input_col, item_output_col)
+{
+  params <- list()
+  if (!is.null(user_input_col)) params$user_input_col <- as.character(user_input_col)
+  if (!is.null(user_output_col)) params$user_output_col <- as.character(user_output_col)
+  if (!is.null(item_input_col)) params$item_input_col <- as.character(item_input_col)
+  if (!is.null(item_output_col)) params$item_output_col <- as.character(item_output_col)
+  .tpu_apply_stage("mmlspark_tpu.recommendation.indexer.RecommendationIndexerModel", params, x, is_estimator = FALSE)
+}

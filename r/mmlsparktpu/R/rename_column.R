@@ -1,0 +1,15 @@
+#' RenameColumn (Transformer)
+#'
+#' Reference: pipeline-stages/RenameColumn.scala:18.
+#'
+#' @param x a data.frame or tpu_table
+#' @param input_col column to rename
+#' @param output_col new name
+#' @export
+ml_rename_column <- function(x, input_col, output_col)
+{
+  params <- list()
+  if (!is.null(input_col)) params$input_col <- as.character(input_col)
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  .tpu_apply_stage("mmlspark_tpu.ops.stages.RenameColumn", params, x, is_estimator = FALSE)
+}

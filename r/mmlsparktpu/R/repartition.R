@@ -1,0 +1,13 @@
+#' Repartition (Transformer)
+#'
+#' Reference: pipeline-stages/Repartition.scala:18. On TPU, row placement is decided by `shard_rows` over the mesh at compute time, so this stage only records the requested parallelism as table-level metadata consumed by downstream sharded stages.
+#'
+#' @param x a data.frame or tpu_table
+#' @param n requested number of shards
+#' @export
+ml_repartition <- function(x, n)
+{
+  params <- list()
+  if (!is.null(n)) params$n <- as.integer(n)
+  .tpu_apply_stage("mmlspark_tpu.ops.stages.Repartition", params, x, is_estimator = FALSE)
+}

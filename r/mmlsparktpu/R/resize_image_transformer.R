@@ -1,0 +1,19 @@
+#' ResizeImageTransformer (Transformer)
+#'
+#' Reference: ResizeImageTransformer (ResizeImageTransformer.scala:54+).
+#'
+#' @param x a data.frame or tpu_table
+#' @param output_col output image column
+#' @param input_col input image column
+#' @param height target height
+#' @param width target width
+#' @export
+ml_resize_image_transformer <- function(x, output_col = "image_out", input_col = "image", height, width)
+{
+  params <- list()
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  if (!is.null(input_col)) params$input_col <- as.character(input_col)
+  if (!is.null(height)) params$height <- as.integer(height)
+  if (!is.null(width)) params$width <- as.integer(width)
+  .tpu_apply_stage("mmlspark_tpu.image.transformer.ResizeImageTransformer", params, x, is_estimator = FALSE)
+}

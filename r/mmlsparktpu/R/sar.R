@@ -1,0 +1,36 @@
+#' SAR (Estimator)
+#'
+#' Reference params: SARParams (SAR.scala:39-56) + Spark ALS-style cols.
+#'
+#' @param x a data.frame or tpu_table
+#' @param user_col indexed user id column
+#' @param item_col indexed item id column
+#' @param rating_col rating column (optional)
+#' @param time_col activity timestamp column (optional)
+#' @param similarity_function jaccard | lift | cooccurrence
+#' @param support_threshold min co-occurrence to keep a similarity
+#' @param time_decay_coeff half-life in days for affinity decay
+#' @param start_time reference time (default: max activity time)
+#' @param activity_time_format strptime format
+#' @param start_time_format strptime format
+#' @param num_users explicit user vocabulary size (default: max id + 1)
+#' @param num_items explicit item vocabulary size (default: max id + 1)
+#' @param only.model return the fitted model without transforming x (the reference's unfit.model)
+#' @export
+ml_sar <- function(x, user_col = "user", item_col = "item", rating_col = NULL, time_col = NULL, similarity_function = "jaccard", support_threshold = 4L, time_decay_coeff = 30L, start_time = NULL, activity_time_format = "%Y-%m-%d %H:%M:%S", start_time_format = "%Y-%m-%d %H:%M:%S", num_users = NULL, num_items = NULL, only.model = FALSE)
+{
+  params <- list()
+  if (!is.null(user_col)) params$user_col <- as.character(user_col)
+  if (!is.null(item_col)) params$item_col <- as.character(item_col)
+  if (!is.null(rating_col)) params$rating_col <- as.character(rating_col)
+  if (!is.null(time_col)) params$time_col <- as.character(time_col)
+  if (!is.null(similarity_function)) params$similarity_function <- as.character(similarity_function)
+  if (!is.null(support_threshold)) params$support_threshold <- as.integer(support_threshold)
+  if (!is.null(time_decay_coeff)) params$time_decay_coeff <- as.integer(time_decay_coeff)
+  if (!is.null(start_time)) params$start_time <- as.character(start_time)
+  if (!is.null(activity_time_format)) params$activity_time_format <- as.character(activity_time_format)
+  if (!is.null(start_time_format)) params$start_time_format <- as.character(start_time_format)
+  if (!is.null(num_users)) params$num_users <- as.integer(num_users)
+  if (!is.null(num_items)) params$num_items <- as.integer(num_items)
+  .tpu_apply_stage("mmlspark_tpu.recommendation.sar.SAR", params, x, is_estimator = TRUE, only.model = only.model)
+}

@@ -1,0 +1,13 @@
+#' SelectColumns (Transformer)
+#'
+#' Reference: pipeline-stages/SelectColumns.scala:21.
+#'
+#' @param x a data.frame or tpu_table
+#' @param cols columns to keep
+#' @export
+ml_select_columns <- function(x, cols)
+{
+  params <- list()
+  if (!is.null(cols)) params$cols <- as.list(cols)
+  .tpu_apply_stage("mmlspark_tpu.ops.stages.SelectColumns", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,25 @@
+#' SimpleHTTPTransformer (Transformer)
+#'
+#' input parser → HTTP → output parser, with optional error column (SimpleHTTPTransformer.scala:61+, error col :18-26).
+#'
+#' @param x a data.frame or tpu_table
+#' @param output_col parsed output column
+#' @param input_col payload column
+#' @param url target URL (JSON input parser)
+#' @param concurrency in-flight requests
+#' @param timeout request timeout (s)
+#' @param error_col error-info column (None = raise on HTTP error)
+#' @param flatten_output_field dotted path into response JSON
+#' @export
+ml_simple_http_transformer <- function(x, output_col = "output", input_col = "input", url = NULL, concurrency = 1L, timeout = 60.0, error_col = NULL, flatten_output_field = NULL)
+{
+  params <- list()
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  if (!is.null(input_col)) params$input_col <- as.character(input_col)
+  if (!is.null(url)) params$url <- as.character(url)
+  if (!is.null(concurrency)) params$concurrency <- as.integer(concurrency)
+  if (!is.null(timeout)) params$timeout <- as.double(timeout)
+  if (!is.null(error_col)) params$error_col <- as.character(error_col)
+  if (!is.null(flatten_output_field)) params$flatten_output_field <- as.character(flatten_output_field)
+  .tpu_apply_stage("mmlspark_tpu.io_http.transformer.SimpleHTTPTransformer", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,19 @@
+#' StopWordsRemover (Transformer)
+#'
+#' StopWordsRemover
+#'
+#' @param x a data.frame or tpu_table
+#' @param output_col filtered token column
+#' @param input_col token list column
+#' @param stop_words stop word list (default english)
+#' @param case_sensitive case sensitive match
+#' @export
+ml_stop_words_remover <- function(x, output_col = "filtered", input_col = "tokens", stop_words = NULL, case_sensitive = FALSE)
+{
+  params <- list()
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  if (!is.null(input_col)) params$input_col <- as.character(input_col)
+  if (!is.null(stop_words)) params$stop_words <- stop_words
+  if (!is.null(case_sensitive)) params$case_sensitive <- as.logical(case_sensitive)
+  .tpu_apply_stage("mmlspark_tpu.text.featurizer.StopWordsRemover", params, x, is_estimator = FALSE)
+}

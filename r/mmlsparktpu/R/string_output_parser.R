@@ -1,0 +1,15 @@
+#' StringOutputParser (Transformer)
+#'
+#' Response -> body text (Parsers.scala:164-180).
+#'
+#' @param x a data.frame or tpu_table
+#' @param output_col text output column
+#' @param input_col HTTPResponseData column
+#' @export
+ml_string_output_parser <- function(x, output_col = "output", input_col = "response")
+{
+  params <- list()
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  if (!is.null(input_col)) params$input_col <- as.character(input_col)
+  .tpu_apply_stage("mmlspark_tpu.io_http.transformer.StringOutputParser", params, x, is_estimator = FALSE)
+}

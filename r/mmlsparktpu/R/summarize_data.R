@@ -1,0 +1,21 @@
+#' SummarizeData (Transformer)
+#'
+#' SummarizeData
+#'
+#' @param x a data.frame or tpu_table
+#' @param counts include count/unique/missing
+#' @param basic include mean/std/min/max
+#' @param sample include quantiles
+#' @param percentiles include percentile stats
+#' @param error_threshold quantile error (ignored: exact)
+#' @export
+ml_summarize_data <- function(x, counts = TRUE, basic = TRUE, sample = TRUE, percentiles = TRUE, error_threshold = 0.0)
+{
+  params <- list()
+  if (!is.null(counts)) params$counts <- as.logical(counts)
+  if (!is.null(basic)) params$basic <- as.logical(basic)
+  if (!is.null(sample)) params$sample <- as.logical(sample)
+  if (!is.null(percentiles)) params$percentiles <- as.logical(percentiles)
+  if (!is.null(error_threshold)) params$error_threshold <- as.double(error_threshold)
+  .tpu_apply_stage("mmlspark_tpu.ops.summarize.SummarizeData", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,21 @@
+#' SuperpixelTransformer (Transformer)
+#'
+#' Reference: SuperpixelTransformer.scala:33+.
+#'
+#' @param x a data.frame or tpu_table
+#' @param output_col labels output column
+#' @param input_col image column
+#' @param cell_size target superpixel cell size (px)
+#' @param iters SLIC iterations
+#' @param compactness spatial vs color weight
+#' @export
+ml_superpixel_transformer <- function(x, output_col = "superpixels", input_col = "image", cell_size = 16L, iters = 5L, compactness = 10.0)
+{
+  params <- list()
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  if (!is.null(input_col)) params$input_col <- as.character(input_col)
+  if (!is.null(cell_size)) params$cell_size <- as.integer(cell_size)
+  if (!is.null(iters)) params$iters <- as.integer(iters)
+  if (!is.null(compactness)) params$compactness <- as.double(compactness)
+  .tpu_apply_stage("mmlspark_tpu.automl.lime.SuperpixelTransformer", params, x, is_estimator = FALSE)
+}

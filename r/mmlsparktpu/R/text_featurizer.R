@@ -1,0 +1,42 @@
+#' TextFeaturizer (Estimator)
+#'
+#' Composed text pipeline (TextFeaturizer.scala:179-384).
+#'
+#' @param x a data.frame or tpu_table
+#' @param output_col feature vector column
+#' @param input_col string column
+#' @param use_tokenizer tokenize
+#' @param tokenizer_pattern token split pattern
+#' @param to_lowercase lowercase
+#' @param use_stop_words_remover remove stop words
+#' @param case_sensitive_stop_words stop word case
+#' @param default_stop_word_language stop word language
+#' @param stop_words explicit stop word list (overrides language)
+#' @param use_n_gram append ngrams
+#' @param n_gram_length ngram n
+#' @param binarize_inputs binary TF
+#' @param use_idf apply IDF
+#' @param num_features hash buckets (see HashingTF note)
+#' @param min_doc_freq IDF min doc frequency
+#' @param only.model return the fitted model without transforming x (the reference's unfit.model)
+#' @export
+ml_text_featurizer <- function(x, output_col = "features", input_col = "text", use_tokenizer = TRUE, tokenizer_pattern = "\\W+", to_lowercase = TRUE, use_stop_words_remover = FALSE, case_sensitive_stop_words = FALSE, default_stop_word_language = "english", stop_words = NULL, use_n_gram = FALSE, n_gram_length = 2L, binarize_inputs = FALSE, use_idf = TRUE, num_features = 4096L, min_doc_freq = 1L, only.model = FALSE)
+{
+  params <- list()
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  if (!is.null(input_col)) params$input_col <- as.character(input_col)
+  if (!is.null(use_tokenizer)) params$use_tokenizer <- as.logical(use_tokenizer)
+  if (!is.null(tokenizer_pattern)) params$tokenizer_pattern <- as.character(tokenizer_pattern)
+  if (!is.null(to_lowercase)) params$to_lowercase <- as.logical(to_lowercase)
+  if (!is.null(use_stop_words_remover)) params$use_stop_words_remover <- as.logical(use_stop_words_remover)
+  if (!is.null(case_sensitive_stop_words)) params$case_sensitive_stop_words <- as.logical(case_sensitive_stop_words)
+  if (!is.null(default_stop_word_language)) params$default_stop_word_language <- as.character(default_stop_word_language)
+  if (!is.null(stop_words)) params$stop_words <- stop_words
+  if (!is.null(use_n_gram)) params$use_n_gram <- as.logical(use_n_gram)
+  if (!is.null(n_gram_length)) params$n_gram_length <- as.integer(n_gram_length)
+  if (!is.null(binarize_inputs)) params$binarize_inputs <- as.logical(binarize_inputs)
+  if (!is.null(use_idf)) params$use_idf <- as.logical(use_idf)
+  if (!is.null(num_features)) params$num_features <- as.integer(num_features)
+  if (!is.null(min_doc_freq)) params$min_doc_freq <- as.integer(min_doc_freq)
+  .tpu_apply_stage("mmlspark_tpu.text.featurizer.TextFeaturizer", params, x, is_estimator = TRUE, only.model = only.model)
+}

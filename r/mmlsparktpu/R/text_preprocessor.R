@@ -1,0 +1,19 @@
+#' TextPreprocessor (Transformer)
+#'
+#' Trie-based find-and-replace normalization. Reference: pipeline-stages/TextPreprocessor.scala:14-95 (Trie with putAll/mapText, longest-match-wins replacement).
+#'
+#' @param x a data.frame or tpu_table
+#' @param input_col input text column
+#' @param output_col output text column
+#' @param map dict of substring -> replacement
+#' @param normalize_case lowercase before matching
+#' @export
+ml_text_preprocessor <- function(x, input_col, output_col, map, normalize_case = TRUE)
+{
+  params <- list()
+  if (!is.null(input_col)) params$input_col <- as.character(input_col)
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  if (!is.null(map)) params$map <- as.list(map)
+  if (!is.null(normalize_case)) params$normalize_case <- as.logical(normalize_case)
+  .tpu_apply_stage("mmlspark_tpu.ops.stages.TextPreprocessor", params, x, is_estimator = FALSE)
+}

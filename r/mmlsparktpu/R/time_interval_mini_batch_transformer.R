@@ -1,0 +1,17 @@
+#' TimeIntervalMiniBatchTransformer (Transformer)
+#'
+#' Batch rows arriving within an interval (MiniBatchTransformer.scala:65-136). Streaming-only concept; for a materialized Table it requires an arrival-time column to group by.
+#'
+#' @param x a data.frame or tpu_table
+#' @param interval_ms interval in milliseconds
+#' @param arrival_time_col epoch-ms column giving arrival times
+#' @param max_batch_size cap on batch size
+#' @export
+ml_time_interval_mini_batch_transformer <- function(x, interval_ms, arrival_time_col = NULL, max_batch_size = NULL)
+{
+  params <- list()
+  if (!is.null(interval_ms)) params$interval_ms <- as.integer(interval_ms)
+  if (!is.null(arrival_time_col)) params$arrival_time_col <- as.character(arrival_time_col)
+  if (!is.null(max_batch_size)) params$max_batch_size <- as.integer(max_batch_size)
+  .tpu_apply_stage("mmlspark_tpu.ops.minibatch.TimeIntervalMiniBatchTransformer", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,15 @@
+#' Timer (Transformer)
+#'
+#' Wraps a stage and logs wall-clock transform time.
+#'
+#' @param x a data.frame or tpu_table
+#' @param stage wrapped transformer
+#' @param disable if true, skip timing
+#' @export
+ml_timer <- function(x, stage = NULL, disable = FALSE)
+{
+  params <- list()
+  if (!is.null(stage)) params$stage <- stage
+  if (!is.null(disable)) params$disable <- as.logical(disable)
+  .tpu_apply_stage("mmlspark_tpu.core.pipeline.Timer", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,21 @@
+#' Tokenizer (Transformer)
+#'
+#' Regex tokenizer (Spark ML Tokenizer semantics: lowercase + split).
+#'
+#' @param x a data.frame or tpu_table
+#' @param output_col token list column
+#' @param input_col string column
+#' @param pattern split pattern
+#' @param lowercase lowercase first
+#' @param min_token_length drop shorter tokens
+#' @export
+ml_tokenizer <- function(x, output_col = "tokens", input_col = "text", pattern = "\\W+", lowercase = TRUE, min_token_length = 1L)
+{
+  params <- list()
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  if (!is.null(input_col)) params$input_col <- as.character(input_col)
+  if (!is.null(pattern)) params$pattern <- as.character(pattern)
+  if (!is.null(lowercase)) params$lowercase <- as.logical(lowercase)
+  if (!is.null(min_token_length)) params$min_token_length <- as.integer(min_token_length)
+  .tpu_apply_stage("mmlspark_tpu.text.featurizer.Tokenizer", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,22 @@
+#' TrainClassifier (Estimator)
+#'
+#' Featurize + label-reindex + fit (TrainClassifier.scala:50-276).
+#'
+#' @param x a data.frame or tpu_table
+#' @param label_col name of the label column
+#' @param model inner estimator to train
+#' @param features_col assembled features column
+#' @param number_of_features hash buckets for featurization
+#' @param reindex_label reindex labels to [0, K)
+#' @param only.model return the fitted model without transforming x (the reference's unfit.model)
+#' @export
+ml_train_classifier <- function(x, label_col = "label", model, features_col = "features", number_of_features = NULL, reindex_label = TRUE, only.model = FALSE)
+{
+  params <- list()
+  if (!is.null(label_col)) params$label_col <- as.character(label_col)
+  if (!is.null(model)) params$model <- model
+  if (!is.null(features_col)) params$features_col <- as.character(features_col)
+  if (!is.null(number_of_features)) params$number_of_features <- as.integer(number_of_features)
+  if (!is.null(reindex_label)) params$reindex_label <- as.logical(reindex_label)
+  .tpu_apply_stage("mmlspark_tpu.automl.train.TrainClassifier", params, x, is_estimator = TRUE, only.model = only.model)
+}

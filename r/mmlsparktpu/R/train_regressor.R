@@ -1,0 +1,20 @@
+#' TrainRegressor (Estimator)
+#'
+#' Reference: TrainRegressor.scala:21-106.
+#'
+#' @param x a data.frame or tpu_table
+#' @param label_col name of the label column
+#' @param model inner estimator to train
+#' @param features_col assembled features column
+#' @param number_of_features hash buckets for featurization
+#' @param only.model return the fitted model without transforming x (the reference's unfit.model)
+#' @export
+ml_train_regressor <- function(x, label_col = "label", model, features_col = "features", number_of_features = NULL, only.model = FALSE)
+{
+  params <- list()
+  if (!is.null(label_col)) params$label_col <- as.character(label_col)
+  if (!is.null(model)) params$model <- model
+  if (!is.null(features_col)) params$features_col <- as.character(features_col)
+  if (!is.null(number_of_features)) params$number_of_features <- as.integer(number_of_features)
+  .tpu_apply_stage("mmlspark_tpu.automl.train.TrainRegressor", params, x, is_estimator = TRUE, only.model = only.model)
+}

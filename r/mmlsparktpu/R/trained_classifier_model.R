@@ -1,0 +1,15 @@
+#' TrainedClassifierModel (Model)
+#'
+#' Featurizer + fitted model + label decode (TrainClassifier.scala:278-376).
+#'
+#' @param x a data.frame or tpu_table
+#' @param label_col name of the label column
+#' @param features_col assembled features column
+#' @export
+ml_trained_classifier_model <- function(x, label_col = "label", features_col = "features")
+{
+  params <- list()
+  if (!is.null(label_col)) params$label_col <- as.character(label_col)
+  if (!is.null(features_col)) params$features_col <- as.character(features_col)
+  .tpu_apply_stage("mmlspark_tpu.automl.train.TrainedClassifierModel", params, x, is_estimator = FALSE)
+}

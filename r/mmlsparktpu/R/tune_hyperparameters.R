@@ -1,0 +1,32 @@
+#' TuneHyperparameters (Estimator)
+#'
+#' K-fold CV search over estimators × param maps, trials on a thread pool (TuneHyperparameters.scala:33-194).
+#'
+#' @param x a data.frame or tpu_table
+#' @param label_col name of the label column
+#' @param models estimator or list of estimators
+#' @param evaluation_metric metric name to optimize
+#' @param num_folds cross-validation folds
+#' @param parallelism concurrent trials
+#' @param seed fold shuffling seed
+#' @param param_space GridSpace | RandomSpace | dict of dists
+#' @param num_runs random-search runs (dict param_space only)
+#' @param refit refit best params on the full table
+#' @param trial_submeshes disjoint data submeshes for parallel trials
+#' @param only.model return the fitted model without transforming x (the reference's unfit.model)
+#' @export
+ml_tune_hyperparameters <- function(x, label_col = "label", models, evaluation_metric = "accuracy", num_folds = 3L, parallelism = 4L, seed = 0L, param_space, num_runs = 10L, refit = TRUE, trial_submeshes = 0L, only.model = FALSE)
+{
+  params <- list()
+  if (!is.null(label_col)) params$label_col <- as.character(label_col)
+  if (!is.null(models)) params$models <- models
+  if (!is.null(evaluation_metric)) params$evaluation_metric <- as.character(evaluation_metric)
+  if (!is.null(num_folds)) params$num_folds <- as.integer(num_folds)
+  if (!is.null(parallelism)) params$parallelism <- as.integer(parallelism)
+  if (!is.null(seed)) params$seed <- as.integer(seed)
+  if (!is.null(param_space)) params$param_space <- param_space
+  if (!is.null(num_runs)) params$num_runs <- as.integer(num_runs)
+  if (!is.null(refit)) params$refit <- as.logical(refit)
+  if (!is.null(trial_submeshes)) params$trial_submeshes <- as.integer(trial_submeshes)
+  .tpu_apply_stage("mmlspark_tpu.automl.tune.TuneHyperparameters", params, x, is_estimator = TRUE, only.model = only.model)
+}

@@ -1,0 +1,11 @@
+#' TuneHyperparametersModel (Model)
+#'
+#' Reference: TuneHyperparameters.scala:196+.
+#'
+#' @param x a data.frame or tpu_table
+#' @export
+ml_tune_hyperparameters_model <- function(x)
+{
+  params <- list()
+  .tpu_apply_stage("mmlspark_tpu.automl.tune.TuneHyperparametersModel", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,19 @@
+#' UDFTransformer (Transformer)
+#'
+#' Apply a per-row (or whole-column) function to one column. Reference: pipeline-stages/UDFTransformer.scala:21.
+#'
+#' @param x a data.frame or tpu_table
+#' @param input_col input column
+#' @param output_col output column
+#' @param udf callable applied per row
+#' @param vectorized if true, udf receives the whole column
+#' @export
+ml_udf_transformer <- function(x, input_col, output_col, udf, vectorized = FALSE)
+{
+  params <- list()
+  if (!is.null(input_col)) params$input_col <- as.character(input_col)
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  if (!is.null(udf)) params$udf <- udf
+  if (!is.null(vectorized)) params$vectorized <- as.logical(vectorized)
+  .tpu_apply_stage("mmlspark_tpu.ops.stages.UDFTransformer", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,19 @@
+#' UnrollBinaryImage (Transformer)
+#'
+#' Decode image bytes then unroll (reference UnrollImage.scala:177+).
+#'
+#' @param x a data.frame or tpu_table
+#' @param output_col unrolled vector column
+#' @param input_col encoded image bytes column
+#' @param height resize height (optional)
+#' @param width resize width (optional)
+#' @export
+ml_unroll_binary_image <- function(x, output_col = "features", input_col = "bytes", height = NULL, width = NULL)
+{
+  params <- list()
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  if (!is.null(input_col)) params$input_col <- as.character(input_col)
+  if (!is.null(height)) params$height <- as.integer(height)
+  if (!is.null(width)) params$width <- as.integer(width)
+  .tpu_apply_stage("mmlspark_tpu.image.unroll.UnrollBinaryImage", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,15 @@
+#' UnrollImage (Transformer)
+#'
+#' UnrollImage
+#'
+#' @param x a data.frame or tpu_table
+#' @param output_col unrolled vector column
+#' @param input_col image column ((n,H,W,C) or list)
+#' @export
+ml_unroll_image <- function(x, output_col = "features", input_col = "image")
+{
+  params <- list()
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  if (!is.null(input_col)) params$input_col <- as.character(input_col)
+  .tpu_apply_stage("mmlspark_tpu.image.unroll.UnrollImage", params, x, is_estimator = FALSE)
+}

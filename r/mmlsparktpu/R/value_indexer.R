@@ -1,0 +1,16 @@
+#' ValueIndexer (Estimator)
+#'
+#' Index distinct values of a column into [0, n). Nulls/NaNs map to the last index, mirroring ValueIndexer.scala:38-52 null handling.
+#'
+#' @param x a data.frame or tpu_table
+#' @param input_col column to index
+#' @param output_col indexed output column
+#' @param only.model return the fitted model without transforming x (the reference's unfit.model)
+#' @export
+ml_value_indexer <- function(x, input_col, output_col, only.model = FALSE)
+{
+  params <- list()
+  if (!is.null(input_col)) params$input_col <- as.character(input_col)
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  .tpu_apply_stage("mmlspark_tpu.ops.indexer.ValueIndexer", params, x, is_estimator = TRUE, only.model = only.model)
+}

@@ -1,0 +1,15 @@
+#' ValueIndexerModel (Model)
+#'
+#' ValueIndexerModel
+#'
+#' @param x a data.frame or tpu_table
+#' @param input_col column to index
+#' @param output_col indexed output column
+#' @export
+ml_value_indexer_model <- function(x, input_col, output_col)
+{
+  params <- list()
+  if (!is.null(input_col)) params$input_col <- as.character(input_col)
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  .tpu_apply_stage("mmlspark_tpu.ops.indexer.ValueIndexerModel", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,319 @@
+#!/usr/bin/env python
+"""Generate the R language surface (r/mmlsparktpu/) from the stage registry.
+
+Reference: `SparklyRWrapper` (src/codegen/src/main/scala/
+SparklyRWrapper.scala:21-196) reflects over every pipeline stage and emits
+one `ml_<stage>` R function (roxygen docs from Param docs, R-typed
+defaults, `as.integer`/`as.logical`/`as.double` conversions, fit+transform
+semantics for estimators) plus the package NAMESPACE/DESCRIPTION
+(WrapperGenerator.scala:244).
+
+TPU redesign: R calls Python directly through `reticulate` — no JVM, no
+Spark connection object. The generated package has ONE bridge helper
+(`.tpu_apply_stage` in R/package.R) and one thin generated function per
+registered stage; `tpu_table`/`tpu_collect` convert data.frame <-> Table
+at the boundary. The same registry the fuzzing suite enforces coverage
+over drives generation, so the R surface can never silently trail the
+Python one (tests/test_r_wrappers.py keeps the committed output fresh,
+exactly like docs/api.md).
+
+Usage: python tools/gen_r_wrappers.py          # rewrites r/mmlsparktpu/
+       python tools/gen_r_wrappers.py --check  # exit 1 if stale
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+SUBPACKAGES = ("core", "gbdt", "nn", "image", "ops", "text", "automl",
+               "recommendation", "io_http", "plot", "parallel", "utils")
+
+R_DIR = os.path.join(os.path.dirname(__file__), "..", "r", "mmlsparktpu")
+
+# R reserved words can never be argument names; none of the registry's
+# params collide today and the generator refuses if one ever does
+R_RESERVED = {"if", "else", "repeat", "while", "function", "for", "next",
+              "break", "TRUE", "FALSE", "NULL", "Inf", "NaN", "NA"}
+
+
+def snake(name: str) -> str:
+    s = re.sub(r"(?<=[a-z0-9])(?=[A-Z])", "_", name)
+    s = re.sub(r"(?<=[A-Z])(?=[A-Z][a-z])", "_", s)
+    return s.lower()
+
+
+def r_string(s: str) -> str:
+    return '"' + s.replace("\\", "\\\\").replace('"', '\\"') + '"'
+
+
+def r_default(p) -> str | None:
+    """R literal for a Param default; None = required (no default)."""
+    if p.required:
+        return None
+    d = p.default
+    if d is None:
+        return "NULL"
+    if isinstance(d, bool):
+        return "TRUE" if d else "FALSE"
+    if isinstance(d, int):
+        return f"{d}L"
+    if isinstance(d, float):
+        return repr(d)
+    if isinstance(d, str):
+        return r_string(d)
+    if isinstance(d, (list, tuple)) and not d:
+        return "NULL"  # empty collection: omit -> python default applies
+    return "NULL"      # complex default: reference emits NULL the same way
+
+
+def r_conversion(p, name: str) -> str:
+    """The getParamConversion analogue (SparklyRWrapper.scala:91-100).
+    A tuple ptype is a UNION, not a collection: (int, float) wants a
+    scalar (as.list would feed Param.validate a rejected list); only
+    unions admitting list/tuple/dict convert through as.list."""
+    pt = p.ptype
+    if isinstance(pt, tuple):
+        if any(t in (list, tuple, dict) for t in pt):
+            return f"as.list({name})"
+        if float in pt:
+            return f"as.double({name})"
+        if int in pt:
+            return f"as.integer({name})"
+        if str in pt:
+            return f"as.character({name})"
+        return name
+    if pt is bool:
+        return f"as.logical({name})"
+    if pt is int:
+        return f"as.integer({name})"
+    if pt is float:
+        return f"as.double({name})"
+    if pt is str:
+        return f"as.character({name})"
+    if pt in (list, dict):
+        return f"as.list({name})"
+    return name
+
+
+def _role(cls) -> str:
+    from mmlspark_tpu.core.pipeline import Estimator, Model, Transformer
+
+    if issubclass(cls, Model):
+        return "Model"
+    if issubclass(cls, Estimator):
+        return "Estimator"
+    if issubclass(cls, Transformer):
+        return "Transformer"
+    return "Stage"
+
+
+def _summary(cls) -> str:
+    import inspect
+
+    doc = cls.__dict__.get("__doc__") or ""
+    doc = inspect.cleandoc(doc)
+    return doc.split("\n\n", 1)[0].replace("\n", " ").strip()
+
+
+def stage_function(qual: str, cls) -> tuple[str, str, str]:
+    """-> (exported name, file name, R source) for one registered stage."""
+    params = getattr(cls, "_params", {})
+    fn = f"ml_{snake(cls.__name__)}"
+    role = _role(cls)
+
+    sig, body, docs = [], [], []
+    for name, p in params.items():
+        if name in R_RESERVED:
+            raise ValueError(f"{qual}.{name} collides with an R keyword")
+        default = r_default(p)
+        sig.append(name if default is None else f"{name} = {default}")
+        body.append(
+            f"  if (!is.null({name})) "
+            f"params${name} <- {r_conversion(p, name)}")
+        doc = (p.doc or "").replace("\n", " ")
+        docs.append(f"#' @param {name} {doc}")
+
+    is_est = role == "Estimator"
+    extra_sig = ", only.model = FALSE" if is_est else ""
+    extra_doc = (["#' @param only.model return the fitted model without "
+                  "transforming x (the reference's unfit.model)"]
+                 if is_est else [])
+    summary = _summary(cls) or cls.__name__
+    lines = [
+        f"#' {cls.__name__} ({role})",
+        "#'",
+        f"#' {summary}",
+        "#'",
+        "#' @param x a data.frame or tpu_table",
+        *docs,
+        *extra_doc,
+        "#' @export",
+        f"{fn} <- function(x{''.join(', ' + s for s in sig)}{extra_sig})",
+        "{",
+        "  params <- list()",
+        *body,
+        f"  .tpu_apply_stage({r_string(qual)}, params, x, "
+        f"is_estimator = {'TRUE' if is_est else 'FALSE'}"
+        f"{', only.model = only.model' if is_est else ''})",
+        "}",
+        "",
+    ]
+    return fn, f"{fn[3:]}.R", "\n".join(lines)
+
+
+PACKAGE_R = '''\
+# Bridge runtime for the generated wrappers (the sparklyr-connection
+# analogue, SparklyRWrapper.scala:30-52 — here the "connection" is an
+# embedded Python interpreter via reticulate).
+
+.tpu_env <- new.env(parent = emptyenv())
+
+.tpu <- function() {
+  if (is.null(.tpu_env$pkg)) {
+    .tpu_env$pkg <- reticulate::import("mmlspark_tpu")
+    for (sub in c({subpackages})) {
+      reticulate::import(paste0("mmlspark_tpu.", sub))
+    }
+  }
+  .tpu_env$pkg
+}
+
+#' Convert a data.frame (or named list of columns) to a Table
+#' @param df a data.frame or named list
+#' @export
+tpu_table <- function(df) {
+  .tpu()
+  schema <- reticulate::import("mmlspark_tpu.core.schema")
+  # per-column as.list: a length-1 R vector would otherwise convert to a
+  # Python SCALAR and break Table's column-length check on 1-row inputs
+  cols <- lapply(as.list(df), as.list)
+  schema$Table(reticulate::r_to_py(cols))
+}
+
+#' Collect a Table back into a data.frame
+#' @param tbl a Table
+#' @export
+tpu_collect <- function(tbl) {
+  cols <- list()
+  for (name in tbl$columns) {
+    # tbl[name] auto-converts (the module is imported with convert=TRUE);
+    # py_to_r here would error on the already-converted R object
+    cols[[name]] <- tbl[name]
+  }
+  as.data.frame(cols, stringsAsFactors = FALSE)
+}
+
+.tpu_resolve_class <- function(qualified) {
+  parts <- strsplit(qualified, ".", fixed = TRUE)[[1]]
+  module <- paste(parts[-length(parts)], collapse = ".")
+  cls_name <- parts[length(parts)]
+  reticulate::import(module)[[cls_name]]
+}
+
+.tpu_apply_stage <- function(qualified, params, x,
+                             is_estimator = FALSE, only.model = FALSE) {
+  .tpu()
+  tbl <- if (inherits(x, "python.builtin.object")) x else tpu_table(x)
+  cls <- .tpu_resolve_class(qualified)
+  stage <- do.call(cls, params)
+  if (is_estimator) {
+    model <- stage$fit(tbl)
+    if (isTRUE(only.model)) {
+      return(model)
+    }
+    return(model$transform(tbl))
+  }
+  stage$transform(tbl)
+}
+'''
+
+
+def generate() -> dict[str, str]:
+    """-> {relative path under r/mmlsparktpu: content}."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    for sub in SUBPACKAGES:
+        importlib.import_module(f"mmlspark_tpu.{sub}")
+    from mmlspark_tpu import __version__
+    from mmlspark_tpu.core.serialize import registry
+
+    # single source of truth for the eager-import list (plain replace, not
+    # str.format — the R code is full of literal braces)
+    subs = ", ".join(f'"{s}"' for s in SUBPACKAGES)
+    files: dict[str, str] = {
+        "R/package.R": PACKAGE_R.replace("{subpackages}", subs)}
+    exports = ["export(tpu_table)", "export(tpu_collect)"]
+    seen_fns: dict[str, str] = {}
+    for qual, cls in sorted(registry().items()):
+        fn, fname, src = stage_function(qual, cls)
+        if fn in seen_fns:
+            # bare-name collisions would silently overwrite a wrapper file
+            # and dispatch half the calls to the wrong class
+            raise ValueError(
+                f"R wrapper name collision: {qual} and {seen_fns[fn]} "
+                f"both generate {fn}")
+        seen_fns[fn] = qual
+        files[f"R/{fname}"] = src
+        exports.append(f"export({fn})")
+    files["NAMESPACE"] = "\n".join(sorted(exports)) + "\n"
+    files["DESCRIPTION"] = "\n".join([
+        "Package: mmlsparktpu",
+        "Type: Package",
+        "Title: R bindings for the mmlspark_tpu framework",
+        f"Version: {__version__}",
+        "Description: Auto-generated R surface (one ml_* function per",
+        "    registered pipeline stage) bridging to the TPU-native Python",
+        "    framework via reticulate. Regenerate with",
+        "    tools/gen_r_wrappers.py; do not edit by hand.",
+        "Imports: reticulate",
+        "License: MIT",
+        "Encoding: UTF-8",
+    ]) + "\n"
+    return files
+
+
+def main() -> None:
+    files = generate()
+    base = os.path.normpath(R_DIR)
+    if "--check" in sys.argv:
+        stale = []
+        for rel, content in files.items():
+            path = os.path.join(base, rel)
+            try:
+                with open(path) as fh:
+                    if fh.read() != content:
+                        stale.append(rel)
+            except FileNotFoundError:
+                stale.append(rel)
+        on_disk = set()
+        for root, _dirs, names in os.walk(base):
+            for n in names:
+                on_disk.add(os.path.relpath(os.path.join(root, n), base))
+        orphans = on_disk - set(files)
+        if stale or orphans:
+            print(f"r/mmlsparktpu is stale (changed: {sorted(stale)[:5]}, "
+                  f"orphaned: {sorted(orphans)[:5]}) — "
+                  "run python tools/gen_r_wrappers.py")
+            raise SystemExit(1)
+        print(f"r/mmlsparktpu up to date ({len(files)} files)")
+        return
+    import shutil
+
+    if os.path.isdir(base):
+        shutil.rmtree(base)
+    for rel, content in files.items():
+        path = os.path.join(base, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as fh:
+            fh.write(content)
+    print(f"wrote {len(files)} files under {base}")
+
+
+if __name__ == "__main__":
+    main()
