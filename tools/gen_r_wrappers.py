@@ -189,9 +189,12 @@ PACKAGE_R = '''\
 tpu_table <- function(df) {
   .tpu()
   schema <- reticulate::import("mmlspark_tpu.core.schema")
-  # per-column as.list: a length-1 R vector would otherwise convert to a
-  # Python SCALAR and break Table's column-length check on 1-row inputs
-  cols <- lapply(as.list(df), as.list)
+  # length-1 R vectors would convert to Python SCALARS and break Table's
+  # column-length check on 1-row inputs; box ONLY those — longer columns
+  # keep reticulate's vectorized double-vector -> array fast path
+  cols <- lapply(as.list(df), function(col) {
+    if (length(col) == 1L) as.list(col) else col
+  })
   schema$Table(reticulate::r_to_py(cols))
 }
 

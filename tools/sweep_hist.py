@@ -170,6 +170,81 @@ def _chunk_of(budget_mb: int) -> int:
         hk._FUSED_MASK_VMEM_BYTES = old
 
 
+def full_fit_ab():
+    """FULL-FIT A/B at the Adult-Census bench shape (VERDICT r4 #3): the
+    µs/build sweep above ranks kernels in isolation, but the decision to
+    flip the default needs END-TO-END fit seconds — binning, growth, and
+    the histogram stream together — plus the valid-AUC guard that a
+    faster kernel didn't silently break learning. One row per candidate
+    configuration; the winner's numbers go to BENCH_TPU_MEASURED.md and
+    the default flip happens on this table, not on µs/build."""
+    import bench as bench_mod
+    from mmlspark_tpu.core.kernels import set_kernel_mode
+    from mmlspark_tpu.gbdt.booster import Booster, TrainOptions
+
+    n_fit, n_valid, f_dim = 200_000, 8_192, 28
+    x, y = bench_mod.make_dataset(n_fit + n_valid, f_dim)
+    x, x_v, y, y_v = x[:n_fit], x[n_fit:], y[:n_fit], y[n_fit:]
+    base = dict(objective="binary", num_iterations=50, num_leaves=63,
+                learning_rate=0.1)
+
+    configs = [
+        # (label, kernel mode, env overrides, TrainOptions extras)
+        ("pallas per-feature int32", "pallas", {}, {}),
+        ("pallas per-feature uint8", "pallas", {}, {"bin_dtype": "uint8"}),
+        ("xla uint8", "xla", {}, {"bin_dtype": "uint8"}),
+        ("pallas grouped G=4 uint8", "pallas",
+         {"MMLSPARK_TPU_HIST_GROUP": "4"}, {"bin_dtype": "uint8"}),
+        ("pallas fused uint8", "pallas",
+         {"MMLSPARK_TPU_FUSED_HIST": "1"}, {"bin_dtype": "uint8"}),
+        ("pallas per-feature uint8+devbin", "pallas", {},
+         {"bin_dtype": "uint8", "device_binning": True}),
+    ]
+    print(f"\n== FULL-FIT A/B (n={n_fit}, F={f_dim}, 50 iters, 63 leaves; "
+          "fit seconds include binning) ==")
+    rows = []
+    for label, mode, env, extra in configs:
+        try:
+            set_kernel_mode(mode)
+            ctxs = [_with_env(k, v) for k, v in env.items()]
+            with contextlib.ExitStack() as stack:
+                for c in ctxs:
+                    stack.enter_context(c)
+                # cold pass includes compile; the warm pass (fresh train,
+                # cached lowering) is the steady-state number the default
+                # flip must rank on — compile-time deltas between pallas/
+                # xla/fused lowerings would otherwise pick the winner
+                t0 = time.perf_counter()
+                Booster.train(x, y, TrainOptions(**base, **extra))
+                cold_s = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                b = Booster.train(x, y, TrainOptions(**base, **extra))
+                fit_s = time.perf_counter() - t0
+            auc = bench_mod._auc(y_v, np.asarray(b.predict(x_v)))
+            rows.append((label, fit_s, auc))
+            print(f"{label:34s} warm {fit_s:7.2f} s "
+                  f"(cold {cold_s:6.2f})   {n_fit / fit_s:12,.0f} rows/s"
+                  f"   valid AUC {auc:.4f}")
+        except Exception as e:  # noqa: BLE001 — per-config verdicts
+            print(f"{label:34s} FAILED: {type(e).__name__}: "
+                  f"{str(e).splitlines()[0][:90]}")
+        finally:
+            set_kernel_mode(None)
+    if rows:
+        # the winner must LEARN, not just finish: a fast config with a
+        # silently broken kernel (AUC collapse) can never take the table
+        best_auc = max(r[2] for r in rows if r[2] is not None)
+        sound = [r for r in rows
+                 if r[2] is not None and r[2] >= max(0.75, best_auc - 0.01)]
+        if sound:
+            best = min(sound, key=lambda r: r[1])
+            print(f"FULL-FIT WINNER: {best[0]} ({best[1]:.2f} s, "
+                  f"AUC {best[2]:.4f})")
+        else:
+            print("FULL-FIT WINNER: none — every config failed the "
+                  "AUC soundness floor")
+
+
 def main():
     from bench import pin_cpu_if_requested
 
@@ -212,6 +287,12 @@ def main():
                       f"variant = {err:.2e}")
         except Exception as e:  # noqa: BLE001
             print(f"{name:34s} FAILED: {type(e).__name__}: {e}")
+
+    if jax.devices()[0].platform == "cpu":
+        print("\nfull-fit A/B skipped on CPU (pallas non-interpret cannot "
+              "run here; the decision table needs the real chip)")
+    elif os.environ.get("MMLSPARK_TPU_SWEEP_FULLFIT", "1") != "0":
+        full_fit_ab()
 
 
 if __name__ == "__main__":

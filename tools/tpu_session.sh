@@ -91,8 +91,8 @@ then
   rc=1
 fi
 
-echo "== kernel sweep (incl. the FIXED fused variant — failed Mosaic in window 1) =="
-if timeout 900 python -u tools/sweep_hist.py > "$OUT/sweep.txt" 2>&1; then
+echo "== kernel sweep (µs/build variants + the FULL-FIT A/B decision table) =="
+if timeout 1800 python -u tools/sweep_hist.py > "$OUT/sweep.txt" 2>&1; then
   tail -12 "$OUT/sweep.txt"
 else
   echo "SWEEP FAILED (rc=$?) — tail of $OUT/sweep.txt:"; tail -5 "$OUT/sweep.txt"
