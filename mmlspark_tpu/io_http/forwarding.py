@@ -57,6 +57,13 @@ class ForwardingOptions:
     max_retries: int = 50
     connect_timeout_s: float = 20.0
     extra_ssh_args: tuple[str, ...] = ()
+    # the client binary; picklable across the fleet's spawn boundary
+    # (unlike an injected launcher), and swappable for a stub in tests
+    ssh_command: str = "ssh"
+    # auth/handshake margin on top of ConnectTimeout in the settle window
+    # (establish_forward): tunable here because the fleet path has no
+    # other way to bound per-attempt wait when the gateway is fast
+    settle_margin_s: float = 5.0
 
 
 def build_ssh_command(opts: ForwardingOptions, remote_port: int,
@@ -64,7 +71,7 @@ def build_ssh_command(opts: ForwardingOptions, remote_port: int,
     """argv for one reverse-forward attempt. Pure so the exact contract —
     flags, bind syntax, failure mode — is unit-testable."""
     cmd = [
-        "ssh", "-N",
+        opts.ssh_command, "-N",
         # listen-port-busy must FAIL the process (the scan signal), not
         # degrade to a warning while ssh stays connected
         "-o", "ExitOnForwardFailure=yes",
@@ -138,10 +145,11 @@ def establish_forward(
     the slowest legitimate path to failure — TCP connect (bounded by
     ConnectTimeout) plus auth — or a still-connecting ssh would be
     reported as an established tunnel and registered in the rendezvous;
-    hence the default of connect_timeout_s + 5 s. Pass an explicit
-    settle_s only when the gateway's connect+auth latency is known."""
+    hence the default of connect_timeout_s + settle_margin_s. Pass an
+    explicit settle_s (or tune the margin in ForwardingOptions) only when
+    the gateway's connect+auth latency is known."""
     if settle_s is None:
-        settle_s = opts.connect_timeout_s + 5.0
+        settle_s = opts.connect_timeout_s + opts.settle_margin_s
     start = (opts.remote_port_start
              if opts.remote_port_start is not None else local_port)
     for attempt in range(opts.max_retries + 1):

@@ -701,10 +701,15 @@ def _fleet_worker(handler_factory, conn, server_kw, partition_id=0,
     HTTPSourceV2.scala:363-372), announce ServiceInfo to the driver
     rendezvous, and serve until terminated."""
     import os
+    import signal
 
     from .forwarding import establish_forward, get_local_ip
 
     srv = ServingServer(handler_factory(), **server_kw).start()
+    # SIGTERM (ServingFleet.stop) must unwind through the finally below —
+    # the default disposition would kill the process with the reverse
+    # tunnel still up, stranding a live ssh holding the remote listen port
+    signal.signal(signal.SIGTERM, lambda *_: srv._stop.set())
     fwd = None
     if forwarding is not None:
         fwd = establish_forward(srv.port, forwarding, local_host=srv.host)

@@ -793,3 +793,55 @@ class TestPortForwarding:
         from mmlspark_tpu.io_http.forwarding import get_local_ip
 
         ipaddress.ip_address(get_local_ip())  # parses or raises
+
+    def test_fleet_registers_public_coords_end_to_end(self, tmp_path):
+        """ServingFleet(forwarding=...) through the REAL worker path: each
+        spawned replica launches the (stubbed) ssh client, survives the
+        settle window, and registers public_host/public_port in the
+        rendezvous — the full HTTPSourceV2 forwarding.enabled flow with
+        only the ssh binary replaced by a sleeper stub."""
+        import stat
+
+        from mmlspark_tpu.io_http.forwarding import ForwardingOptions
+        from mmlspark_tpu.io_http.serving import ServingFleet
+
+        # single-process stub (like real ssh): a sh wrapper would orphan
+        # its sleep child on SIGTERM and pollute the host with strays
+        stub = tmp_path / "fake_ssh"
+        stub.write_text(
+            "#!/usr/bin/env python3\nimport time\ntime.sleep(300)\n")
+        stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+
+        fleet = ServingFleet(
+            _fleet_factory, n_hosts=2,
+            forwarding=ForwardingOptions(
+                username="svc", ssh_host="gw.example.com",
+                remote_port_start=9500, ssh_command=str(stub),
+                connect_timeout_s=0.2, settle_margin_s=0.3),
+        ).start()
+        try:
+            services = fleet.rendezvous.services()
+            assert len(services) == 2
+            for svc in services:
+                assert svc.public_host == "gw.example.com"
+                assert svc.public_port == 9500   # port scan start, per replica
+                assert svc.local_ip
+            # the data path still answers on the direct coordinates
+            out = _post(fleet.urls[0], {"x": 2.0})
+            assert out == {"doubled": 4.0}
+        finally:
+            fleet.stop()
+        # stop() must tear the tunnels down WITH the workers (SIGTERM
+        # unwinds through the worker's finally): a stranded ssh would hold
+        # the remote listen port and advertise a dead server
+        import subprocess
+        import time as _time
+
+        deadline = _time.monotonic() + 10
+        while _time.monotonic() < deadline:
+            alive = subprocess.run(
+                ["pgrep", "-f", str(stub)], capture_output=True).stdout
+            if not alive.strip():
+                break
+            _time.sleep(0.2)
+        assert not alive.strip(), f"orphaned tunnel stubs: {alive}"
