@@ -805,14 +805,13 @@ class Booster:
             return self._predict_raw_host(binned)
         return np.asarray(self._traverse_fn()(jnp.asarray(binned)))
 
-    def predict(self, x: np.ndarray, device: str | None = None,
-                num_iteration: int | None = None) -> np.ndarray:
-        """Probability / transformed prediction (reference
-        LightGBMBooster.score semantics)."""
-        raw = np.asarray(
-            self.predict_raw(x, device=device, num_iteration=num_iteration),
-            np.float64,
-        )
+    def transform_score(self, raw: np.ndarray) -> np.ndarray:
+        """Raw margins -> transformed prediction (sigmoid / softmax / exp
+        per objective — reference LightGBMBooster.score semantics).
+        Factored out so callers that already hold the margins (e.g. the
+        classification model's transform, which outputs BOTH columns)
+        never pay the bin+traverse pass twice."""
+        raw = np.asarray(raw, np.float64)
         if self.objective == "binary":
             return 1.0 / (1.0 + np.exp(-raw))
         if self.objective == "multiclass":
@@ -821,6 +820,13 @@ class Booster:
         if self.objective in ("poisson", "gamma", "tweedie"):
             return np.exp(raw)
         return raw
+
+    def predict(self, x: np.ndarray, device: str | None = None,
+                num_iteration: int | None = None) -> np.ndarray:
+        """Probability / transformed prediction (reference
+        LightGBMBooster.score semantics)."""
+        return self.transform_score(
+            self.predict_raw(x, device=device, num_iteration=num_iteration))
 
     # ------------------------------------------------------------------ #
     # importances / persistence                                          #

@@ -245,8 +245,9 @@ class GBDTClassificationModel(_BoosterModelMixin, HasFeaturesCol, HasPredictionC
         x = _features_from(table, self.get("features_col"))
         if getattr(x, "ndim", 2) == 1:
             x = x[:, None]
+        # one bin+traverse pass: both output columns derive from the margins
         raw = self.booster.predict_raw(x)
-        prob = self.booster.predict(x)
+        prob = self.booster.transform_score(raw)
         if raw.ndim == 1:  # binary: present as (n, 2) like the reference
             prob2 = np.stack([1.0 - prob, prob], axis=1)
             raw2 = np.stack([-raw, raw], axis=1)

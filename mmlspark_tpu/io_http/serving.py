@@ -370,17 +370,14 @@ class ServingServer:
             # 0.59 ms server p50 when the old 0.2 ms window was removed).
             deadline = time.monotonic() + self.max_latency_ms / 1e3
             while len(batch) < self.max_batch_size:
-                remaining = deadline - time.monotonic()
-                if remaining > 0:
-                    try:
-                        batch.append(self._queue.get(timeout=remaining))
-                    except queue.Empty:
-                        break
-                else:
-                    try:
-                        batch.append(self._queue.get_nowait())
-                    except queue.Empty:
-                        break
+                try:
+                    # timeout=0 == non-blocking get, so past the deadline
+                    # (always, when the window is 0) this drains whatever
+                    # is queued and stops at the first Empty
+                    batch.append(self._queue.get(
+                        timeout=max(deadline - time.monotonic(), 0)))
+                except queue.Empty:
+                    break
             try:
                 table = Table({"request": [ex.request for ex in batch]})
                 out = self.handler(table)
