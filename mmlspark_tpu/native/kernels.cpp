@@ -38,6 +38,29 @@ bool looks_hex(const char* cs, const char* ce) {
     return (ce - p) >= 2 && p[0] == '0' && (p[1] == 'x' || p[1] == 'X');
 }
 
+// Shared thread-over-row-ranges scaffolding (disjoint writes per range):
+// one place for the concurrency cap, the min-work gate, and the
+// chunk/join discipline used by binning and prediction.
+template <typename Fn>
+void parallel_rows(int64_t n, int64_t min_rows_per_thread, const Fn& fn) {
+    int64_t nt = static_cast<int64_t>(std::thread::hardware_concurrency());
+    if (nt > 16) nt = 16;
+    if (nt <= 1 || n < 2 * min_rows_per_thread) {
+        fn(static_cast<int64_t>(0), n);
+        return;
+    }
+    if (nt > n / min_rows_per_thread) nt = n / min_rows_per_thread;
+    std::vector<std::thread> workers;
+    const int64_t chunk = (n + nt - 1) / nt;
+    for (int64_t t = 0; t < nt; ++t) {
+        const int64_t r0 = t * chunk;
+        const int64_t r1 = r0 + chunk < n ? r0 + chunk : n;
+        if (r0 >= r1) break;
+        workers.emplace_back(fn, r0, r1);
+    }
+    for (auto& w : workers) w.join();
+}
+
 }  // namespace
 
 extern "C" {
@@ -167,23 +190,7 @@ void mmlspark_bin_numeric(
     };
     // thread over row ranges (disjoint writes) once the work is large
     // enough to amortize thread spawn
-    const int64_t kMinRowsPerThread = 16384;
-    int64_t nt = static_cast<int64_t>(std::thread::hardware_concurrency());
-    if (nt > 16) nt = 16;
-    if (nt <= 1 || n < 2 * kMinRowsPerThread) {
-        bin_rows(0, n);
-        return;
-    }
-    if (nt > n / kMinRowsPerThread) nt = n / kMinRowsPerThread;
-    std::vector<std::thread> workers;
-    const int64_t chunk = (n + nt - 1) / nt;
-    for (int64_t t = 0; t < nt; ++t) {
-        const int64_t r0 = t * chunk;
-        const int64_t r1 = r0 + chunk < n ? r0 + chunk : n;
-        if (r0 >= r1) break;
-        workers.emplace_back(bin_rows, r0, r1);
-    }
-    for (auto& w : workers) w.join();
+    parallel_rows(n, 16384, bin_rows);
 }
 
 // Array-of-trees SoA traversal over binned rows: replicates the jitted
@@ -207,40 +214,55 @@ void mmlspark_predict_trees(
     int64_t bc,                 // Bc (bitset width; >= 1)
     float* out)                 // (n,) or (n, k), pre-zeroed
 {
-    if (k <= 1) {
-        for (int64_t i = 0; i < n; ++i) out[i] = init_score;
-    }
-    for (int64_t t = 0; t < num_trees; ++t) {
-        const int64_t off = t * nodes_per_tree;
-        const int32_t* tf = feature + off;
-        const int32_t* tt = threshold + off;
-        const uint8_t* tc = is_cat + off;
-        const int32_t* tl = left + off;
-        const int32_t* tr = right + off;
-        const float* tv = value + off;
-        const uint8_t* tb = cat_bitset + off * bc;
-        const int32_t cls = tree_class[t];
-        for (int64_t i = 0; i < n; ++i) {
+    // ROW-outer, tree-inner: the whole forest's SoA arrays (typically a
+    // few hundred KB) stay resident in L2 while each row's bins stay in
+    // L1 across all trees — tree-outer order would stream the full (n, f)
+    // bin matrix from DRAM once PER TREE (measured 100x the traffic at
+    // 1M x 28 x 100 trees). Per-row float accumulation remains in tree
+    // order, so results are bit-identical to the old loop order and to
+    // the jitted device traversal.
+    // (A 4-row software-pipelined variant was measured SLOWER here: the
+    // out-of-order window already overlaps the independent per-tree walk
+    // chains in this row-outer order, and the parked-leaf bookkeeping
+    // cost more than the extra ILP bought.)
+    auto walk_rows = [&](int64_t r0, int64_t r1) {
+        // one walk of tree t for one row: final node index
+        auto walk_one = [&](const int32_t* row, int64_t off) -> int32_t {
             int32_t node = 0;
             for (int32_t s = 0; s < max_steps; ++s) {
-                const int32_t feat = tf[node];
+                const int32_t feat = feature[off + node];
                 if (feat < 0) break;  // leaf
-                const int32_t col = bins[i * f + feat];
+                const int32_t col = row[feat];
                 // categorical: many-vs-many subset lookup (bins past the
-                // bitset width can only occur on numeric columns)
+                // bitset width only occur on numeric columns)
                 const int64_t bcol = col < bc ? col : bc - 1;
-                const bool go_left = tc[node]
-                    ? (tb[node * bc + bcol] != 0)
-                    : (col <= tt[node]);
-                node = go_left ? tl[node] : tr[node];
+                const bool go_left = is_cat[off + node]
+                    ? (cat_bitset[(off + node) * bc + bcol] != 0)
+                    : (col <= threshold[off + node]);
+                node = go_left ? left[off + node] : right[off + node];
             }
-            if (k > 1) {
-                out[i * k + cls] += tv[node];
+            return node;
+        };
+        for (int64_t i = r0; i < r1; ++i) {
+            const int32_t* row = bins + i * f;
+            if (k <= 1) {
+                float acc = init_score;
+                for (int64_t t = 0; t < num_trees; ++t) {
+                    const int64_t off = t * nodes_per_tree;
+                    acc += value[off + walk_one(row, off)];
+                }
+                out[i] = acc;
             } else {
-                out[i] += tv[node];
+                for (int64_t t = 0; t < num_trees; ++t) {
+                    const int64_t off = t * nodes_per_tree;
+                    out[i * k + tree_class[t]] += value[off + walk_one(row, off)];
+                }
             }
         }
-    }
+    };
+    // thread over row ranges (disjoint out writes); per-row tree order is
+    // unaffected by the partitioning
+    parallel_rows(n, 8192, walk_rows);
 }
 
 }  // extern "C"
