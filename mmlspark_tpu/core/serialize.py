@@ -18,7 +18,7 @@ from typing import Any, Callable
 
 import numpy as np
 
-__all__ = ["register_stage", "registry", "save_stage", "load_stage", "stage_class", "stage_to_blob", "stage_from_blob"]
+__all__ = ["register_stage", "registry", "own_stages", "save_stage", "load_stage", "stage_class", "stage_to_blob", "stage_from_blob"]
 
 _REGISTRY: dict[str, type] = {}          # qualified "module.ClassName" -> class
 _BARE: dict[str, type | None] = {}       # bare ClassName -> class, None if ambiguous
@@ -40,6 +40,15 @@ def register_stage(cls: type) -> type:
 
 def registry() -> dict[str, type]:
     return dict(_REGISTRY)
+
+
+def own_stages() -> dict[str, type]:
+    """The package's OWN registered stages. The registry is process-global,
+    so a host process (notably the test suite's fixture stages) may have
+    registered extras; completeness-style consumers — wrapper/doc
+    generation, the fuzzing coverage walk — must enumerate only these."""
+    return {q: c for q, c in _REGISTRY.items()
+            if c.__module__.startswith("mmlspark_tpu.")}
 
 
 def stage_class(name: str) -> type:

@@ -16,7 +16,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import pytest
 
 import mmlspark_tpu
-from mmlspark_tpu.core.serialize import registry
+from mmlspark_tpu.core.serialize import own_stages, registry
 
 from .harness import experiment_fuzz, serialization_fuzz
 from .test_objects import COVERED_BY_ESTIMATOR, EXEMPT, build_all
@@ -32,7 +32,11 @@ def _import_all_submodules() -> None:
 
 
 _import_all_submodules()
-_ALL_STAGES = sorted(registry())
+# own_stages(): the coverage walk must enumerate the package's own
+# stages only — under one-process multi-file runs the global registry
+# also carries OTHER test modules' fixture stages (tests/test_core.py),
+# which legitimately have no TestObjects
+_ALL_STAGES = sorted(own_stages())
 
 
 @pytest.fixture(scope="session")

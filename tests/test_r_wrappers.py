@@ -38,10 +38,13 @@ def generated(gen):
 
 @pytest.fixture(scope="module")
 def registry(generated):
-    # generate() imported every subpackage, so the registry is populated
-    from mmlspark_tpu.core.serialize import registry as reg
+    # generate() imported every subpackage, so the registry is populated.
+    # own_stages(), the same accessor the generator enumerates: under the
+    # full suite the process-global registry also carries test-registered
+    # stages (tests/test_core.py), which have no wrappers.
+    from mmlspark_tpu.core.serialize import own_stages
 
-    return reg()
+    return own_stages()
 
 
 class TestFreshness:

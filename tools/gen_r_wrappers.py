@@ -244,7 +244,7 @@ def generate() -> dict[str, str]:
     for sub in SUBPACKAGES:
         importlib.import_module(f"mmlspark_tpu.{sub}")
     from mmlspark_tpu import __version__
-    from mmlspark_tpu.core.serialize import registry
+    from mmlspark_tpu.core.serialize import own_stages
 
     # single source of truth for the eager-import list (plain replace, not
     # str.format — the R code is full of literal braces)
@@ -253,7 +253,10 @@ def generate() -> dict[str, str]:
         "R/package.R": PACKAGE_R.replace("{subpackages}", subs)}
     exports = ["export(tpu_table)", "export(tpu_collect)"]
     seen_fns: dict[str, str] = {}
-    for qual, cls in sorted(registry().items()):
+    # own_stages(), not registry(): generation must not depend on what a
+    # host process registered (the fuzzing suite's test stages pollute
+    # the process-global registry)
+    for qual, cls in sorted(own_stages().items()):
         fn, fname, src = stage_function(qual, cls)
         if fn in seen_fns:
             # bare-name collisions would silently overwrite a wrapper file
