@@ -1,11 +1,17 @@
 """Model serving — the `SparkServing - Deploying a Classifier` notebook
 flow: train, deploy behind a local HTTP endpoint (continuous direct-reply
 path), POST rows, read the measured service latency.
+
+Second act: deploy a model WITHOUT training — the stocked model zoo's
+`gbdt_wdbc` booster (real WDBC data, LightGBM-interchange artifact,
+sha256-verified on load) goes straight behind the endpoint, the
+reference's ModelDownloader → Spark Serving story end to end.
 """
 
 import _backend  # noqa: F401 — honors JAX_PLATFORMS=cpu (see _backend.py)
 
 import json
+import os
 import urllib.request
 
 import numpy as np
@@ -13,6 +19,20 @@ import numpy as np
 from mmlspark_tpu.core.schema import Table
 from mmlspark_tpu.gbdt import GBDTClassifier
 from mmlspark_tpu.io_http import serve_model
+
+REPO = os.path.join(os.path.dirname(__file__), os.pardir)
+
+
+def _post_rows(server, rows):
+    preds = []
+    for row in rows:
+        req = urllib.request.Request(
+            server.url, data=json.dumps(row).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            preds.append(json.loads(r.read())["prediction"])
+    return preds
 
 
 def main():
@@ -23,22 +43,48 @@ def main():
         GBDTClassifier(num_iterations=30, num_leaves=15)
     )
 
-    server = serve_model(model, input_cols=["f0", "f1", "f2", "f3"],
-                         max_latency_ms=0.5)
+    server = serve_model(model, input_cols=["f0", "f1", "f2", "f3"])
     try:
-        correct = 0
-        for i in range(50):
-            row = {f"f{j}": float(x[i, j]) for j in range(4)}
-            req = urllib.request.Request(
-                server.url, data=json.dumps(row).encode(),
-                headers={"Content-Type": "application/json"},
-            )
-            with urllib.request.urlopen(req, timeout=10) as r:
-                pred = json.loads(r.read())["prediction"]
-            correct += pred == y[i]
+        rows = [{f"f{j}": float(x[i, j]) for j in range(4)}
+                for i in range(50)]
+        preds = _post_rows(server, rows)
+        correct = sum(p == yi for p, yi in zip(preds, y[:50]))
         stats = server.latency_stats()
         print(f"served 50 rows, accuracy {correct / 50:.2f}, "
               f"p50 {stats['p50_ms']:.2f} ms, p99 {stats['p99_ms']:.2f} ms")
+    finally:
+        server.stop()
+
+    # -- zero-training deployment from the stocked zoo ------------------
+    from mmlspark_tpu.gbdt.estimators import GBDTClassificationModel
+    from mmlspark_tpu.nn.zoo import ModelDownloader
+    from mmlspark_tpu.utils.datagen import holdout_split, load_label_csv
+
+    zoo = ModelDownloader(os.path.join(REPO, "model_zoo"))
+    if not any(s.name == "gbdt_wdbc" for s in zoo.models()):
+        print("zoo not stocked (run tools/build_zoo.py) — skipping act 2")
+        return
+    booster = zoo.load_booster("gbdt_wdbc")
+    zoo_model = GBDTClassificationModel()
+    zoo_model.booster = booster
+    # same assembly as load_native_model: labels come from the artifact
+    zoo_model.classes = (np.asarray(booster.class_labels)
+                         if booster.class_labels is not None else None)
+
+    xw, yw = load_label_csv(os.path.join(
+        REPO, "tests", "benchmarks", "data", "breast_cancer_wdbc.csv"))
+    _tr, te = holdout_split(len(yw))
+    cols = [f"f{j}" for j in range(xw.shape[1])]
+    server = serve_model(zoo_model, input_cols=cols)
+    try:
+        rows = [{c: float(v) for c, v in zip(cols, xw[i])} for i in te[:60]]
+        preds = _post_rows(server, rows)
+        acc = float(np.mean([p == yi for p, yi in zip(preds, yw[te[:60]])]))
+        stats = server.latency_stats()
+        print(f"zoo model (no training) served {len(rows)} real WDBC "
+              f"holdout rows: accuracy {acc:.2f}, "
+              f"p50 {stats['p50_ms']:.2f} ms")
+        assert acc > 0.9, acc
     finally:
         server.stop()
 

@@ -48,7 +48,8 @@ def own_stages() -> dict[str, type]:
     registered extras; completeness-style consumers — wrapper/doc
     generation, the fuzzing coverage walk — must enumerate only these."""
     return {q: c for q, c in _REGISTRY.items()
-            if c.__module__.startswith("mmlspark_tpu.")}
+            if c.__module__ == "mmlspark_tpu"
+            or c.__module__.startswith("mmlspark_tpu.")}
 
 
 def stage_class(name: str) -> type:
