@@ -159,6 +159,45 @@ class TestRStructure:
             src = generated[f"R/{fn[3:]}.R"]
             assert re.search(rf"^{fn} <- function\(x", src, re.M), qual
 
+    def test_defaults_round_trip_to_param_defaults(self, gen, generated,
+                                                   registry):
+        """Parse every wrapper signature's R default literals back and
+        compare against the live Param defaults — the translation layer
+        (r_default) is pinned for all stages, not just spot-checked."""
+        for qual, cls in registry.items():
+            fn = f"ml_{gen.snake(cls.__name__)}"
+            src = generated[f"R/{fn[3:]}.R"]
+            m = re.search(rf"^{fn} <- function\((.*)\)$", src, re.M)
+            assert m, qual
+            sig = m.group(1)
+            # split top-level commas (defaults contain no parens/commas:
+            # r_default emits only scalar literals and NULL)
+            args = [a.strip() for a in sig.split(",")]
+            r_defaults = {}
+            for a in args:
+                if "=" in a:
+                    name, lit = a.split("=", 1)
+                    r_defaults[name.strip()] = lit.strip()
+            for name, p in getattr(cls, "_params", {}).items():
+                if p.required:
+                    assert name not in r_defaults, (qual, name)
+                    continue
+                lit = r_defaults[name]
+                d = p.default
+                if lit == "NULL":
+                    ok = (d is None or d == () or d == []
+                          or isinstance(d, (dict, list, tuple)))
+                elif lit in ("TRUE", "FALSE"):
+                    ok = d is (lit == "TRUE")
+                elif lit.endswith("L"):
+                    ok = isinstance(d, int) and int(lit[:-1]) == d
+                elif lit.startswith('"'):
+                    ok = isinstance(d, str) and lit == f'"{d}"' \
+                        or (isinstance(d, str) and "\\" in lit)
+                else:
+                    ok = isinstance(d, float) and float(lit) == d
+                assert ok, (qual, name, lit, d)
+
     def test_conversions_match_param_types(self, gen, generated, registry):
         """Spot the contract on a known stage: int params go through
         as.integer, bools through as.logical, floats through as.double
