@@ -175,6 +175,21 @@ class Table:
         out._check_lengths()
         return out
 
+    def with_columns(self, columns: Mapping[str, Any]) -> "Table":
+        """Add/replace several columns in ONE functional update — a chain
+        of with_column would copy the column dict and re-validate lengths
+        once per column (measurable on the serving hot path, where a
+        request fans out into one column per JSON key)."""
+        cols = dict(self._cols)
+        metas = dict(self._meta)
+        for name, values in columns.items():
+            cols[name] = _as_column(values)
+            metas.pop(name, None)  # new values invalidate old metadata
+        out = Table.__new__(Table)
+        out._cols, out._meta = cols, metas
+        out._check_lengths()
+        return out
+
     def with_meta(self, name: str, meta: Mapping) -> "Table":
         if name not in self._cols:
             raise KeyError(name)

@@ -681,17 +681,25 @@ class Booster:
         k = self.num_class
         max_steps = int(self.feature.shape[1] // 2 + 1)
         # native per-row scoring (the LGBM_BoosterPredictForMat analogue,
-        # mmlspark_tpu/native); bit-identical to the numpy walk below
-        from ..native import predict_trees as _native_predict
+        # mmlspark_tpu/native); bit-identical to the numpy walk below.
+        # The prepared closure caches the immutable tree arrays' ctypes
+        # marshalling — rebuilt only if this instance never made one
+        # (trees never change after construction; truncated views are new
+        # instances with their own cache slot).
+        fn = self._predict_cache.get("host_fn")
+        if fn is None:
+            from ..native import make_tree_predictor
 
-        res = _native_predict(
-            np.asarray(bins, np.int32), self.feature, self.threshold_bin,
-            self.is_categorical, self.left, self.right, self.value,
-            self.tree_class, k, max_steps, self.init_score,
-            self.cat_bitset,
-        )
-        if res is not None:
-            return res
+            fn = make_tree_predictor(
+                self.feature, self.threshold_bin, self.is_categorical,
+                self.left, self.right, self.value, self.tree_class,
+                k, max_steps, self.init_score, self.cat_bitset,
+            )
+            # truncated views get fresh instances with empty caches, and
+            # the LRU eviction above only touches ("truncated", n) keys
+            self._predict_cache["host_fn"] = fn or False
+        if fn:
+            return fn(np.asarray(bins, np.int32))
         out = (np.zeros((n, k), np.float32) if k > 1
                else np.full((n,), self.init_score, np.float32))
         for t in range(self.num_trees):

@@ -74,20 +74,22 @@ def parse_request(table: Table, request_col: str = "request",
             for k in b:
                 if k not in keys:
                     keys.append(k)
-        out = table
+        new_cols: dict[str, Any] = {}
         for k in keys:
             vals = [b.get(k) for b in bodies]
             if all(isinstance(v, (int, float, bool, type(None))) for v in vals):
-                out = out.with_column(k, np.asarray(
-                    [np.nan if v is None else v for v in vals], np.float64))
+                new_cols[k] = np.asarray(
+                    [np.nan if v is None else v for v in vals], np.float64)
             elif all(isinstance(v, list) for v in vals):
                 try:
-                    out = out.with_column(k, np.asarray(vals, np.float64))
+                    new_cols[k] = np.asarray(vals, np.float64)
                 except (ValueError, TypeError):
-                    out = out.with_column(k, vals)
+                    new_cols[k] = vals
             else:
-                out = out.with_column(k, vals)
-        return out
+                new_cols[k] = vals
+        # one functional update: a per-key with_column chain re-copies the
+        # table once per JSON field on every request
+        return table.with_columns(new_cols)
     col = output_col or "body"
     return table.with_column(col, bodies)
 

@@ -93,6 +93,19 @@ def get_lib() -> "ctypes.CDLL | None":
             ctypes.c_int32, ctypes.c_int32, ctypes.c_float, _U8, _I64, _F32,
         ]
         lib.mmlspark_predict_trees.restype = None
+        # raw void* twin of the SAME signature, declared here so the two
+        # can never drift: make_tree_predictor calls through it with
+        # cached data pointers (the ndpointer path re-marshals every
+        # immutable tree array on every call)
+        _raw_proto = ctypes.CFUNCTYPE(
+            None,
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64,
+            *([ctypes.c_void_p] * 7),
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_float,
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+        )
+        lib._predict_trees_raw = _raw_proto(("mmlspark_predict_trees", lib))
         lib.mmlspark_csv_parse.argtypes = [
             ctypes.c_char_p, np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
             _I64, _I64, ctypes.c_char, _F64, _U8, ctypes.c_int32,
@@ -151,25 +164,29 @@ def csv_parse(data: bytes, offsets: np.ndarray, n_cols: int,
     return out, ok
 
 
-def predict_trees(bins: np.ndarray, feature: np.ndarray, threshold: np.ndarray,
-                  is_cat: np.ndarray, left: np.ndarray, right: np.ndarray,
-                  value: np.ndarray, tree_class: np.ndarray, k: int,
-                  max_steps: int, init_score: float,
-                  cat_bitset: "np.ndarray | None" = None
-                  ) -> "np.ndarray | None":
-    """SoA tree-walk scoring; None when the native lib is unavailable.
-    cat_bitset: (T, M, Bc) bool left-subset masks for categorical nodes."""
+def make_tree_predictor(feature: np.ndarray, threshold: np.ndarray,
+                        is_cat: np.ndarray, left: np.ndarray,
+                        right: np.ndarray, value: np.ndarray,
+                        tree_class: np.ndarray, k: int, max_steps: int,
+                        init_score: float,
+                        cat_bitset: "np.ndarray | None" = None):
+    """Prepared SoA tree-walk scorer: `fn(bins) -> out`, or None when the
+    native lib is unavailable.
+
+    The tree arrays are immutable after training, but the plain
+    predict_trees wrapper re-ran ascontiguousarray + ndpointer
+    marshalling on all eight of them per call — measured ~0.1 ms per
+    single-row serving request, comparable to the walk itself. Here they
+    are converted ONCE and the call goes through a raw void* prototype
+    with cached data pointers; only `bins`/`out` marshal per call."""
     lib = get_lib()
     if lib is None:
         return None
-    n, f = bins.shape
     t, m = feature.shape
     if cat_bitset is None:
         cat_bitset = np.zeros((t, m, 1), bool)
     bc = cat_bitset.shape[-1]
-    out = (np.zeros((n, k), np.float32) if k > 1 else np.zeros((n,), np.float32))
-    lib.mmlspark_predict_trees(
-        np.ascontiguousarray(bins, np.int32), n, f, t, m,
+    arrs = (
         np.ascontiguousarray(feature, np.int32),
         np.ascontiguousarray(threshold, np.int32),
         np.ascontiguousarray(is_cat, np.uint8),
@@ -177,7 +194,36 @@ def predict_trees(bins: np.ndarray, feature: np.ndarray, threshold: np.ndarray,
         np.ascontiguousarray(right, np.int32),
         np.ascontiguousarray(value, np.float32),
         np.ascontiguousarray(tree_class, np.int32),
-        k, max_steps, float(init_score),
-        np.ascontiguousarray(cat_bitset, np.uint8), bc, out,
+        np.ascontiguousarray(cat_bitset, np.uint8),
     )
-    return out
+    fn = lib._predict_trees_raw  # declared beside argtypes in get_lib
+    tree_ptrs = tuple(a.ctypes.data for a in arrs[:7])
+    cat_ptr = arrs[7].ctypes.data
+    init = float(init_score)
+    kk, steps = int(k), int(max_steps)
+
+    def predict(bins: np.ndarray) -> np.ndarray:
+        b = np.ascontiguousarray(bins, np.int32)
+        n, f = b.shape
+        out = (np.zeros((n, kk), np.float32) if kk > 1
+               else np.zeros((n,), np.float32))
+        fn(b.ctypes.data, n, f, t, m, *tree_ptrs,
+           kk, steps, init, cat_ptr, bc, out.ctypes.data)
+        return out
+
+    predict._keepalive = arrs  # the cached pointers must outlive the closure
+    return predict
+
+
+def predict_trees(bins: np.ndarray, feature: np.ndarray, threshold: np.ndarray,
+                  is_cat: np.ndarray, left: np.ndarray, right: np.ndarray,
+                  value: np.ndarray, tree_class: np.ndarray, k: int,
+                  max_steps: int, init_score: float,
+                  cat_bitset: "np.ndarray | None" = None
+                  ) -> "np.ndarray | None":
+    """SoA tree-walk scoring; None when the native lib is unavailable.
+    cat_bitset: (T, M, Bc) bool left-subset masks for categorical nodes.
+    One-shot convenience over make_tree_predictor."""
+    fn = make_tree_predictor(feature, threshold, is_cat, left, right, value,
+                             tree_class, k, max_steps, init_score, cat_bitset)
+    return None if fn is None else fn(bins)
