@@ -855,6 +855,48 @@ def bench_serving() -> dict:
             "client_rtt_p99_ms": float(np.percentile(rtt_ms, 99))}
 
 
+def bench_streaming() -> dict:
+    """Micro-batch engine throughput (batches/sec, rows/sec): a fitted GBDT
+    model scoring MemorySource batches through StreamingQuery into a
+    MemorySink. The driver loop is host-side Python, so this row tracks
+    per-batch engine overhead, NOT accelerator throughput — it is reported
+    as a CPU number regardless of platform. The model transform itself is
+    the compile-once/stream-forever path: batch 0 compiles, every later
+    batch replays the cached executable."""
+    from mmlspark_tpu.core.schema import Table
+    from mmlspark_tpu.gbdt.estimators import GBDTClassifier
+    from mmlspark_tpu.streaming import MemorySink, MemorySource, StreamingQuery
+
+    x, y = make_dataset(4096, 8, seed=13)
+    model = GBDTClassifier(num_iterations=10, num_leaves=15).fit(
+        Table({"features": x, "label": y})
+    )
+    rows_per_batch, n_batches = 512, 50
+    rng = np.random.default_rng(17)
+    batches = [Table({"features": rng.normal(size=(rows_per_batch, 8))})
+               for _ in range(n_batches)]
+
+    source, sink = MemorySource(), MemorySink()
+    q = StreamingQuery(source, model, sink, name="bench")
+    # warm-up batch: compile the scoring step outside the timed window
+    source.add_rows(batches[0])
+    q.process_next()
+    t0 = time.perf_counter()
+    for b in batches[1:]:
+        source.add_rows(b)
+        q.process_next()
+    elapsed = time.perf_counter() - t0
+    q.stop()
+    timed = n_batches - 1
+    assert q.batches_processed == n_batches, (
+        f"expected {n_batches} micro-batches, ran {q.batches_processed}")
+    return {
+        "batches_per_sec": timed / elapsed,
+        "rows_per_sec": timed * rows_per_batch / elapsed,
+        "rows_per_batch": rows_per_batch,
+    }
+
+
 def _resolve_kernel_name() -> str:
     from mmlspark_tpu.core.kernels import resolve
 
@@ -937,6 +979,20 @@ def _transformer_extra(transformer: "dict | None") -> dict:
     }
 
 
+def _streaming_extra(streaming: "dict | None") -> dict:
+    """Streaming-engine fields of the JSON line. The micro-batch driver is
+    host-side Python: these are CPU numbers on every platform (the label
+    keeps a TPU run's trend line from being read as accelerator work)."""
+    g = (streaming or {}).get
+    return {
+        "streaming_batches_per_sec": _r1(streaming, "batches_per_sec"),
+        "streaming_rows_per_sec": _r1(streaming, "rows_per_sec"),
+        "streaming_rows_per_batch": g("rows_per_batch"),
+        "streaming_backend": "cpu (host-side driver, non-TPU)"
+        if streaming else None,
+    }
+
+
 def _run_suite(platform: str) -> dict:
     chip, peak_tflops, peak_gbps = chip_peaks()
 
@@ -1000,6 +1056,11 @@ def _run_suite(platform: str) -> dict:
     except Exception as e:  # noqa: BLE001 — latency is auxiliary
         print(f"bench: serving latency bench failed ({e!r})", file=sys.stderr)
         serving = None
+    try:
+        streaming = bench_streaming()
+    except Exception as e:  # noqa: BLE001 — engine overhead is auxiliary
+        print(f"bench: streaming bench failed ({e!r})", file=sys.stderr)
+        streaming = None
 
     resident = runner.get("resident_images_per_sec", 0.0)
     mfu_note = (
@@ -1047,6 +1108,7 @@ def _run_suite(platform: str) -> dict:
                 serving["client_rtt_p50_ms"], 3) if serving else None,
             "serving_client_rtt_p99_ms": round(
                 serving["client_rtt_p99_ms"], 3) if serving else None,
+            **_streaming_extra(streaming),
             "headroom_note": (
                 "gbdt fit is HBM-bound (see gbdt_modeled_hbm_* vs chip peak); "
                 "end-to-end runner throughput is host->device transfer bound: "

@@ -196,7 +196,11 @@ def read_parquet(path: str) -> Table:
     for name in tbl.column_names:
         ca = tbl[name].combine_chunks()
         t = ca.type
-        if pat.is_floating(t):
+        if pat.is_fixed_size_list(t):
+            # vector column written by write_parquet: restore (n, d)
+            flat = ca.flatten().to_numpy(zero_copy_only=False)
+            cols[name] = flat.reshape(len(ca), t.list_size)
+        elif pat.is_floating(t):
             cols[name] = ca.to_numpy(zero_copy_only=False)
         elif pat.is_integer(t) or pat.is_boolean(t):
             if ca.null_count:
@@ -219,7 +223,13 @@ def write_parquet(table: Table, path: str) -> None:
     for name in table.columns:
         col = table[name]
         names.append(name)
-        if isinstance(col, np.ndarray):
+        if isinstance(col, np.ndarray) and col.ndim == 2:
+            # vector column: FixedSizeList keeps the row width in the
+            # schema so read_parquet can restore the (n, d) ndarray
+            flat = pa.array(np.ascontiguousarray(col).reshape(-1))
+            arrays.append(pa.FixedSizeListArray.from_arrays(
+                flat, col.shape[1]))
+        elif isinstance(col, np.ndarray):
             arrays.append(pa.array(col))
         else:
             arrays.append(pa.array(list(col)))

@@ -40,11 +40,20 @@ from typing import Any, NamedTuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # jax < 0.5: shard_map lives under experimental
+    import functools as _functools
+
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    # the old rep-checker cannot type the varying scan carries this module
+    # builds (new jax proves them with pcast); disable it, semantics match
+    shard_map = _functools.partial(_shard_map, check_rep=False)
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..parallel.mesh import DATA_AXIS
-from ..parallel.collectives import psum_exact_fixedpoint
+from ..parallel.collectives import pcast, psum_exact_fixedpoint
 
 __all__ = ["TreeArrays", "GrowConfig", "make_grow_fn", "pad_rows"]
 
@@ -346,7 +355,7 @@ def make_grow_fn(
         if axis_name is not None:
             # constants are replicated under shard_map; row state must carry
             # the varying-manual-axis type so lax.cond branches agree
-            node_of_row = jax.lax.pcast(node_of_row, (axis_name,), to="varying")
+            node_of_row = pcast(node_of_row, (axis_name,), to="varying")
         hists = jnp.zeros((m, num_features, num_bins, 3), jnp.float32)
         hists = hists.at[0].set(
             root_h0 if root_h0 is not None else hist_for(sample_mask)

@@ -39,10 +39,19 @@ from typing import Callable, NamedTuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # jax < 0.5: shard_map lives under experimental
+    import functools as _functools
+
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    # the old rep-checker cannot type the varying scan carries this module
+    # builds (new jax proves them with pcast); disable it, semantics match
+    shard_map = _functools.partial(_shard_map, check_rep=False)
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..parallel.collectives import psum_exact_fixedpoint
+from ..parallel.collectives import pcast, psum_exact_fixedpoint
 from ..parallel.mesh import DATA_AXIS
 from .engine import GrowConfig, TreeArrays, make_grow_fn, tree_apply
 
@@ -564,7 +573,7 @@ def make_fused_dart_fn(
             # the contribution matrix holds row-sharded values; the zeros
             # init must carry the varying manual-axis type so the scan
             # carry types line up (engine.py's node_of_row pattern)
-            contribs0 = jax.lax.pcast(contribs0, (axis_name,), to="varying")
+            contribs0 = pcast(contribs0, (axis_name,), to="varying")
 
         def body(carry, it):
             trees, contribs, weights, bag = carry

@@ -31,10 +31,13 @@ __all__ = ["ForwardingOptions", "PortForward", "build_ssh_command",
 def get_local_ip() -> str:
     """This host's outbound-facing IP (reference getLocalIp,
     HTTPSourceV2.scala:325-327). A connectionless UDP socket picks the
-    routing-table answer without sending any packet."""
+    routing-table answer without sending any packet. The probe target is a
+    PUBLIC address (the reference uses one too): probing 10/8 would return
+    127.0.0.1 on any host without an RFC-1918 route even though it has a
+    perfectly good default route."""
     s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
     try:
-        s.connect(("10.255.255.255", 1))
+        s.connect(("8.8.8.8", 80))
         return s.getsockname()[0]
     except OSError:
         return "127.0.0.1"
@@ -75,6 +78,10 @@ def build_ssh_command(opts: ForwardingOptions, remote_port: int,
         # listen-port-busy must FAIL the process (the scan signal), not
         # degrade to a warning while ssh stays connected
         "-o", "ExitOnForwardFailure=yes",
+        # no interactive auth: a gateway that falls back to a password
+        # prompt must exit nonzero immediately, not sit at the prompt for
+        # the whole settle window and register as an established tunnel
+        "-o", "BatchMode=yes",
         "-o", "StrictHostKeyChecking=no",
         "-o", f"ConnectTimeout={max(int(opts.connect_timeout_s), 1)}",
         # a half-dead gateway must not leave a zombie forward behind NAT:
@@ -123,8 +130,12 @@ class PortForward:
 
 
 def _default_launcher(cmd: Sequence[str]):
+    # stdin=DEVNULL: with no tty, anything in ssh that still tries to read
+    # (a stray prompt BatchMode missed, host-key confirmation on an odd
+    # sshd) gets EOF and dies instead of blocking on the parent's stdin
     return subprocess.Popen(
-        list(cmd), stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        list(cmd), stdin=subprocess.DEVNULL,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
 
 
 def establish_forward(

@@ -96,16 +96,22 @@ def get_lib() -> "ctypes.CDLL | None":
         # raw void* twin of the SAME signature, declared here so the two
         # can never drift: make_tree_predictor calls through it with
         # cached data pointers (the ndpointer path re-marshals every
-        # immutable tree array on every call)
-        _raw_proto = ctypes.CFUNCTYPE(
-            None,
+        # immutable tree array on every call). It must be a SECOND CDLL
+        # handle, not a CFUNCTYPE wrapper: ctypes releases the GIL only for
+        # foreign functions reached through a library object (CFUNCTYPE
+        # pointers are called WITH the GIL held), and the tree walk now
+        # shares a process with serving threads that must keep draining
+        # sockets while it runs.
+        raw = ctypes.CDLL(path)
+        raw.mmlspark_predict_trees.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
             ctypes.c_int64, ctypes.c_int64,
             *([ctypes.c_void_p] * 7),
             ctypes.c_int32, ctypes.c_int32, ctypes.c_float,
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
-        )
-        lib._predict_trees_raw = _raw_proto(("mmlspark_predict_trees", lib))
+        ]
+        raw.mmlspark_predict_trees.restype = None
+        lib._predict_trees_raw = raw.mmlspark_predict_trees
         lib.mmlspark_csv_parse.argtypes = [
             ctypes.c_char_p, np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
             _I64, _I64, ctypes.c_char, _F64, _U8, ctypes.c_int32,

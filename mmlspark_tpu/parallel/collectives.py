@@ -56,6 +56,7 @@ __all__ = [
     "all_to_all",
     "axis_index",
     "axis_size",
+    "pcast",
     "psum_ordered",
     "psum_kahan",
     "psum_exact_fixedpoint",
@@ -88,7 +89,7 @@ def reduce_scatter(x, axis_name: str, scatter_dimension: int = 0):
 def ppermute_ring(x, axis_name: str, reverse: bool = False):
     """Rotate shards one step around the ring — the building block of ring
     attention. Lowered by XLA to a neighbor exchange on the ICI torus."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     if reverse:
         perm = [(i, (i - 1) % n) for i in range(n)]
     else:
@@ -107,7 +108,18 @@ def axis_index(axis_name: str):
 
 
 def axis_size(axis_name: str):
-    return lax.axis_size(axis_name)
+    # lax.axis_size is newer-jax; psum of ones is the portable spelling
+    # (constant-folded to the static mapped-axis size, no collective)
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def pcast(x, axis_names, to: str = "varying"):
+    """lax.pcast where it exists; identity on older jax, whose shard_map
+    has no varying-manual-axes typing to satisfy."""
+    fn = getattr(lax, "pcast", None)
+    return fn(x, axis_names, to=to) if fn is not None else x
 
 
 # --------------------------------------------------------------------- #
@@ -188,7 +200,7 @@ def psum_exact_fixedpoint(x, axis_name: str, *, n_shards: int | None = None):
     to the global max.
     """
     if n_shards is None:
-        n_shards = lax.axis_size(axis_name)
+        n_shards = axis_size(axis_name)
     # per-channel scale over all but the last axis; every shard must agree,
     # so reduce the max with pmax (max is order-independent — no
     # determinism leak here)

@@ -19,6 +19,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .collectives import axis_size
+
 __all__ = ["MoEParams", "init_moe", "moe_ffn_local", "moe_ffn_sharded"]
 
 EXPERT_AXIS = "expert"
@@ -95,7 +97,7 @@ def moe_ffn_sharded(params: MoEParams, x, axis_name: str = EXPERT_AXIS,
     holds its E_local experts' tokens from EVERY shard; `all_to_all` #2
     sends expert outputs back to the owning token shards.
     """
-    n_shards = lax.axis_size(axis_name)
+    n_shards = axis_size(axis_name)
     t_local, d = x.shape
     e_local = params.w1.shape[0]
     e = e_local * n_shards

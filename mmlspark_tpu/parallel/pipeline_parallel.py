@@ -20,8 +20,15 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+try:
+    from jax import shard_map
+except ImportError:  # jax < 0.5: shard_map lives under experimental
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from .collectives import pcast
 
 PIPE_AXIS = "pipe"
 
@@ -80,10 +87,12 @@ def pipeline_apply(stage_fn, n_stages: int, axis_name: str = PIPE_AXIS):
 
         # the loop body makes both carries device-varying (ppermute / writes
         # gated on axis_index); the initial values must carry that type too
-        state0 = lax.pcast(
+        # (collectives.pcast is an identity on older jax, which has no
+        # varying-manual-axes typing)
+        state0 = pcast(
             jnp.zeros_like(microbatches[0]), (axis_name,), to="varying"
         )
-        out0 = lax.pcast(
+        out0 = pcast(
             jnp.zeros_like(microbatches), (axis_name,), to="varying"
         )
         _, outputs = lax.fori_loop(

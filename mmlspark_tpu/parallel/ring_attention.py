@@ -27,8 +27,15 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+try:
+    from jax import shard_map
+except ImportError:  # jax < 0.5: shard_map lives under experimental
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from .collectives import axis_size
 
 __all__ = [
     "dense_attention",
@@ -92,7 +99,7 @@ def _ring_attention_sharded(q, k, v, axis_name: str, causal: bool,
     one-block-per-hop update."""
     b, t_local, h, d = q.shape
     scale = d ** -0.5
-    n_dev = lax.axis_size(axis_name)
+    n_dev = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     q_off = my * t_local
     qpos = jnp.arange(t_local) + q_off

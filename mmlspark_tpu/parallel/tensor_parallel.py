@@ -15,7 +15,12 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+try:
+    from jax import shard_map
+except ImportError:  # jax < 0.5: shard_map lives under experimental
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 __all__ = [

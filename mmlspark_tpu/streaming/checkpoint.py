@@ -1,0 +1,188 @@
+"""Write-ahead commit log + state snapshots for streaming queries.
+
+Reference contract: Spark's streaming checkpoint directory holds an
+`offsets/<batchId>` file written BEFORE a batch runs and a
+`commits/<batchId>` file written after the sink accepts it; on restart
+the query replays the last planned-but-uncommitted batch against the
+exact offsets in its plan file. That plan-first ordering is what makes
+replay deterministic: the restarted query re-forms the in-flight batch
+from the RECORDED offset range, not from whatever the source contains
+now, so an idempotent sink sees byte-identical data for the same
+batch id.
+
+TPU redesign: one append-only JSONL log (`commits.jsonl`) carries both
+record types — `{"t": "plan", "batch_id", "start", "end"}` and
+`{"t": "commit", "batch_id"}` — with the serving journal's durability
+idioms (io_http/journal.py): write+flush+fsync per record, torn-tail
+detection with on-disk truncation at load, atomic compact via tmp-write
+plus os.replace. Stateful-operator snapshots live beside it as
+`state-<batchId>.json`, written atomically before the sink write so a
+replayed batch restarts its operators from the state that PRECEDED the
+crashed attempt.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+__all__ = ["CommitLog"]
+
+
+class CommitLog:
+    """Plan/commit write-ahead log under `checkpoint_dir/commits.jsonl`."""
+
+    FILENAME = "commits.jsonl"
+    _STATE_FMT = "state-{:09d}.json"
+
+    def __init__(self, checkpoint_dir: str):
+        self.dir = checkpoint_dir
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        self.path = os.path.join(checkpoint_dir, self.FILENAME)
+        self._lock = threading.Lock()
+        self._plans: dict[int, dict] = {}   # batch_id -> {"start", "end"}
+        self._committed: set[int] = set()
+        self._load()
+        self._fh = open(self.path, "a", encoding="utf-8")
+
+    # -- load / durability (journal.py idioms) ---------------------------- #
+
+    def _load(self) -> None:
+        if not os.path.exists(self.path):
+            return
+        good_end = 0     # byte offset just past the last intact record
+        with open(self.path, "rb") as fh:
+            for raw in fh:
+                if not raw.endswith(b"\n"):
+                    break                       # torn tail (no newline)
+                line = raw.strip()
+                if not line:
+                    good_end += len(raw)
+                    continue
+                try:
+                    rec = json.loads(line.decode("utf-8"))
+                except (json.JSONDecodeError, UnicodeDecodeError):
+                    break                       # torn record mid-append
+                good_end += len(raw)
+                self._apply(rec)
+        # truncate the torn tail ON DISK (appending after a partial line
+        # would fuse the next record onto it — see journal.py._load)
+        if good_end < os.path.getsize(self.path):
+            with open(self.path, "r+b") as fh:
+                fh.truncate(good_end)
+
+    def _apply(self, rec: dict) -> None:
+        if rec.get("t") == "plan":
+            self._plans[int(rec["batch_id"])] = {
+                "start": rec.get("start"), "end": rec.get("end")}
+        elif rec.get("t") == "commit":
+            self._committed.add(int(rec["batch_id"]))
+
+    def _append(self, rec: dict) -> None:
+        self._fh.write(json.dumps(rec) + "\n")
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+
+    # -- plan / commit ---------------------------------------------------- #
+
+    def plan(self, batch_id: int, start, end) -> None:
+        """Record the offset range of `batch_id` BEFORE running it.
+        Offsets are JSON-able dicts (or None for 'beginning of stream')."""
+        with self._lock:
+            self._plans[batch_id] = {"start": start, "end": end}
+            self._append({"t": "plan", "batch_id": batch_id,
+                          "start": start, "end": end})
+
+    def planned(self, batch_id: int) -> dict | None:
+        """{"start", "end"} of a planned batch, or None."""
+        with self._lock:
+            return self._plans.get(batch_id)
+
+    def commit(self, batch_id: int) -> None:
+        with self._lock:
+            if batch_id in self._committed:
+                return
+            self._committed.add(batch_id)
+            self._append({"t": "commit", "batch_id": batch_id})
+
+    def last_committed(self) -> int:
+        """Highest committed batch id; -1 when nothing has committed."""
+        with self._lock:
+            return max(self._committed, default=-1)
+
+    # -- state snapshots --------------------------------------------------- #
+
+    def _state_path(self, batch_id: int) -> str:
+        return os.path.join(self.dir, self._STATE_FMT.format(batch_id))
+
+    def write_state(self, batch_id: int, doc: dict) -> None:
+        """Atomically snapshot stateful-operator state as of AFTER
+        `batch_id` (tmp + rename, so a crash mid-write leaves the previous
+        snapshot intact and a replay simply overwrites)."""
+        tmp = self._state_path(batch_id) + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self._state_path(batch_id))
+
+    def read_state(self, batch_id: int) -> dict | None:
+        try:
+            with open(self._state_path(batch_id), encoding="utf-8") as fh:
+                return json.load(fh)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return None
+
+    def prune_state(self, keep_from: int) -> None:
+        """Drop snapshots older than `keep_from` (recovery only ever needs
+        the last committed batch's state)."""
+        for name in os.listdir(self.dir):
+            if not (name.startswith("state-") and name.endswith(".json")):
+                continue
+            try:
+                bid = int(name[len("state-"):-len(".json")])
+            except ValueError:
+                continue
+            if bid < keep_from:
+                try:
+                    os.unlink(os.path.join(self.dir, name))
+                except OSError:
+                    pass
+
+    # -- compaction -------------------------------------------------------- #
+
+    def compact(self) -> int:
+        """Rewrite the log keeping only the last committed batch's records
+        and anything after it (the commit-trimming analogue). The last
+        committed plan must SURVIVE compaction: its `end` is the start
+        offset of the next batch after a restart. Returns records dropped."""
+        with self._lock:
+            last = max(self._committed, default=-1)
+            keep_plans = {b: p for b, p in self._plans.items() if b >= last}
+            keep_commits = {b for b in self._committed if b >= last}
+            dropped = (len(self._plans) - len(keep_plans)) + (
+                len(self._committed) - len(keep_commits))
+            self._plans, self._committed = keep_plans, keep_commits
+            tmp = self.path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                for b in sorted(self._plans):
+                    fh.write(json.dumps({
+                        "t": "plan", "batch_id": b,
+                        "start": self._plans[b]["start"],
+                        "end": self._plans[b]["end"]}) + "\n")
+                for b in sorted(self._committed):
+                    fh.write(json.dumps({"t": "commit", "batch_id": b}) + "\n")
+                fh.flush()
+                os.fsync(fh.fileno())
+            self._fh.close()
+            os.replace(tmp, self.path)
+            self._fh = open(self.path, "a", encoding="utf-8")
+            return dropped
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._fh.close()
+            except OSError:
+                pass

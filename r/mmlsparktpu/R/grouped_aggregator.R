@@ -1,0 +1,19 @@
+#' GroupedAggregator (Transformer)
+#'
+#' Running grouped aggregation in complete output mode: each batch folds into per-group accumulators and `transform` returns the CURRENT aggregate for every group seen so far, sorted by group key.
+#'
+#' @param x a data.frame or tpu_table
+#' @param group_col grouping column; rows sharing a value share an accumulator
+#' @param value_col numeric column to aggregate; None counts rows
+#' @param agg one of count|sum|mean|min|max
+#' @param output_col output column holding the aggregate
+#' @export
+ml_grouped_aggregator <- function(x, group_col = "key", value_col = NULL, agg = "count", output_col = "aggregate")
+{
+  params <- list()
+  if (!is.null(group_col)) params$group_col <- as.character(group_col)
+  if (!is.null(value_col)) params$value_col <- as.character(value_col)
+  if (!is.null(agg)) params$agg <- as.character(agg)
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  .tpu_apply_stage("mmlspark_tpu.streaming.state.GroupedAggregator", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,25 @@
+#' WindowedAggregator (Transformer)
+#'
+#' Tumbling-window aggregation with a watermark: rows are bucketed by `floor(time / window_s)`, rows older than the watermark are DROPPED (counted in `late_rows_dropped`), and a window is emitted exactly once — when the watermark (max event time seen minus `watermark_delay_s`) passes its end — then its state is evicted.
+#'
+#' @param x a data.frame or tpu_table
+#' @param time_col event-time column, in seconds
+#' @param window_s tumbling window length in seconds
+#' @param group_col optional sub-grouping column within windows
+#' @param value_col numeric column to aggregate; None counts rows
+#' @param agg one of count|sum|mean|min|max
+#' @param output_col output column holding the aggregate
+#' @param watermark_delay_s how long to admit out-of-order rows past the max event time seen
+#' @export
+ml_windowed_aggregator <- function(x, time_col = "time", window_s = 60.0, group_col = NULL, value_col = NULL, agg = "count", output_col = "aggregate", watermark_delay_s = 0.0)
+{
+  params <- list()
+  if (!is.null(time_col)) params$time_col <- as.character(time_col)
+  if (!is.null(window_s)) params$window_s <- as.double(window_s)
+  if (!is.null(group_col)) params$group_col <- as.character(group_col)
+  if (!is.null(value_col)) params$value_col <- as.character(value_col)
+  if (!is.null(agg)) params$agg <- as.character(agg)
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  if (!is.null(watermark_delay_s)) params$watermark_delay_s <- as.double(watermark_delay_s)
+  .tpu_apply_stage("mmlspark_tpu.streaming.state.WindowedAggregator", params, x, is_estimator = FALSE)
+}

@@ -743,6 +743,31 @@ def _io_http_objects(ctx) -> dict[str, list[TestObject]]:
     }
 
 
+def _streaming_objects(ctx) -> dict[str, list[TestObject]]:
+    from mmlspark_tpu.streaming import GroupedAggregator, WindowedAggregator
+
+    # event times span five 10s windows; with a 5s watermark delay the
+    # max time (47) finalizes everything through [30,40) in one batch,
+    # so the windowed fuzz exercises real emission, not an empty table
+    events = Table({
+        "key": ["a", "b", "a", "c", "b", "a"],
+        "value": np.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0]),
+        "time": np.array([1.0, 5.0, 12.0, 18.0, 23.0, 47.0]),
+    })
+    return {
+        "mmlspark_tpu.streaming.state.GroupedAggregator": [TestObject(
+            GroupedAggregator(group_col="key", value_col="value", agg="sum"),
+            transform_table=events,
+        )],
+        "mmlspark_tpu.streaming.state.WindowedAggregator": [TestObject(
+            WindowedAggregator(time_col="time", window_s=10.0,
+                               group_col="key", value_col="value",
+                               agg="mean", watermark_delay_s=5.0),
+            transform_table=events,
+        )],
+    }
+
+
 BUILDER_GROUPS: list[Callable] = [
     _core_objects,
     _ops_objects,
@@ -753,6 +778,7 @@ BUILDER_GROUPS: list[Callable] = [
     _automl_objects,
     _recommendation_objects,
     _io_http_objects,
+    _streaming_objects,
 ]
 
 

@@ -28,7 +28,10 @@ def main() -> None:
     )
 
     import numpy as np
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:  # jax < 0.5: shard_map lives under experimental
+        from jax.experimental.shard_map import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     devs = jax.devices()                     # global across processes

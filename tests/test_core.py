@@ -235,7 +235,10 @@ class TestMesh:
         import jax
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
-        from jax import shard_map
+        try:
+            from jax import shard_map
+        except ImportError:
+            from jax.experimental.shard_map import shard_map
         from mmlspark_tpu.parallel import DATA_AXIS, MODEL_AXIS
 
         x = np.ones((8, 4), np.float32)

@@ -1,6 +1,10 @@
 """Example-script smoke tests — the role of the reference's notebook smoke
 runs (tools/pytests/notebook-tests + NotebookTests.scala): every shipped
-example must execute end to end on the CPU mesh."""
+example must execute end to end on the CPU mesh.
+
+Each example is a full interpreter + mesh + compile cycle (minutes of wall
+clock across the set), so the module lives in the slow tier with the other
+end-to-end subprocess suites; tier-1 covers the same code paths in-process."""
 
 import os
 import pathlib
@@ -8,6 +12,8 @@ import subprocess
 import sys
 
 import pytest
+
+pytestmark = pytest.mark.slow
 
 REPO = pathlib.Path(__file__).parent.parent
 EXAMPLES = sorted(p for p in (REPO / "examples").glob("*.py")

@@ -29,6 +29,11 @@ import os
 import numpy as np
 import pytest
 
+# every case compiles a fresh fused boosting loop (different TrainOptions =
+# different XLA program); minutes of compile wall-clock put the module in
+# the slow tier alongside the other end-to-end gates
+pytestmark = pytest.mark.slow
+
 DATA = os.path.join(os.path.dirname(__file__), "benchmarks", "data",
                     "breast_cancer_wdbc.csv")
 

@@ -1,0 +1,607 @@
+"""Structured streaming engine tests.
+
+Covers the Source/Sink contracts, the commit-log WAL, stateful operators
+(watermarks, late-data drop, state checkpointing), the StreamingQuery
+driver, ServingSource parity with the direct serving path, and the
+exactly-once kill-and-restart guarantee (subprocess SIGKILL mid-stream;
+sink output must be byte-identical to a one-shot batch transform).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.schema import Table
+from mmlspark_tpu.core.table_io import write_csv
+from mmlspark_tpu.streaming import (
+    CommitLog,
+    DirectorySource,
+    ForeachBatchSink,
+    GroupedAggregator,
+    MemorySink,
+    MemorySource,
+    ParquetSink,
+    ReplySink,
+    ServingSource,
+    SocketSource,
+    StreamingQuery,
+    WindowedAggregator,
+)
+
+def _tbl(lo, hi):
+    return Table({"x": np.arange(float(lo), float(hi))})
+
+
+# --------------------------------------------------------------------------- #
+# commit log
+
+
+class TestCommitLog:
+    def test_plan_then_commit_roundtrip(self, tmp_path):
+        log = CommitLog(str(tmp_path))
+        assert log.last_committed() == -1
+        log.plan(0, None, {"rows": 3})
+        assert log.planned(0) == {"start": None, "end": {"rows": 3}}
+        assert log.last_committed() == -1     # planned is not committed
+        log.commit(0)
+        log.close()
+        log2 = CommitLog(str(tmp_path))
+        assert log2.last_committed() == 0
+        assert log2.planned(0)["end"] == {"rows": 3}
+        log2.close()
+
+    def test_torn_tail_is_truncated_on_disk(self, tmp_path):
+        log = CommitLog(str(tmp_path))
+        log.plan(0, None, {"rows": 1})
+        log.commit(0)
+        log.close()
+        with open(log.path, "ab") as fh:
+            fh.write(b'{"t": "plan", "batch_id": 1, "sta')   # crash mid-append
+        log2 = CommitLog(str(tmp_path))
+        assert log2.planned(1) is None
+        assert log2.last_committed() == 0
+        # the torn bytes are gone from disk, not just skipped in memory
+        with open(log2.path, "rb") as fh:
+            data = fh.read()
+        assert b'"batch_id": 1' not in data
+        assert data.endswith(b'{"t": "commit", "batch_id": 0}\n')
+        log2.close()
+
+    def test_state_snapshots_and_pruning(self, tmp_path):
+        log = CommitLog(str(tmp_path))
+        log.write_state(0, {"ops": [{"n": 1}]})
+        log.write_state(1, {"ops": [{"n": 2}]})
+        assert log.read_state(1) == {"ops": [{"n": 2}]}
+        log.prune_state(keep_from=1)
+        assert log.read_state(0) is None
+        assert log.read_state(1) == {"ops": [{"n": 2}]}
+        log.close()
+
+    def test_compact_keeps_last_committed_plan(self, tmp_path):
+        log = CommitLog(str(tmp_path))
+        for b in range(5):
+            log.plan(b, {"rows": b}, {"rows": b + 1})
+            log.commit(b)
+        dropped = log.compact()
+        assert dropped > 0
+        log.close()
+        log2 = CommitLog(str(tmp_path))
+        assert log2.last_committed() == 4
+        # batch 4's plan survives: its end is the restart start offset
+        assert log2.planned(4) == {"start": {"rows": 4}, "end": {"rows": 5}}
+        assert log2.planned(0) is None
+        log2.close()
+
+
+# --------------------------------------------------------------------------- #
+# sources
+
+
+class TestSources:
+    def test_memory_source_offsets_and_trim(self):
+        src = MemorySource()
+        assert src.get_offset() is None
+        src.add_rows(_tbl(0, 3))
+        end = src.get_offset()
+        assert end == {"rows": 3}
+        assert list(src.get_batch(None, end)["x"]) == [0, 1, 2]
+        src.commit(end)
+        src.add_rows(_tbl(3, 5))
+        end2 = src.get_offset()
+        assert list(src.get_batch(end, end2)["x"]) == [3, 4]
+        with pytest.raises(ValueError, match="trimmed"):
+            src.get_batch(None, end2)         # committed rows are gone
+
+    def test_directory_source_delta_batches(self, tmp_path):
+        d = str(tmp_path / "in")
+        os.makedirs(d)
+        src = DirectorySource(d, "*.csv")
+        assert src.get_offset() is None
+        write_csv(_tbl(0, 2), os.path.join(d, "a-000.csv"))
+        end1 = src.get_offset()
+        assert end1 == {"files": ["a-000.csv"]}
+        assert list(src.get_batch(None, end1)["x"]) == [0, 1]
+        write_csv(_tbl(2, 5), os.path.join(d, "a-001.csv"))
+        end2 = src.get_offset()
+        # only the delta — already-seen files never re-read
+        assert list(src.get_batch(end1, end2)["x"]) == [2, 3, 4]
+        assert src.empty_range(end2, end2)
+        # dot-prefixed temp files are invisible (atomic-writer contract)
+        with open(os.path.join(d, ".tmp-b.csv"), "w") as fh:
+            fh.write("x\n1\n")
+        assert src.get_offset() == end2
+
+    def test_socket_source_lines(self):
+        server = socket.create_server(("127.0.0.1", 0))
+        host, port = server.getsockname()
+
+        def feed():
+            conn, _ = server.accept()
+            conn.sendall(b"alpha\nbeta\ngam")
+            time.sleep(0.05)
+            conn.sendall(b"ma\n")
+            conn.close()
+
+        t = threading.Thread(target=feed, daemon=True)
+        t.start()
+        src = SocketSource(host, port)
+        try:
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                off = src.get_offset()
+                if off and off["rows"] >= 3:
+                    break
+                time.sleep(0.01)
+            batch = src.get_batch(None, {"rows": 3})
+            assert list(batch["value"]) == ["alpha", "beta", "gamma"]
+        finally:
+            src.close()
+            server.close()
+        t.join(timeout=2)
+
+
+# --------------------------------------------------------------------------- #
+# sinks
+
+
+class TestSinks:
+    def test_memory_sink_idempotent(self):
+        sink = MemorySink()
+        sink.add_batch(0, _tbl(0, 2))
+        sink.add_batch(0, _tbl(50, 99))       # replay: dropped
+        sink.add_batch(1, _tbl(2, 3))
+        assert list(sink.table()["x"]) == [0, 1, 2]
+        assert sink.batch_ids() == [0, 1]
+
+    def test_parquet_sink_idempotent_and_atomic(self, tmp_path):
+        pytest.importorskip("pyarrow")
+        sink = ParquetSink(str(tmp_path))
+        sink.add_batch(0, _tbl(0, 2))
+        sink.add_batch(1, _tbl(2, 4))
+        sink.add_batch(0, _tbl(50, 99))       # replay: existing part wins
+        names = sorted(os.listdir(str(tmp_path)))
+        assert names == ["part-000000000.parquet", "part-000000001.parquet"]
+        assert list(sink.table()["x"]) == [0, 1, 2, 3]
+        sink.add_batch(2, Table({}))          # empty batch: no file
+        assert len(os.listdir(str(tmp_path))) == 2
+
+    def test_foreach_batch_sink(self):
+        seen = []
+        sink = ForeachBatchSink(lambda t, bid: seen.append((bid, t.num_rows)))
+        sink.add_batch(7, _tbl(0, 3))
+        assert seen == [(7, 3)]
+
+
+# --------------------------------------------------------------------------- #
+# stateful operators
+
+
+class TestStatefulOperators:
+    def test_grouped_running_aggregate(self):
+        agg = GroupedAggregator(group_col="k", value_col="v", agg="mean")
+        agg.transform(Table({"k": ["a", "b"], "v": np.array([2.0, 10.0])}))
+        out = agg.transform(Table({"k": ["a"], "v": np.array([4.0])}))
+        assert list(out["k"]) == ["a", "b"]
+        assert list(out["aggregate"]) == [3.0, 10.0]   # running mean
+
+    def test_grouped_state_doc_roundtrip(self):
+        a = GroupedAggregator(group_col="k", agg="count")
+        a.transform(Table({"k": ["x", "x", "y"]}))
+        b = GroupedAggregator(group_col="k", agg="count")
+        b.load_state_doc(json.loads(json.dumps(a.state_doc())))
+        out = b.transform(Table({"k": ["y"]}))
+        assert list(out["aggregate"]) == [2.0, 2.0]
+
+    def test_windowed_watermark_and_late_drop(self):
+        w = WindowedAggregator(time_col="t", window_s=10.0, agg="count",
+                               watermark_delay_s=5.0)
+        out1 = w.transform(Table({"t": np.array([1.0, 2.0, 12.0])}))
+        # watermark = 12 - 5 = 7: no window end (10, 20, ...) passed yet
+        assert out1.num_rows == 0
+        assert w.watermark() == 7.0
+        out2 = w.transform(Table({"t": np.array([16.0, 3.0])}))
+        # 3.0 predates the batch-start watermark (7) -> dropped as late
+        assert w.late_rows_dropped == 1
+        # new watermark 11 >= window [0,10) end -> emitted exactly once
+        assert list(out2["window_start"]) == [0.0]
+        assert list(out2["aggregate"]) == [2.0]
+        out3 = w.transform(Table({"t": np.array([17.0])}))
+        assert 0.0 not in list(out3["window_start"])   # never re-emitted
+
+    def test_windowed_groups_and_flush(self):
+        # delay large enough that no window finalizes before flush()
+        w = WindowedAggregator(time_col="t", window_s=10.0, group_col="g",
+                               value_col="v", agg="sum",
+                               watermark_delay_s=100.0)
+        w.transform(Table({"t": np.array([1.0, 2.0, 11.0]),
+                           "g": ["a", "b", "a"],
+                           "v": np.array([1.0, 2.0, 4.0])}))
+        rest = w.flush()
+        got = {(s, g): v for s, g, v in zip(
+            rest["window_start"], rest["g"], rest["aggregate"])}
+        assert got[(0.0, "a")] == 1.0
+        assert got[(0.0, "b")] == 2.0
+        assert got[(10.0, "a")] == 4.0
+        assert w.flush().num_rows == 0        # state evicted
+
+    def test_save_load_mid_stream(self, tmp_path):
+        w = WindowedAggregator(time_col="t", window_s=10.0, agg="count",
+                               watermark_delay_s=0.0)
+        w.transform(Table({"t": np.array([1.0, 15.0])}))
+        w.save(str(tmp_path / "w"))
+        from mmlspark_tpu.core.pipeline import PipelineStage
+
+        w2 = PipelineStage.load(str(tmp_path / "w"))
+        o1 = w.transform(Table({"t": np.array([25.0])}))
+        o2 = w2.transform(Table({"t": np.array([25.0])}))
+        assert list(o1["window_start"]) == list(o2["window_start"]) == [10.0]
+        assert list(o1["aggregate"]) == list(o2["aggregate"])
+
+
+# --------------------------------------------------------------------------- #
+# the driver
+
+
+class TestStreamingQuery:
+    def test_memory_to_memory_incremental(self):
+        src, sink = MemorySource(), MemorySink()
+        q = StreamingQuery(src, None, sink)
+        src.add_rows(_tbl(0, 3))
+        assert q.process_all_available() == 1
+        assert q.process_all_available() == 0   # no new data, no new batch
+        src.add_rows(_tbl(3, 5))
+        assert q.process_all_available() == 1
+        assert list(sink.table()["x"]) == [0, 1, 2, 3, 4]
+        assert q.batches_processed == 2 and q.rows_processed == 5
+        assert q.last_progress["batch_id"] == 1
+
+    def test_background_trigger_loop(self):
+        src, sink = MemorySource(), MemorySink()
+        q = StreamingQuery(src, None, sink, trigger_interval_s=0.01).start()
+        try:
+            assert q.is_active
+            src.add_rows(_tbl(0, 4))
+            deadline = time.monotonic() + 5
+            while q.batches_processed < 1 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert q.batches_processed >= 1
+        finally:
+            q.stop()
+        assert q.await_termination(1.0)
+        assert list(sink.table()["x"]) == [0, 1, 2, 3]
+
+    def test_stateful_rollback_on_sink_failure(self, tmp_path):
+        """A failed batch must not leak half-folded operator state into the
+        retry — the WAL plan makes the retry identical, so the committed
+        aggregate counts every row exactly once."""
+        agg = GroupedAggregator(group_col="k", agg="count")
+        src = MemorySource()
+
+        class FlakySink(MemorySink):
+            def __init__(self):
+                super().__init__()
+                self.failures_left = 1
+
+            def add_batch(self, batch_id, table):
+                if self.failures_left > 0:
+                    self.failures_left -= 1
+                    raise OSError("sink hiccup")
+                super().add_batch(batch_id, table)
+
+        sink = FlakySink()
+        q = StreamingQuery(src, agg, sink, checkpoint_dir=str(tmp_path))
+        src.add_rows(Table({"k": ["a", "a", "b"]}))
+        with pytest.raises(OSError):
+            q.process_next()
+        assert q.process_next()               # retry of the SAME planned batch
+        out = sink.table()
+        got = dict(zip(out["k"], out["aggregate"]))
+        assert got == {"a": 2.0, "b": 1.0}    # not 4/2: no double-fold
+
+    def test_transform_callable_and_pipeline_stage(self):
+        src, sink = MemorySource(), MemorySink()
+        q = StreamingQuery(src, lambda t: t.with_column("y", t["x"] * 2), sink)
+        src.add_rows(_tbl(0, 3))
+        q.process_all_available()
+        assert list(sink.table()["y"]) == [0, 2, 4]
+
+    def test_checkpoint_restart_skips_committed(self, tmp_path):
+        d = str(tmp_path / "in")
+        os.makedirs(d)
+        write_csv(_tbl(0, 3), os.path.join(d, "f-000.csv"))
+        ck = str(tmp_path / "ck")
+        sink1 = MemorySink()
+        q1 = StreamingQuery(DirectorySource(d, "*.csv"), None, sink1,
+                            checkpoint_dir=ck)
+        assert q1.process_all_available() == 1
+        q1.stop()
+        # restart: committed files are not re-read; only new ones flow
+        write_csv(_tbl(3, 4), os.path.join(d, "f-001.csv"))
+        sink2 = MemorySink()
+        q2 = StreamingQuery(DirectorySource(d, "*.csv"), None, sink2,
+                            checkpoint_dir=ck)
+        assert q2.process_all_available() == 1
+        q2.stop()
+        assert list(sink2.table()["x"]) == [3.0]
+
+    def test_stateful_query_recovers_operator_state(self, tmp_path):
+        d = str(tmp_path / "in")
+        os.makedirs(d)
+        ck = str(tmp_path / "ck")
+        write_csv(Table({"k": ["a", "a"]}), os.path.join(d, "f-000.csv"))
+        agg1 = GroupedAggregator(group_col="k", agg="count")
+        q1 = StreamingQuery(DirectorySource(d, "*.csv"), agg1, MemorySink(),
+                            checkpoint_dir=ck)
+        q1.process_all_available()
+        q1.stop()
+        write_csv(Table({"k": ["a", "b"]}), os.path.join(d, "f-001.csv"))
+        agg2 = GroupedAggregator(group_col="k", agg="count")
+        sink2 = MemorySink()
+        q2 = StreamingQuery(DirectorySource(d, "*.csv"), agg2, sink2,
+                            checkpoint_dir=ck)
+        q2.process_all_available()
+        q2.stop()
+        out = sink2.table()
+        got = dict(zip(out["k"], out["aggregate"]))
+        # "a" counts BOTH files: the restart restored the running state
+        assert got == {"a": 3.0, "b": 1.0}
+
+
+# --------------------------------------------------------------------------- #
+# serving parity
+
+
+def _doubling_handler(batch: Table) -> Table:
+    from mmlspark_tpu.io_http.schema import HTTPResponseData
+
+    replies = [
+        HTTPResponseData(
+            200, "ok", {"Content-Type": "application/json"},
+            json.dumps({"doubled": json.loads(r.entity)["x"] * 2}).encode(),
+        )
+        for r in batch["request"]
+    ]
+    return Table({"id": list(batch["id"]), "reply": replies})
+
+
+def _post(url: str, payload: dict) -> dict:
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+class TestServingSource:
+    def test_requires_batch_mode(self):
+        from mmlspark_tpu.io_http.serving import ServingServer
+
+        srv = ServingServer(lambda t: t)      # continuous mode
+        with pytest.raises(ValueError, match="batch"):
+            ServingSource(srv)
+
+    def test_streaming_query_serves_same_replies_as_micro_batch_path(self):
+        """A ServingSource-backed StreamingQuery answers requests with the
+        byte-same bodies as the existing MicroBatchQuery serving path."""
+        from mmlspark_tpu.io_http import MicroBatchQuery
+        from mmlspark_tpu.io_http.serving import ServingServer
+
+        srv_a = ServingServer(mode="batch").start()
+        srv_b = ServingServer(mode="batch").start()
+        qa = MicroBatchQuery(srv_a, _doubling_handler,
+                             trigger_interval_s=0.01).start()
+        qb = StreamingQuery(ServingSource(srv_b), _doubling_handler,
+                            ReplySink(srv_b),
+                            trigger_interval_s=0.01).start()
+        try:
+            for x in (3, 11, 20):
+                assert _post(srv_a.url, {"x": x}) == _post(srv_b.url, {"x": x})
+            assert qb.batches_processed >= 1
+            assert qb.exception is None
+        finally:
+            qa.stop()
+            qb.stop()
+            srv_a.stop()
+            srv_b.stop()
+
+    def test_serving_offsets_are_pending_ids(self):
+        from mmlspark_tpu.io_http.serving import ServingServer
+
+        srv = ServingServer(mode="batch").start()
+        src = ServingSource(srv)
+        try:
+            assert src.get_offset() is None
+            results: list[dict] = []
+            t = threading.Thread(
+                target=lambda: results.append(_post(srv.url, {"x": 1})),
+                daemon=True)
+            t.start()
+            deadline = time.monotonic() + 5
+            while src.get_offset() is None and time.monotonic() < deadline:
+                time.sleep(0.01)
+            end = src.get_offset()
+            assert end is not None and len(end["ids"]) == 1
+            batch = src.get_batch(None, end)
+            assert list(batch["id"]) == end["ids"]
+            ReplySink(srv).add_batch(0, _doubling_handler(batch))
+            t.join(timeout=5)
+            assert results == [{"doubled": 2}]
+        finally:
+            srv.stop()
+
+
+# --------------------------------------------------------------------------- #
+# end-to-end: files -> featurize -> GBDT -> parquet, with kill/restart
+
+
+def _make_training_table(n=80, seed=7):
+    rng = np.random.default_rng(seed)
+    a = rng.normal(size=n)
+    b = rng.normal(size=n)
+    y = 2.0 * a - b + 0.01 * rng.normal(size=n)
+    return Table({"a": a, "b": b, "label": y})
+
+
+def _fit_scoring_pipeline(train: Table):
+    from mmlspark_tpu.core.pipeline import Pipeline
+    from mmlspark_tpu.gbdt.estimators import GBDTRegressor
+    from mmlspark_tpu.ops.featurize import Featurize
+
+    return Pipeline([
+        Featurize(feature_columns={"features": ["a", "b"]}),
+        GBDTRegressor(num_iterations=5, num_leaves=7, label_col="label"),
+    ]).fit(train)
+
+
+@pytest.mark.slow
+class TestEndToEnd:
+    def test_stream_matches_batch_transform(self, tmp_path):
+        """DirectorySource -> Featurize -> GBDT -> ParquetSink over files
+        appended WHILE the query runs equals one batch transform."""
+        pytest.importorskip("pyarrow")
+        train = _make_training_table()
+        model = _fit_scoring_pipeline(train)
+        d = str(tmp_path / "in")
+        os.makedirs(d)
+        out = str(tmp_path / "out")
+        ck = str(tmp_path / "ck")
+        sink = ParquetSink(out)
+        q = StreamingQuery(DirectorySource(d, "*.csv"), model, sink,
+                           checkpoint_dir=ck, trigger_interval_s=0.01).start()
+        rng = np.random.default_rng(11)
+        chunks = []
+        try:
+            for i in range(4):
+                chunk = Table({"a": rng.normal(size=5), "b": rng.normal(size=5),
+                               "label": rng.normal(size=5)})
+                chunks.append(chunk)
+                # atomic appearance: dot-temp then rename into the watch dir
+                tmp = os.path.join(d, f".tmp-{i:03d}.csv")
+                write_csv(chunk, tmp)
+                os.replace(tmp, os.path.join(d, f"chunk-{i:03d}.csv"))
+                time.sleep(0.05)
+            deadline = time.monotonic() + 30
+            while q.rows_processed < 20 and time.monotonic() < deadline:
+                time.sleep(0.02)
+        finally:
+            q.stop()
+        assert q.exception is None
+        whole = chunks[0]
+        for c in chunks[1:]:
+            whole = whole.concat(c)
+        expected = model.transform(whole)
+        got = sink.table()
+        assert got.num_rows == expected.num_rows == 20
+        np.testing.assert_array_equal(
+            np.asarray(got["prediction"]), np.asarray(expected["prediction"]))
+
+    def test_kill_mid_stream_restart_is_exactly_once(self, tmp_path):
+        """SIGKILL the driver process mid-batch, restart from the
+        checkpoint, and the sink's total output is byte-identical to the
+        one-shot batch Pipeline.transform — no duplicates, no gaps."""
+        pytest.importorskip("pyarrow")
+        train = _make_training_table()
+        model = _fit_scoring_pipeline(train)
+        model_dir = str(tmp_path / "model")
+        model.save(model_dir)
+        d = str(tmp_path / "in")
+        os.makedirs(d)
+        out = str(tmp_path / "out")
+        ck = str(tmp_path / "ck")
+        rng = np.random.default_rng(23)
+        chunks = []
+        for i in range(8):
+            chunk = Table({"a": rng.normal(size=4), "b": rng.normal(size=4),
+                           "label": rng.normal(size=4)})
+            chunks.append(chunk)
+            write_csv(chunk, os.path.join(d, f"chunk-{i:03d}.csv"))
+
+        driver = os.path.join(str(tmp_path), "driver.py")
+        with open(driver, "w") as fh:
+            fh.write(
+                "import sys, time\n"
+                "import mmlspark_tpu.gbdt.estimators  # registers stages\n"
+                "import mmlspark_tpu.ops.featurize\n"
+                "from mmlspark_tpu.core.pipeline import PipelineStage\n"
+                "from mmlspark_tpu.streaming import (DirectorySource,\n"
+                "    ParquetSink, StreamingQuery)\n"
+                "model_dir, d, out, ck, slow = sys.argv[1:6]\n"
+                "model = PipelineStage.load(model_dir)\n"
+                "def transform(t):\n"
+                "    o = model.transform(t)\n"
+                "    time.sleep(float(slow))\n"   # widen the kill window
+                "    return o\n"
+                "src = DirectorySource(d, '*.csv', max_files_per_trigger=1)\n"
+                "q = StreamingQuery(src, transform, ParquetSink(out),\n"
+                "                   checkpoint_dir=ck)\n"
+                "q.process_all_available()\n"
+                "print('DONE', q.batches_processed, flush=True)\n")
+
+        from tests.conftest import subprocess_env
+
+        env = subprocess_env()
+        env["JAX_PLATFORMS"] = "cpu"
+        # phase 1: kill while parts are landing (mid-stream, between or
+        # inside a batch — exactly-once must hold wherever it lands)
+        p1 = subprocess.Popen([sys.executable, driver, model_dir, d, out, ck,
+                               "0.3"], env=env, stdout=subprocess.PIPE)
+        try:
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                parts = [n for n in os.listdir(out)
+                         if n.startswith("part-")] if os.path.isdir(out) else []
+                if len(parts) >= 2:
+                    break
+                if p1.poll() is not None:
+                    break
+                time.sleep(0.02)
+            assert p1.poll() is None, "driver finished before it was killed"
+            p1.send_signal(signal.SIGKILL)
+        finally:
+            p1.wait(timeout=30)
+        # phase 2: restart; replays the in-flight batch, drains the rest
+        p2 = subprocess.run([sys.executable, driver, model_dir, d, out, ck,
+                             "0"], env=env, capture_output=True, text=True,
+                            timeout=300)
+        assert p2.returncode == 0, p2.stderr[-2000:]
+        whole = chunks[0]
+        for c in chunks[1:]:
+            whole = whole.concat(c)
+        expected = model.transform(whole)
+        got = ParquetSink(out).table()
+        assert got.num_rows == expected.num_rows    # no duplicates, no gaps
+        np.testing.assert_array_equal(
+            np.asarray(got["prediction"]), np.asarray(expected["prediction"]))
+        np.testing.assert_array_equal(np.asarray(got["a"]),
+                                      np.asarray(expected["a"]))

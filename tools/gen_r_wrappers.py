@@ -24,6 +24,7 @@ Usage: python tools/gen_r_wrappers.py          # rewrites r/mmlsparktpu/
 from __future__ import annotations
 
 import importlib
+import math
 import os
 import re
 import sys
@@ -31,7 +32,8 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 SUBPACKAGES = ("core", "gbdt", "nn", "image", "ops", "text", "automl",
-               "recommendation", "io_http", "plot", "parallel", "utils")
+               "recommendation", "io_http", "plot", "parallel", "streaming",
+               "utils")
 
 R_DIR = os.path.join(os.path.dirname(__file__), "..", "r", "mmlsparktpu")
 
@@ -63,6 +65,12 @@ def r_default(p) -> str | None:
     if isinstance(d, int):
         return f"{d}L"
     if isinstance(d, float):
+        # repr() of a non-finite float is "inf"/"nan" — not valid R. R
+        # spells them Inf/-Inf/NaN (all parse as doubles).
+        if math.isinf(d):
+            return "Inf" if d > 0 else "-Inf"
+        if math.isnan(d):
+            return "NaN"
         return repr(d)
     if isinstance(d, str):
         return r_string(d)
