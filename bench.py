@@ -855,6 +855,69 @@ def bench_serving() -> dict:
             "client_rtt_p99_ms": float(np.percentile(rtt_ms, 99))}
 
 
+def bench_serving_degraded() -> dict:
+    """Continuous-mode serving latency under chaos: ~10% of requests hit a
+    seeded injected-fault burst (FaultInjector 503s) while the server runs
+    the resilience shedding config (bounded queue + per-request deadline).
+    Tracks healthy-path client p50/p99 and the observed error rate — the
+    number that shows load shedding keeps the tail flat when a dependency
+    burns instead of timing every caller out at once."""
+    import http.client
+
+    from mmlspark_tpu.io_http.schema import HTTPResponseData
+    from mmlspark_tpu.io_http.serving import ServingServer
+    from mmlspark_tpu.resilience import FaultInjector
+
+    # ~10% of requests overall: a 7% trigger rate with burst=2 (real
+    # outages are correlated runs, not independent coin flips)
+    fi = FaultInjector(seed=23, status_prob=0.07, status_code=503,
+                       status_burst=2, retry_after_s=0.05)
+    ok = HTTPResponseData(200, "OK",
+                          headers={"Content-Type": "application/json"},
+                          entity=b'{"prediction": 1.0}')
+    injected = HTTPResponseData(503, "injected fault",
+                                headers={"Retry-After": "0.05"}, entity=b"{}")
+
+    def handler(table):
+        return table.with_column(
+            "reply", [injected if fi.decide() == "status" else ok
+                      for _ in range(table.num_rows)])
+
+    srv = ServingServer(handler, max_pending=64,
+                        request_deadline_s=5.0).start()
+    try:
+        body = b'{"f0": 0.5}'
+        conn = http.client.HTTPConnection(srv.host, srv.port, timeout=30)
+
+        def post():
+            conn.request("POST", srv.api_path, body=body,
+                         headers={"Content-Type": "application/json"})
+            r = conn.getresponse()
+            r.read()
+            return r.status
+
+        for _ in range(20):          # warm-up outside the timed window
+            post()
+        statuses, rtt = [], []
+        for _ in range(300):
+            t0 = time.perf_counter()
+            statuses.append(post())
+            rtt.append(time.perf_counter() - t0)
+        conn.close()
+    finally:
+        srv.stop()
+    healthy_ms = np.asarray(
+        [t for t, s in zip(rtt, statuses) if s == 200]) * 1e3
+    return {
+        "p50_ms": float(np.percentile(healthy_ms, 50)),
+        "p99_ms": float(np.percentile(healthy_ms, 99)),
+        "error_rate": sum(1 for s in statuses if s != 200) / len(statuses),
+        "faults_injected": fi.injected["status"],
+        "requests_shed": srv.requests_shed,
+        "requests_expired": srv.requests_expired,
+    }
+
+
 def bench_streaming() -> dict:
     """Micro-batch engine throughput (batches/sec, rows/sec): a fitted GBDT
     model scoring MemorySource batches through StreamingQuery into a
@@ -1057,6 +1120,11 @@ def _run_suite(platform: str) -> dict:
         print(f"bench: serving latency bench failed ({e!r})", file=sys.stderr)
         serving = None
     try:
+        degraded = bench_serving_degraded()
+    except Exception as e:  # noqa: BLE001 — chaos latency is auxiliary
+        print(f"bench: degraded serving bench failed ({e!r})", file=sys.stderr)
+        degraded = None
+    try:
         streaming = bench_streaming()
     except Exception as e:  # noqa: BLE001 — engine overhead is auxiliary
         print(f"bench: streaming bench failed ({e!r})", file=sys.stderr)
@@ -1108,6 +1176,12 @@ def _run_suite(platform: str) -> dict:
                 serving["client_rtt_p50_ms"], 3) if serving else None,
             "serving_client_rtt_p99_ms": round(
                 serving["client_rtt_p99_ms"], 3) if serving else None,
+            "serving_degraded_p50_ms": round(
+                degraded["p50_ms"], 3) if degraded else None,
+            "serving_degraded_p99_ms": round(
+                degraded["p99_ms"], 3) if degraded else None,
+            "serving_degraded_error_rate": round(
+                degraded["error_rate"], 4) if degraded else None,
             **_streaming_extra(streaming),
             "headroom_note": (
                 "gbdt fit is HBM-bound (see gbdt_modeled_hbm_* vs chip peak); "

@@ -5,19 +5,45 @@ exponential backoff and 429 Retry-After handling (:64-105),
 `SingleThreadedHTTPClient` and `AsyncHTTPClient` (sliding window of Futures,
 Clients.scala:102-116 + AsyncUtils.bufferedAwait). Here: urllib on threads;
 the async window is utils.async_utils.buffered_map.
+
+Retry semantics are delegated to resilience.policy.RetryPolicy (one
+implementation for the whole package); the legacy `retries`/`backoff_ms`
+arguments build an equivalent fixed-ladder policy. An optional
+resilience.CircuitBreaker short-circuits a dead endpoint to a synthetic
+503 instead of burning the backoff budget per request.
 """
 
 from __future__ import annotations
 
-import time
 import urllib.error
 import urllib.request
 from typing import Iterable, Sequence
 
+from ..resilience.breaker import CircuitBreaker
+from ..resilience.policy import (RetryPolicy, is_retryable_exception,
+                                 is_retryable_status)
 from ..utils.async_utils import buffered_map
 from .schema import HTTPRequestData, HTTPResponseData
 
 __all__ = ["http_send", "HTTPClient"]
+
+
+def _legacy_policy(retries: int, backoff_ms: Sequence[float]) -> RetryPolicy:
+    """The pre-resilience contract: `retries` total attempts walking the
+    `backoff_ms` ladder (HTTPClients.scala's hard-coded schedule)."""
+    return RetryPolicy(max_retries=max(retries, 1) - 1,
+                       backoffs_ms=list(backoff_ms))
+
+
+def _breaker_open_response(breaker: CircuitBreaker) -> HTTPResponseData:
+    """Synthetic local 503 while the circuit is open — same shape as a
+    server-side overload answer, so error_col/fallback paths need no
+    special case."""
+    return HTTPResponseData(
+        503, f"circuit open: {breaker.name or 'endpoint'}",
+        headers={"Retry-After": f"{breaker.retry_after_s():.3f}"},
+        entity=None,
+    )
 
 
 def http_send(
@@ -25,18 +51,28 @@ def http_send(
     timeout: float = 60.0,
     retries: int = 3,
     backoff_ms: Sequence[int] = (100, 500, 1000),
+    policy: "RetryPolicy | None" = None,
+    breaker: "CircuitBreaker | None" = None,
 ) -> HTTPResponseData:
     """One request with the reference's retry semantics
     (HTTPClients.scala:64-105): retry on 429/5xx/connection errors, honor
-    Retry-After, exponential-ish backoff list."""
+    Retry-After (capped by the policy — an adversarial `Retry-After: 1e9`
+    must not hang the pipeline thread), back off between attempts."""
+    if policy is None:
+        policy = _legacy_policy(retries, backoff_ms)
+    if breaker is not None and not breaker.allow():
+        return _breaker_open_response(breaker)
+    sess = policy.session()
     last_exc: Exception | None = None
-    for attempt in range(max(retries, 1)):
+    while True:
         try:
             r = urllib.request.Request(
                 req.url, data=req.entity, headers=req.headers,
                 method=req.method,
             )
             with urllib.request.urlopen(r, timeout=timeout) as resp:
+                if breaker is not None:
+                    breaker.record_success()
                 return HTTPResponseData(
                     status_code=resp.status,
                     reason=getattr(resp, "reason", "") or "",
@@ -45,27 +81,34 @@ def http_send(
                 )
         except urllib.error.HTTPError as e:
             body = e.read()
-            if e.code == 429 or 500 <= e.code < 600:
-                retry_after = e.headers.get("Retry-After")
-                if attempt + 1 < retries:
-                    if retry_after is not None:
-                        try:
-                            time.sleep(float(retry_after))
-                        except ValueError:
-                            pass
-                    else:
-                        time.sleep(backoff_ms[min(attempt, len(backoff_ms) - 1)] / 1e3)
+            if is_retryable_status(e.code):
+                if breaker is not None:
+                    breaker.record_failure()
+                if sess.should_retry():
+                    retry_after = e.headers.get("Retry-After")
+                    try:
+                        retry_after_s = (float(retry_after)
+                                         if retry_after is not None else None)
+                    except ValueError:
+                        retry_after_s = None
+                    sess.backoff(retry_after_s=retry_after_s)
                     continue
+            elif breaker is not None:
+                # non-retryable 4xx: the endpoint answered — it is healthy
+                breaker.record_success()
             return HTTPResponseData(
                 status_code=e.code, reason=str(e.reason),
                 headers=dict(e.headers), entity=body,
             )
         except Exception as e:  # noqa: BLE001 — connection-level retry
             last_exc = e
-            if attempt + 1 < retries:
-                time.sleep(backoff_ms[min(attempt, len(backoff_ms) - 1)] / 1e3)
+            if breaker is not None:
+                breaker.record_failure()
+            if is_retryable_exception(e) and sess.should_retry():
+                sess.backoff()
                 continue
-    return HTTPResponseData(status_code=0, reason=str(last_exc), entity=None)
+            return HTTPResponseData(
+                status_code=0, reason=str(last_exc), entity=None)
 
 
 class HTTPClient:
@@ -73,13 +116,18 @@ class HTTPClient:
     sliding window; 1 = SingleThreadedHTTPClient."""
 
     def __init__(self, concurrency: int = 1, timeout: float = 60.0,
-                 retries: int = 3):
+                 retries: int = 3, policy: "RetryPolicy | None" = None,
+                 breaker: "CircuitBreaker | None" = None):
         self.concurrency = concurrency
         self.timeout = timeout
         self.retries = retries
+        self.policy = policy
+        self.breaker = breaker
 
     def send_all(self, reqs: Iterable[HTTPRequestData]) -> list[HTTPResponseData]:
-        fn = lambda r: http_send(r, timeout=self.timeout, retries=self.retries)  # noqa: E731
+        fn = lambda r: http_send(  # noqa: E731
+            r, timeout=self.timeout, retries=self.retries,
+            policy=self.policy, breaker=self.breaker)
         if self.concurrency <= 1:
             return [fn(r) for r in reqs]
         return list(buffered_map(fn, list(reqs), self.concurrency))

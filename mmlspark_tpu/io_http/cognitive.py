@@ -22,6 +22,7 @@ from ..core.params import HasOutputCol, Param, ServiceParam
 from ..core.pipeline import Transformer
 from ..core.schema import Table
 from ..core.serialize import register_stage
+from ..resilience.policy import SYSTEM_CLOCK
 from .clients import HTTPClient
 from .schema import HTTPRequestData, HTTPResponseData
 
@@ -57,8 +58,15 @@ class CognitiveServiceBase(HasOutputCol, Transformer):
     error_col = Param(None, "error column (None = raise)", ptype=str)
     concurrency = Param(1, "in-flight requests", ptype=int)
     timeout = Param(60.0, "request timeout (s)", ptype=float)
+    retries = Param(3, "retry attempts (429/5xx/conn)", ptype=int)
 
     handler: Callable | None = None  # test hook: request -> HTTPResponseData
+    # optional resilience wiring (runtime attrs, not serialized): an open
+    # breaker answers synthetic 503s locally, which flow into error_col
+    # (or the raise path) like any other service failure
+    retry_policy = None
+    breaker = None
+    clock = SYSTEM_CLOCK                 # paces async-poll waits; injectable
 
     # subclasses build the per-row request body
     def _row_body(self, row_vals: dict[str, Any], i: int) -> Any:
@@ -85,18 +93,45 @@ class CognitiveServiceBase(HasOutputCol, Transformer):
             self.get("url"), self._row_body(row_vals, i), headers=self._headers()
         )
 
+    def _guarded_handler(self, req: HTTPRequestData) -> HTTPResponseData:
+        """The handler hook routed through the breaker, mirroring what
+        http_send does for real traffic: open circuit answers a local 503
+        (which flows to error_col), outcomes feed the rolling window."""
+        from ..resilience.policy import is_retryable_status
+        from .clients import _breaker_open_response
+
+        if self.breaker is None:
+            return self.handler(req)
+        if not self.breaker.allow():
+            return _breaker_open_response(self.breaker)
+        try:
+            r = self.handler(req)
+        except Exception:
+            self.breaker.record_failure()
+            raise
+        if isinstance(r, HTTPResponseData) and \
+                is_retryable_status(r.status_code):
+            self.breaker.record_failure()
+        else:
+            self.breaker.record_success()
+        return r
+
     def _send_one(self, req: HTTPRequestData) -> HTTPResponseData:
         if self.handler is not None:
-            return self.handler(req)
+            return self._guarded_handler(req)
         from .clients import http_send
 
-        return http_send(req, timeout=self.get("timeout"))
+        return http_send(req, timeout=self.get("timeout"),
+                         retries=self.get("retries"),
+                         policy=self.retry_policy, breaker=self.breaker)
 
     def _exchange(self, reqs: list[HTTPRequestData]) -> list[HTTPResponseData]:
         if self.handler is not None:
-            return [self.handler(r) for r in reqs]
+            return [self._guarded_handler(r) for r in reqs]
         client = HTTPClient(concurrency=self.get("concurrency"),
-                            timeout=self.get("timeout"))
+                            timeout=self.get("timeout"),
+                            retries=self.get("retries"),
+                            policy=self.retry_policy, breaker=self.breaker)
         return client.send_all(reqs)
 
     def _transform(self, table: Table) -> Table:
@@ -238,8 +273,6 @@ class _AsyncPollBase(_VisionBase):
     max_polls = Param(300, "poll attempts before giving up", ptype=int)
 
     def _poll_operation(self, resp: HTTPResponseData) -> HTTPResponseData:
-        import time as _time
-
         if not (isinstance(resp, HTTPResponseData) and resp.status_code == 202):
             return resp
         loc = resp.headers.get("Operation-Location") or resp.headers.get(
@@ -260,7 +293,7 @@ class _AsyncPollBase(_VisionBase):
                                         dict(r.headers), r.entity)
             if status not in ("Running", "NotStarted", ""):
                 return r
-            _time.sleep(self.get("poll_interval_s"))
+            self.clock.sleep(self.get("poll_interval_s"))
         return HTTPResponseData(504, "poll limit reached")
 
     def _exchange(self, reqs):

@@ -20,9 +20,10 @@ from __future__ import annotations
 
 import socket
 import subprocess
-import time
 from dataclasses import dataclass, field
 from typing import Callable, Sequence
+
+from ..resilience.policy import Clock, SYSTEM_CLOCK
 
 __all__ = ["ForwardingOptions", "PortForward", "build_ssh_command",
            "establish_forward", "get_local_ip"]
@@ -144,6 +145,7 @@ def establish_forward(
     local_host: str = "127.0.0.1",
     launcher: Callable[[Sequence[str]], object] = _default_launcher,
     settle_s: float | None = None,
+    clock: "Clock | None" = None,
 ) -> PortForward:
     """Scan remote listen ports from `remote_port_start` (default: the
     local port), launching one reverse-forward attempt per candidate,
@@ -159,6 +161,8 @@ def establish_forward(
     hence the default of connect_timeout_s + settle_margin_s. Pass an
     explicit settle_s (or tune the margin in ForwardingOptions) only when
     the gateway's connect+auth latency is known."""
+    if clock is None:
+        clock = SYSTEM_CLOCK
     if settle_s is None:
         settle_s = opts.connect_timeout_s + opts.settle_margin_s
     start = (opts.remote_port_start
@@ -167,13 +171,13 @@ def establish_forward(
         remote_port = start + attempt
         proc = launcher(build_ssh_command(
             opts, remote_port, local_host, local_port))
-        deadline = time.monotonic() + settle_s
+        deadline = clock.monotonic() + settle_s
         failed = False
-        while time.monotonic() < deadline:
+        while clock.monotonic() < deadline:
             if proc.poll() is not None:
                 failed = True
                 break
-            time.sleep(0.05)
+            clock.sleep(0.05)
         if not failed:
             return PortForward(
                 remote_host=opts.ssh_host, remote_port=remote_port,

@@ -57,6 +57,8 @@ class _Exchange:
     event: threading.Event = field(default_factory=threading.Event)
     response: HTTPResponseData | None = None
     enqueued_at: float = 0.0
+    # absolute perf_counter deadline (request_deadline_s); None = no deadline
+    deadline: float | None = None
 
 
 class SingleSegmentHandler(BaseHTTPRequestHandler):
@@ -94,6 +96,9 @@ class ServingServer:
         api_path: str = "/",
         mode: str = "continuous",
         checkpoint_dir: str | None = None,
+        max_pending: int = 0,
+        request_deadline_s: float | None = None,
+        drain_timeout_s: float = 5.0,
     ):
         if mode not in ("continuous", "batch"):
             raise ValueError(f"mode must be 'continuous' or 'batch', got {mode!r}")
@@ -111,6 +116,15 @@ class ServingServer:
         self.max_batch_size = max_batch_size
         self.max_latency_ms = max_latency_ms
         self.reply_timeout_s = reply_timeout_s
+        # load shedding: an overloaded server must answer 503 + Retry-After
+        # immediately instead of queueing without bound (and timing every
+        # caller out at once later). max_pending=0 keeps the historical
+        # unbounded-queue behavior.
+        self.max_pending = max_pending
+        # per-request deadline: past it the request answers 504 WITHOUT
+        # being scored — an expired exchange must not occupy a batch slot
+        self.request_deadline_s = request_deadline_s
+        self.drain_timeout_s = drain_timeout_s
         self.api_path = api_path
         # "continuous": batcher thread drains the queue and replies directly
         # (HTTPSourceV2.scala:336-474). "batch": the micro-batch engine is the
@@ -142,6 +156,9 @@ class ServingServer:
         # ThreadingHTTPServer handler threads, so guarded by a lock
         self.requests_seen = 0
         self.requests_answered = 0
+        self.requests_shed = 0      # refused with 503 (overload / draining)
+        self.requests_expired = 0   # answered 504 past their deadline
+        self._draining = False
         self._counter_lock = threading.Lock()
         # rolling service latencies (seconds, enqueue -> reply written)
         self._latencies: collections.deque[float] = collections.deque(maxlen=8192)
@@ -191,10 +208,29 @@ class ServingServer:
                     return
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length) if length else b""
+                # admission control BEFORE parking: draining servers and
+                # full queues shed with 503 + Retry-After (the bounded-
+                # queue contract) instead of queueing without bound and
+                # timing everyone out later. The body was already read so
+                # the keep-alive stream stays framed.
+                if outer._draining or (
+                        outer.max_pending and
+                        outer._load() >= outer.max_pending):
+                    with outer._counter_lock:
+                        outer.requests_shed += 1
+                    self.send_response(503)
+                    self.send_header("Retry-After", "1")
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                now = time.perf_counter()
                 ex = _Exchange(HTTPRequestData(
                     method="POST", url=self.path,
                     headers=dict(self.headers), entity=body,
-                ), enqueued_at=time.perf_counter())
+                ), enqueued_at=now,
+                    deadline=(now + outer.request_deadline_s
+                              if outer.request_deadline_s is not None
+                              else None))
                 ex_id = None
                 if outer.mode == "batch":
                     ex_id = str(next(outer._id_counter))
@@ -206,7 +242,10 @@ class ServingServer:
                         outer._pending[ex_id] = ex
                 else:
                     outer._queue.put(ex)
-                if not ex.event.wait(outer.reply_timeout_s):
+                wait_s = outer.reply_timeout_s
+                if outer.request_deadline_s is not None:
+                    wait_s = min(wait_s, outer.request_deadline_s)
+                if not ex.event.wait(wait_s):
                     if ex_id is not None and outer.journal is None:
                         # dead client: stop re-serving it via get_batch().
                         # With a journal the request is DATA in the stream
@@ -215,6 +254,8 @@ class ServingServer:
                         # connection gets a 504.
                         with outer._counter_lock:
                             outer._pending.pop(ex_id, None)
+                    with outer._counter_lock:
+                        outer.requests_expired += 1
                     self.send_response(504)
                     self.send_header("Content-Length", "0")
                     self.end_headers()
@@ -245,6 +286,8 @@ class ServingServer:
                     "mode": outer.mode,
                     "seen": outer.requests_seen,
                     "answered": outer.requests_answered,
+                    "shed": outer.requests_shed,
+                    "expired": outer.requests_expired,
                     "latency": outer.latency_stats(),
                 }).encode()
                 self.send_response(200)
@@ -267,7 +310,25 @@ class ServingServer:
             self._threads.append(bt)
         return self
 
-    def stop(self) -> None:
+    def _load(self) -> int:
+        """Requests parked and not yet answered — the shed/drain signal."""
+        if self.mode == "batch":
+            with self._counter_lock:
+                return len(self._pending)
+        return self._queue.qsize()
+
+    def stop(self, drain: "bool | None" = None) -> None:
+        """Graceful by default on the continuous path: new requests shed
+        with 503 while the batcher finishes what was already admitted
+        (up to drain_timeout_s), THEN the loops stop — in-flight clients
+        get answers instead of resets. drain=False skips the wait."""
+        self._draining = True
+        if drain is None:
+            drain = self.mode == "continuous"
+        if drain and self.mode == "continuous" and self._server is not None:
+            deadline = time.monotonic() + self.drain_timeout_s
+            while self._load() > 0 and time.monotonic() < deadline:
+                time.sleep(0.005)
         self._stop.set()
         if self._server:
             self._server.shutdown()
@@ -308,6 +369,19 @@ class ServingServer:
         if self.mode != "batch":
             raise RuntimeError("get_batch() is only available in batch mode")
         with self._counter_lock:
+            # journaled requests are stream DATA (accepted = must be
+            # processed) and never expire; without a journal an expired
+            # exchange answers 504 and leaves the replay set
+            if self.request_deadline_s is not None and self.journal is None:
+                now = time.perf_counter()
+                for ex_id in [i for i, ex in self._pending.items()
+                              if ex.deadline is not None
+                              and now > ex.deadline]:
+                    ex = self._pending.pop(ex_id)
+                    ex.response = HTTPResponseData(
+                        504, "deadline exceeded before scoring")
+                    ex.event.set()
+                    self.requests_expired += 1
             ids = list(self._pending)
             if max_rows is not None:
                 ids = ids[:max_rows]
@@ -378,6 +452,24 @@ class ServingServer:
                         timeout=max(deadline - time.monotonic(), 0)))
                 except queue.Empty:
                     break
+            # expired exchanges answer 504 HERE and never occupy a batch
+            # slot — scoring them would waste device time on a reply the
+            # client already gave up on (its wait is capped by the same
+            # deadline)
+            now = time.perf_counter()
+            expired = [ex for ex in batch
+                       if ex.deadline is not None and now > ex.deadline]
+            if expired:
+                with self._counter_lock:
+                    self.requests_expired += len(expired)
+                for ex in expired:
+                    ex.response = HTTPResponseData(
+                        504, "deadline exceeded before scoring")
+                    ex.event.set()
+                batch = [ex for ex in batch
+                         if ex.deadline is None or now <= ex.deadline]
+                if not batch:
+                    continue
             try:
                 table = Table({"request": [ex.request for ex in batch]})
                 out = self.handler(table)

@@ -44,6 +44,11 @@ class HTTPTransformer(HasInputCol, HasOutputCol, Transformer):
     retries = Param(3, "retry attempts (429/5xx/conn)", ptype=int)
 
     handler: Callable | None = None  # test hook: req -> HTTPResponseData
+    # optional resilience overrides (runtime wiring, not serialized):
+    # a RetryPolicy replaces the retries ladder, a CircuitBreaker guards
+    # the endpoint (open circuit -> synthetic 503 responses)
+    retry_policy = None
+    breaker = None
 
     def _transform(self, table: Table) -> Table:
         reqs = table[self.get("input_col")]
@@ -54,6 +59,8 @@ class HTTPTransformer(HasInputCol, HasOutputCol, Transformer):
                 concurrency=self.get("concurrency"),
                 timeout=self.get("timeout"),
                 retries=self.get("retries"),
+                policy=self.retry_policy,
+                breaker=self.breaker,
             )
             resps = client.send_all(list(reqs))
         return table.with_column(self.get("output_col"), resps)
@@ -162,12 +169,15 @@ class SimpleHTTPTransformer(HasInputCol, HasOutputCol, Transformer):
     url = Param(None, "target URL (JSON input parser)", ptype=str)
     concurrency = Param(1, "in-flight requests", ptype=int)
     timeout = Param(60.0, "request timeout (s)", ptype=float)
+    retries = Param(3, "retry attempts (429/5xx/conn)", ptype=int)
     error_col = Param(None, "error-info column (None = raise on HTTP error)", ptype=str)
     flatten_output_field = Param(None, "dotted path into response JSON", ptype=str)
 
     input_parser: Transformer | None = None
     output_parser: Transformer | None = None
     handler: Callable | None = None  # test hook passed to HTTPTransformer
+    retry_policy = None              # forwarded to HTTPTransformer
+    breaker = None
 
     def _transform(self, table: Table) -> Table:
         inp = self.input_parser or JSONInputParser(
@@ -180,8 +190,11 @@ class SimpleHTTPTransformer(HasInputCol, HasOutputCol, Transformer):
         http = HTTPTransformer(
             input_col="__http_request", output_col="__http_response",
             concurrency=self.get("concurrency"), timeout=self.get("timeout"),
+            retries=self.get("retries"),
         )
         http.handler = self.handler
+        http.retry_policy = self.retry_policy
+        http.breaker = self.breaker
         outp = self.output_parser or JSONOutputParser(
             input_col="__http_response", output_col=self.get("output_col"),
             field_path=self.get("flatten_output_field"),

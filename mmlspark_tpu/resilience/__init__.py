@@ -1,0 +1,54 @@
+"""Resilience: retry policies, circuit breakers, fault injection, and
+supervised restarts.
+
+Reference: the retry/backoff semantics live in HTTPClients.scala:64-105
+(429 Retry-After + exponential ladder) and FaultToleranceUtils; the
+reference has no unified subsystem — this package centralizes what our
+port had scattered across io_http/clients.py, utils/async_utils.py and
+io_http/forwarding.py, and adds the pieces a production deployment needs
+on top: per-endpoint circuit breakers, deterministic chaos injection,
+streaming-query supervision, and serving load shedding.
+"""
+
+from .policy import (
+    Clock,
+    FakeClock,
+    RetryBudgetExceeded,
+    RetryPolicy,
+    RetrySession,
+    SYSTEM_CLOCK,
+    SystemClock,
+    is_fatal_exception,
+    is_retryable_exception,
+    is_retryable_status,
+)
+from .breaker import (
+    BreakerRegistry,
+    CircuitBreaker,
+    CircuitBreakerTransformer,
+    CircuitOpenError,
+)
+from .chaos import ChaosError, ChaosTransformer, FaultInjector
+from .supervisor import QuerySupervisor, RestartPolicy
+
+__all__ = [
+    "Clock",
+    "SystemClock",
+    "FakeClock",
+    "SYSTEM_CLOCK",
+    "RetryPolicy",
+    "RetrySession",
+    "RetryBudgetExceeded",
+    "is_retryable_status",
+    "is_retryable_exception",
+    "is_fatal_exception",
+    "CircuitBreaker",
+    "CircuitOpenError",
+    "BreakerRegistry",
+    "CircuitBreakerTransformer",
+    "FaultInjector",
+    "ChaosError",
+    "ChaosTransformer",
+    "QuerySupervisor",
+    "RestartPolicy",
+]

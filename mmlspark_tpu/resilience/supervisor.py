@@ -1,0 +1,172 @@
+"""QuerySupervisor: supervised restarts for streaming queries.
+
+PR 1's StreamingQuery retried a failing batch forever on a fixed
+interval. With the batch retry budget now finite (streaming/query.py), a
+query whose budget runs dry *terminates* with its exception set — and
+this module decides what happens next, playing the role of Spark's
+driver-side query restart loop: restart with backoff while the failure
+looks transient, escalate (state "failed" + on_failure hook) when the
+error is fatal or the restart budget for the rolling window is spent.
+
+Restarting is safe by construction: the WAL makes the planned batch
+replay against its recorded offset range and idempotent sinks drop what
+a pre-crash attempt already wrote, so a supervised query keeps its
+exactly-once guarantee across any number of restarts (the chaos soak
+test in tests/test_resilience.py drives this hard).
+
+The supervisor only needs `start/stop/is_active/exception/
+batches_processed` from the query, so it supervises StreamingQuery or
+anything shaped like it.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, Callable
+
+from .policy import (Clock, RetryPolicy, SYSTEM_CLOCK, is_fatal_exception)
+
+__all__ = ["RestartPolicy", "QuerySupervisor"]
+
+
+class RestartPolicy:
+    """When (and how fast) a died query may be restarted.
+
+    max_restarts   restarts allowed within any rolling `window_s`
+    backoff        RetryPolicy shaping the delay before each restart (the
+                   session resets once a restarted query makes progress,
+                   so a long-lived query doesn't creep toward max_ms)
+    fatal          extra classifier: exception -> bool escalating straight
+                   to failed (stacked on policy.is_fatal_exception)
+    """
+
+    def __init__(
+        self,
+        max_restarts: int = 3,
+        window_s: float = 300.0,
+        backoff: "RetryPolicy | None" = None,
+        fatal: "Callable[[BaseException], bool] | None" = None,
+    ):
+        self.max_restarts = int(max_restarts)
+        self.window_s = float(window_s)
+        self.backoff = backoff if backoff is not None else RetryPolicy(
+            max_retries=max(self.max_restarts, 1),
+            base_ms=100.0, max_ms=30_000.0, seed=0)
+        self.fatal = fatal
+
+    def is_fatal(self, exc: BaseException) -> bool:
+        if self.fatal is not None and self.fatal(exc):
+            return True
+        return is_fatal_exception(exc)
+
+
+class QuerySupervisor:
+    """Monitor thread over one query: restart on transient death, escalate
+    on fatal errors or an exhausted restart budget.
+
+    States: "initialized" -> "running" -> ("stopped" | "failed").
+    on_restart(query, exc, n_restarts) fires before each restart;
+    on_failure(query, exc) fires once on escalation."""
+
+    def __init__(
+        self,
+        query: Any,
+        policy: "RestartPolicy | None" = None,
+        *,
+        on_restart: "Callable | None" = None,
+        on_failure: "Callable | None" = None,
+        poll_interval_s: float = 0.02,
+        clock: Clock = SYSTEM_CLOCK,
+    ):
+        self.query = query
+        self.policy = policy if policy is not None else RestartPolicy()
+        self.on_restart = on_restart
+        self.on_failure = on_failure
+        self.poll_interval_s = poll_interval_s
+        self.clock = clock
+        self.state = "initialized"
+        self.restarts = 0
+        self.last_exception: "BaseException | None" = None
+        self._restart_times: collections.deque[float] = collections.deque()
+        self._stop = threading.Event()
+        self._thread: "threading.Thread | None" = None
+
+    # -- lifecycle ------------------------------------------------------- #
+
+    def start(self) -> "QuerySupervisor":
+        if self._thread is not None and self._thread.is_alive():
+            raise RuntimeError("supervisor is already running")
+        self._stop.clear()
+        self.state = "running"
+        self.query.start()
+        self._thread = threading.Thread(
+            target=self._monitor, name="query-supervisor", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        self.query.stop()
+        if self.state == "running":
+            self.state = "stopped"
+
+    def await_terminal(self, timeout_s: "float | None" = None) -> bool:
+        """Block until the supervisor leaves "running" (or timeout)."""
+        if self._thread is None:
+            return True
+        self._thread.join(timeout_s)
+        return not self._thread.is_alive()
+
+    # -- the monitor loop ------------------------------------------------ #
+
+    def _restart_allowed(self) -> bool:
+        now = self.clock.monotonic()
+        while self._restart_times and \
+                now - self._restart_times[0] > self.policy.window_s:
+            self._restart_times.popleft()
+        return len(self._restart_times) < self.policy.max_restarts
+
+    def _monitor(self) -> None:
+        sess = None
+        batches_at_restart = self.query.batches_processed
+        while not self._stop.is_set():
+            if self.query.is_active:
+                self._stop.wait(self.poll_interval_s)
+                continue
+            if self._stop.is_set():
+                break
+            exc = self.query.exception
+            self.last_exception = exc
+            if exc is None:
+                # clean exit (someone stopped the query directly)
+                self.state = "stopped"
+                return
+            if self.policy.is_fatal(exc) or not self._restart_allowed():
+                self.state = "failed"
+                if self.on_failure is not None:
+                    self.on_failure(self.query, exc)
+                return
+            # progress since the last restart means the previous failure
+            # streak healed: restart the backoff chain
+            if sess is None or \
+                    self.query.batches_processed > batches_at_restart:
+                sess = self.policy.backoff.session()
+            if not sess.should_retry():
+                self.state = "failed"
+                if self.on_failure is not None:
+                    self.on_failure(self.query, exc)
+                return
+            # interruptible backoff: a stop() during the wait wins
+            sess.backoff(wait=self._stop.wait)
+            if self._stop.is_set():
+                break
+            self._restart_times.append(self.clock.monotonic())
+            self.restarts += 1
+            batches_at_restart = self.query.batches_processed
+            if self.on_restart is not None:
+                self.on_restart(self.query, exc, self.restarts)
+            self.query.start()
+        self.state = "stopped"

@@ -30,6 +30,7 @@ import time
 from typing import Any, Callable
 
 from ..core.schema import Table
+from ..resilience.policy import RetryPolicy, is_fatal_exception
 from .checkpoint import CommitLog
 from .sinks import MemorySink, Sink
 from .sources import Source
@@ -72,6 +73,7 @@ class StreamingQuery:
                  checkpoint_dir: "str | None" = None,
                  trigger_interval_s: float = 0.1,
                  compact_every: int = 100,
+                 batch_retry_policy: "RetryPolicy | None" = None,
                  name: str = "query") -> None:
         self.source = source
         self.transform = transform
@@ -79,6 +81,14 @@ class StreamingQuery:
         self.name = name
         self.trigger_interval_s = trigger_interval_s
         self.compact_every = compact_every
+        # finite per-failure-streak retry budget (was: retry forever on a
+        # fixed interval); when it runs dry the query TERMINATES with
+        # `exception` set so a resilience.QuerySupervisor can decide
+        # whether to restart it
+        self.batch_retry_policy = (
+            batch_retry_policy if batch_retry_policy is not None
+            else RetryPolicy(max_retries=3, base_ms=1e3 * trigger_interval_s,
+                             max_ms=30_000.0, seed=0))
         # plain callables aren't walked — a closure owns its own state
         self._ops: list[StatefulOperator] = (
             [s for s in _walk_stages(transform)
@@ -87,6 +97,8 @@ class StreamingQuery:
         self._log = CommitLog(checkpoint_dir) if checkpoint_dir else None
         self._lock = threading.RLock()
         self._stop = threading.Event()
+        self._closed = False
+        self._failed = False
         self._thread: "threading.Thread | None" = None
         self._exception: "BaseException | None" = None
         self._last_end: "dict | None" = None
@@ -195,9 +207,14 @@ class StreamingQuery:
     # -- lifecycle --------------------------------------------------------- #
 
     def start(self) -> "StreamingQuery":
+        if self._closed:
+            raise RuntimeError(
+                f"query {self.name!r} was stopped; build a new query over "
+                "the same checkpoint_dir to resume")
         if self._thread is not None and self._thread.is_alive():
             raise RuntimeError(f"query {self.name!r} is already running")
         self._stop.clear()
+        self._failed = False
         self._thread = threading.Thread(
             target=self._run, name=f"streaming-query-{self.name}",
             daemon=True)
@@ -205,17 +222,39 @@ class StreamingQuery:
         return self
 
     def _run(self) -> None:
+        sess = None
         while not self._stop.is_set():
             try:
-                if not self.process_next():
-                    self._stop.wait(self.trigger_interval_s)
-            except Exception as e:  # noqa: BLE001 — record, back off, retry
+                progressed = self.process_next()
+            except Exception as e:  # noqa: BLE001 — classified below
                 self._exception = e
+                if sess is None:
+                    sess = self.batch_retry_policy.session()
+                if is_fatal_exception(e) or not sess.should_retry():
+                    # budget spent (or the error cannot heal): terminate
+                    # with `exception` set — a QuerySupervisor above takes
+                    # it from here; the WAL plan keeps a later replay exact
+                    self._failed = True
+                    return
+                # interruptible backoff: stop() must not wait it out
+                sess.backoff(wait=self._stop.wait)
+                continue
+            sess = None
+            if progressed:
+                # a recovered query must not look failed forever
+                self._exception = None
+            else:
                 self._stop.wait(self.trigger_interval_s)
 
     @property
     def is_active(self) -> bool:
         return self._thread is not None and self._thread.is_alive()
+
+    @property
+    def failed(self) -> bool:
+        """True when the batch retry budget ran dry (or a fatal error hit)
+        and the query terminated on its own."""
+        return self._failed
 
     @property
     def exception(self) -> "BaseException | None":
@@ -229,9 +268,14 @@ class StreamingQuery:
         return not self._thread.is_alive()
 
     def stop(self) -> None:
+        """Idempotent: signals the loop, joins it, and closes resources
+        exactly once — safe on a never-started or already-stopped query."""
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10)
+        if self._closed:
+            return
+        self._closed = True
         if self._log is not None:
             self._log.close()
         self.source.close()

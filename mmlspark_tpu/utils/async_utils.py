@@ -68,20 +68,24 @@ def retry_with_backoff(
     fn: Callable[[], R],
     backoffs_ms: list[int] | None = None,
     retryable: Callable[[Exception], bool] | None = None,
+    policy=None,
 ) -> R:
     """Run fn with retries (reference HTTPClients.scala:64-105 retry ladder,
-    ModelDownloader FaultToleranceUtils.retryWithTimeout)."""
-    import time
+    ModelDownloader FaultToleranceUtils.retryWithTimeout). The schedule is a
+    resilience.RetryPolicy — pass one for jitter/deadline/fake-clock control;
+    the legacy `backoffs_ms` ladder remains the default contract."""
+    from ..resilience.policy import RetryPolicy
 
-    backoffs = backoffs_ms if backoffs_ms is not None else [100, 500, 1000]
-    last: Exception | None = None
-    for i in range(len(backoffs) + 1):
+    if policy is None:
+        backoffs = backoffs_ms if backoffs_ms is not None else [100, 500, 1000]
+        policy = RetryPolicy(backoffs_ms=backoffs)
+    sess = policy.session()
+    while True:
         try:
             return fn()
         except Exception as e:  # noqa: BLE001
             if retryable is not None and not retryable(e):
                 raise
-            last = e
-            if i < len(backoffs):
-                time.sleep(backoffs[i] / 1000.0)
-    raise RetryError(f"all retries failed: {last}") from last
+            if not sess.should_retry():
+                raise RetryError(f"all retries failed: {e}") from e
+            sess.backoff()

@@ -9,11 +9,12 @@
 #' @param error_col error column (None = raise)
 #' @param concurrency in-flight requests
 #' @param timeout request timeout (s)
+#' @param retries retry attempts (429/5xx/conn)
 #' @param image_url image URL (scalar or column)
 #' @param image_bytes raw image bytes (column)
 #' @param visual_features feature list
 #' @export
-ml_analyze_image <- function(x, output_col = "response", url, subscription_key = NULL, error_col = NULL, concurrency = 1L, timeout = 60.0, image_url = NULL, image_bytes = NULL, visual_features = NULL)
+ml_analyze_image <- function(x, output_col = "response", url, subscription_key = NULL, error_col = NULL, concurrency = 1L, timeout = 60.0, retries = 3L, image_url = NULL, image_bytes = NULL, visual_features = NULL)
 {
   params <- list()
   if (!is.null(output_col)) params$output_col <- as.character(output_col)
@@ -22,6 +23,7 @@ ml_analyze_image <- function(x, output_col = "response", url, subscription_key =
   if (!is.null(error_col)) params$error_col <- as.character(error_col)
   if (!is.null(concurrency)) params$concurrency <- as.integer(concurrency)
   if (!is.null(timeout)) params$timeout <- as.double(timeout)
+  if (!is.null(retries)) params$retries <- as.integer(retries)
   if (!is.null(image_url)) params$image_url <- image_url
   if (!is.null(image_bytes)) params$image_bytes <- image_bytes
   if (!is.null(visual_features)) params$visual_features <- visual_features

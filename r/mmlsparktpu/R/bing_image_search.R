@@ -9,12 +9,13 @@
 #' @param error_col error column (None = raise)
 #' @param concurrency in-flight requests
 #' @param timeout request timeout (s)
+#' @param retries retry attempts (429/5xx/conn)
 #' @param query search query (scalar or column)
 #' @param count results per query
 #' @param offset result offset (paging)
 #' @param market market code, e.g. en-US
 #' @export
-ml_bing_image_search <- function(x, output_col = "response", url, subscription_key = NULL, error_col = NULL, concurrency = 1L, timeout = 60.0, query = NULL, count = 10L, offset = 0L, market = NULL)
+ml_bing_image_search <- function(x, output_col = "response", url, subscription_key = NULL, error_col = NULL, concurrency = 1L, timeout = 60.0, retries = 3L, query = NULL, count = 10L, offset = 0L, market = NULL)
 {
   params <- list()
   if (!is.null(output_col)) params$output_col <- as.character(output_col)
@@ -23,6 +24,7 @@ ml_bing_image_search <- function(x, output_col = "response", url, subscription_k
   if (!is.null(error_col)) params$error_col <- as.character(error_col)
   if (!is.null(concurrency)) params$concurrency <- as.integer(concurrency)
   if (!is.null(timeout)) params$timeout <- as.double(timeout)
+  if (!is.null(retries)) params$retries <- as.integer(retries)
   if (!is.null(query)) params$query <- query
   if (!is.null(count)) params$count <- as.integer(count)
   if (!is.null(offset)) params$offset <- as.integer(offset)

@@ -1,0 +1,21 @@
+#' ChaosTransformer (Transformer)
+#'
+#' Fault-injecting pass-through stage.
+#'
+#' @param x a data.frame or tpu_table
+#' @param seed RNG seed for probabilistic faults
+#' @param exception_prob per-call probability of raising
+#' @param fail_calls explicit call indexes that raise
+#' @param latency_prob per-call probability of added latency
+#' @param latency_ms injected latency per spike (ms)
+#' @export
+ml_chaos_transformer <- function(x, seed = 0L, exception_prob = 0.0, fail_calls = NULL, latency_prob = 0.0, latency_ms = 0.0)
+{
+  params <- list()
+  if (!is.null(seed)) params$seed <- as.integer(seed)
+  if (!is.null(exception_prob)) params$exception_prob <- as.double(exception_prob)
+  if (!is.null(fail_calls)) params$fail_calls <- as.list(fail_calls)
+  if (!is.null(latency_prob)) params$latency_prob <- as.double(latency_prob)
+  if (!is.null(latency_ms)) params$latency_ms <- as.double(latency_ms)
+  .tpu_apply_stage("mmlspark_tpu.resilience.chaos.ChaosTransformer", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,23 @@
+#' CircuitBreakerTransformer (Transformer)
+#'
+#' Wrap any transformer stage with a circuit breaker.
+#'
+#' @param x a data.frame or tpu_table
+#' @param inner wrapped transformer stage
+#' @param failure_rate_threshold failure rate that opens
+#' @param window rolling outcome window (calls)
+#' @param min_calls outcomes required before opening
+#' @param open_duration_s cool-off before half-open (s)
+#' @param open_mode 'raise' or 'passthrough' while open
+#' @export
+ml_circuit_breaker_transformer <- function(x, inner, failure_rate_threshold = 0.5, window = 8L, min_calls = 4L, open_duration_s = 30.0, open_mode = "raise")
+{
+  params <- list()
+  if (!is.null(inner)) params$inner <- inner
+  if (!is.null(failure_rate_threshold)) params$failure_rate_threshold <- as.double(failure_rate_threshold)
+  if (!is.null(window)) params$window <- as.integer(window)
+  if (!is.null(min_calls)) params$min_calls <- as.integer(min_calls)
+  if (!is.null(open_duration_s)) params$open_duration_s <- as.double(open_duration_s)
+  if (!is.null(open_mode)) params$open_mode <- as.character(open_mode)
+  .tpu_apply_stage("mmlspark_tpu.resilience.breaker.CircuitBreakerTransformer", params, x, is_estimator = FALSE)
+}

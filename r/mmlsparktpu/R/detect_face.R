@@ -9,12 +9,13 @@
 #' @param error_col error column (None = raise)
 #' @param concurrency in-flight requests
 #' @param timeout request timeout (s)
+#' @param retries retry attempts (429/5xx/conn)
 #' @param image_url image URL (scalar or column)
 #' @param image_bytes raw image bytes (column)
 #' @param return_face_landmarks include landmarks
 #' @param return_face_attributes attribute list
 #' @export
-ml_detect_face <- function(x, output_col = "response", url, subscription_key = NULL, error_col = NULL, concurrency = 1L, timeout = 60.0, image_url = NULL, image_bytes = NULL, return_face_landmarks = FALSE, return_face_attributes = NULL)
+ml_detect_face <- function(x, output_col = "response", url, subscription_key = NULL, error_col = NULL, concurrency = 1L, timeout = 60.0, retries = 3L, image_url = NULL, image_bytes = NULL, return_face_landmarks = FALSE, return_face_attributes = NULL)
 {
   params <- list()
   if (!is.null(output_col)) params$output_col <- as.character(output_col)
@@ -23,6 +24,7 @@ ml_detect_face <- function(x, output_col = "response", url, subscription_key = N
   if (!is.null(error_col)) params$error_col <- as.character(error_col)
   if (!is.null(concurrency)) params$concurrency <- as.integer(concurrency)
   if (!is.null(timeout)) params$timeout <- as.double(timeout)
+  if (!is.null(retries)) params$retries <- as.integer(retries)
   if (!is.null(image_url)) params$image_url <- image_url
   if (!is.null(image_bytes)) params$image_bytes <- image_bytes
   if (!is.null(return_face_landmarks)) params$return_face_landmarks <- as.logical(return_face_landmarks)

@@ -9,12 +9,13 @@
 #' @param error_col error column (None = raise)
 #' @param concurrency in-flight requests
 #' @param timeout request timeout (s)
+#' @param retries retry attempts (429/5xx/conn)
 #' @param face_id query face id (scalar or column)
 #' @param face_ids candidate face id list (scalar or column)
 #' @param max_candidates max matches returned
 #' @param mode matchPerson | matchFace
 #' @export
-ml_find_similar_face <- function(x, output_col = "response", url, subscription_key = NULL, error_col = NULL, concurrency = 1L, timeout = 60.0, face_id = NULL, face_ids = NULL, max_candidates = 20L, mode = "matchPerson")
+ml_find_similar_face <- function(x, output_col = "response", url, subscription_key = NULL, error_col = NULL, concurrency = 1L, timeout = 60.0, retries = 3L, face_id = NULL, face_ids = NULL, max_candidates = 20L, mode = "matchPerson")
 {
   params <- list()
   if (!is.null(output_col)) params$output_col <- as.character(output_col)
@@ -23,6 +24,7 @@ ml_find_similar_face <- function(x, output_col = "response", url, subscription_k
   if (!is.null(error_col)) params$error_col <- as.character(error_col)
   if (!is.null(concurrency)) params$concurrency <- as.integer(concurrency)
   if (!is.null(timeout)) params$timeout <- as.double(timeout)
+  if (!is.null(retries)) params$retries <- as.integer(retries)
   if (!is.null(face_id)) params$face_id <- face_id
   if (!is.null(face_ids)) params$face_ids <- face_ids
   if (!is.null(max_candidates)) params$max_candidates <- as.integer(max_candidates)

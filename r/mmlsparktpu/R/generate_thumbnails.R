@@ -9,13 +9,14 @@
 #' @param error_col error column (None = raise)
 #' @param concurrency in-flight requests
 #' @param timeout request timeout (s)
+#' @param retries retry attempts (429/5xx/conn)
 #' @param image_url image URL (scalar or column)
 #' @param image_bytes raw image bytes (column)
 #' @param width thumbnail width (px)
 #' @param height thumbnail height (px)
 #' @param smart_cropping center on the region of interest
 #' @export
-ml_generate_thumbnails <- function(x, output_col = "response", url, subscription_key = NULL, error_col = NULL, concurrency = 1L, timeout = 60.0, image_url = NULL, image_bytes = NULL, width = 64L, height = 64L, smart_cropping = TRUE)
+ml_generate_thumbnails <- function(x, output_col = "response", url, subscription_key = NULL, error_col = NULL, concurrency = 1L, timeout = 60.0, retries = 3L, image_url = NULL, image_bytes = NULL, width = 64L, height = 64L, smart_cropping = TRUE)
 {
   params <- list()
   if (!is.null(output_col)) params$output_col <- as.character(output_col)
@@ -24,6 +25,7 @@ ml_generate_thumbnails <- function(x, output_col = "response", url, subscription
   if (!is.null(error_col)) params$error_col <- as.character(error_col)
   if (!is.null(concurrency)) params$concurrency <- as.integer(concurrency)
   if (!is.null(timeout)) params$timeout <- as.double(timeout)
+  if (!is.null(retries)) params$retries <- as.integer(retries)
   if (!is.null(image_url)) params$image_url <- image_url
   if (!is.null(image_bytes)) params$image_bytes <- image_bytes
   if (!is.null(width)) params$width <- as.integer(width)

@@ -9,9 +9,10 @@
 #' @param error_col error column (None = raise)
 #' @param concurrency in-flight requests
 #' @param timeout request timeout (s)
+#' @param retries retry attempts (429/5xx/conn)
 #' @param face_ids face id list (scalar or column)
 #' @export
-ml_group_faces <- function(x, output_col = "response", url, subscription_key = NULL, error_col = NULL, concurrency = 1L, timeout = 60.0, face_ids = NULL)
+ml_group_faces <- function(x, output_col = "response", url, subscription_key = NULL, error_col = NULL, concurrency = 1L, timeout = 60.0, retries = 3L, face_ids = NULL)
 {
   params <- list()
   if (!is.null(output_col)) params$output_col <- as.character(output_col)
@@ -20,6 +21,7 @@ ml_group_faces <- function(x, output_col = "response", url, subscription_key = N
   if (!is.null(error_col)) params$error_col <- as.character(error_col)
   if (!is.null(concurrency)) params$concurrency <- as.integer(concurrency)
   if (!is.null(timeout)) params$timeout <- as.double(timeout)
+  if (!is.null(retries)) params$retries <- as.integer(retries)
   if (!is.null(face_ids)) params$face_ids <- face_ids
   .tpu_apply_stage("mmlspark_tpu.io_http.cognitive.GroupFaces", params, x, is_estimator = FALSE)
 }

@@ -9,12 +9,13 @@
 #' @param error_col error column (None = raise)
 #' @param concurrency in-flight requests
 #' @param timeout request timeout (s)
+#' @param retries retry attempts (429/5xx/conn)
 #' @param person_group_id person group id (scalar or column)
 #' @param face_ids face id list (scalar or column)
 #' @param max_candidates candidates per face
 #' @param confidence_threshold identification confidence floor
 #' @export
-ml_identify_faces <- function(x, output_col = "response", url, subscription_key = NULL, error_col = NULL, concurrency = 1L, timeout = 60.0, person_group_id = NULL, face_ids = NULL, max_candidates = 1L, confidence_threshold = NULL)
+ml_identify_faces <- function(x, output_col = "response", url, subscription_key = NULL, error_col = NULL, concurrency = 1L, timeout = 60.0, retries = 3L, person_group_id = NULL, face_ids = NULL, max_candidates = 1L, confidence_threshold = NULL)
 {
   params <- list()
   if (!is.null(output_col)) params$output_col <- as.character(output_col)
@@ -23,6 +24,7 @@ ml_identify_faces <- function(x, output_col = "response", url, subscription_key 
   if (!is.null(error_col)) params$error_col <- as.character(error_col)
   if (!is.null(concurrency)) params$concurrency <- as.integer(concurrency)
   if (!is.null(timeout)) params$timeout <- as.double(timeout)
+  if (!is.null(retries)) params$retries <- as.integer(retries)
   if (!is.null(person_group_id)) params$person_group_id <- person_group_id
   if (!is.null(face_ids)) params$face_ids <- face_ids
   if (!is.null(max_candidates)) params$max_candidates <- as.integer(max_candidates)

@@ -9,10 +9,11 @@
 #' @param error_col error column (None = raise)
 #' @param concurrency in-flight requests
 #' @param timeout request timeout (s)
+#' @param retries retry attempts (429/5xx/conn)
 #' @param text text to analyze (scalar or column)
 #' @param language language hint
 #' @export
-ml_language_detector <- function(x, output_col = "response", url, subscription_key = NULL, error_col = NULL, concurrency = 1L, timeout = 60.0, text = NULL, language = "en")
+ml_language_detector <- function(x, output_col = "response", url, subscription_key = NULL, error_col = NULL, concurrency = 1L, timeout = 60.0, retries = 3L, text = NULL, language = "en")
 {
   params <- list()
   if (!is.null(output_col)) params$output_col <- as.character(output_col)
@@ -21,6 +22,7 @@ ml_language_detector <- function(x, output_col = "response", url, subscription_k
   if (!is.null(error_col)) params$error_col <- as.character(error_col)
   if (!is.null(concurrency)) params$concurrency <- as.integer(concurrency)
   if (!is.null(timeout)) params$timeout <- as.double(timeout)
+  if (!is.null(retries)) params$retries <- as.integer(retries)
   if (!is.null(text)) params$text <- text
   if (!is.null(language)) params$language <- language
   .tpu_apply_stage("mmlspark_tpu.io_http.cognitive.LanguageDetector", params, x, is_estimator = FALSE)

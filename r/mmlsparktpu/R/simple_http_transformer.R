@@ -8,10 +8,11 @@
 #' @param url target URL (JSON input parser)
 #' @param concurrency in-flight requests
 #' @param timeout request timeout (s)
+#' @param retries retry attempts (429/5xx/conn)
 #' @param error_col error-info column (None = raise on HTTP error)
 #' @param flatten_output_field dotted path into response JSON
 #' @export
-ml_simple_http_transformer <- function(x, output_col = "output", input_col = "input", url = NULL, concurrency = 1L, timeout = 60.0, error_col = NULL, flatten_output_field = NULL)
+ml_simple_http_transformer <- function(x, output_col = "output", input_col = "input", url = NULL, concurrency = 1L, timeout = 60.0, retries = 3L, error_col = NULL, flatten_output_field = NULL)
 {
   params <- list()
   if (!is.null(output_col)) params$output_col <- as.character(output_col)
@@ -19,6 +20,7 @@ ml_simple_http_transformer <- function(x, output_col = "output", input_col = "in
   if (!is.null(url)) params$url <- as.character(url)
   if (!is.null(concurrency)) params$concurrency <- as.integer(concurrency)
   if (!is.null(timeout)) params$timeout <- as.double(timeout)
+  if (!is.null(retries)) params$retries <- as.integer(retries)
   if (!is.null(error_col)) params$error_col <- as.character(error_col)
   if (!is.null(flatten_output_field)) params$flatten_output_field <- as.character(flatten_output_field)
   .tpu_apply_stage("mmlspark_tpu.io_http.transformer.SimpleHTTPTransformer", params, x, is_estimator = FALSE)

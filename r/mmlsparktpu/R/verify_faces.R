@@ -9,10 +9,11 @@
 #' @param error_col error column (None = raise)
 #' @param concurrency in-flight requests
 #' @param timeout request timeout (s)
+#' @param retries retry attempts (429/5xx/conn)
 #' @param face_id1 first face id (scalar or column)
 #' @param face_id2 second face id (scalar or column)
 #' @export
-ml_verify_faces <- function(x, output_col = "response", url, subscription_key = NULL, error_col = NULL, concurrency = 1L, timeout = 60.0, face_id1 = NULL, face_id2 = NULL)
+ml_verify_faces <- function(x, output_col = "response", url, subscription_key = NULL, error_col = NULL, concurrency = 1L, timeout = 60.0, retries = 3L, face_id1 = NULL, face_id2 = NULL)
 {
   params <- list()
   if (!is.null(output_col)) params$output_col <- as.character(output_col)
@@ -21,6 +22,7 @@ ml_verify_faces <- function(x, output_col = "response", url, subscription_key = 
   if (!is.null(error_col)) params$error_col <- as.character(error_col)
   if (!is.null(concurrency)) params$concurrency <- as.integer(concurrency)
   if (!is.null(timeout)) params$timeout <- as.double(timeout)
+  if (!is.null(retries)) params$retries <- as.integer(retries)
   if (!is.null(face_id1)) params$face_id1 <- face_id1
   if (!is.null(face_id2)) params$face_id2 <- face_id2
   .tpu_apply_stage("mmlspark_tpu.io_http.cognitive.VerifyFaces", params, x, is_estimator = FALSE)

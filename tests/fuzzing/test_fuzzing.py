@@ -26,7 +26,7 @@ def _import_all_submodules() -> None:
     """Populate the registry the way JarLoadingUtils reflection does."""
     for pkg_name in ["core", "ops", "gbdt", "nn", "image", "text", "automl",
                      "recommendation", "io_http", "parallel", "streaming",
-                     "utils"]:
+                     "resilience", "utils"]:
         pkg = importlib.import_module(f"mmlspark_tpu.{pkg_name}")
         for mod in pkgutil.iter_modules(pkg.__path__):
             importlib.import_module(f"mmlspark_tpu.{pkg_name}.{mod.name}")

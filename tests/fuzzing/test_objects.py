@@ -768,6 +768,27 @@ def _streaming_objects(ctx) -> dict[str, list[TestObject]]:
     }
 
 
+def _resilience_objects(ctx) -> dict[str, list[TestObject]]:
+    from mmlspark_tpu.ops.stages import DropColumns
+    from mmlspark_tpu.resilience import (ChaosTransformer,
+                                         CircuitBreakerTransformer)
+
+    ab = Table({"a": np.arange(6.0), "b": np.arange(6.0) * 2})
+    return {
+        # seed fixed, no probabilistic faults: the fuzz transform must be
+        # deterministic (save/load roundtrips compare outputs)
+        "mmlspark_tpu.resilience.chaos.ChaosTransformer": [TestObject(
+            ChaosTransformer(seed=7), transform_table=ab,
+        )],
+        "mmlspark_tpu.resilience.breaker.CircuitBreakerTransformer": [
+            TestObject(
+                CircuitBreakerTransformer(inner=DropColumns(cols=["b"]),
+                                          min_calls=2),
+                transform_table=ab,
+            )],
+    }
+
+
 BUILDER_GROUPS: list[Callable] = [
     _core_objects,
     _ops_objects,
@@ -779,6 +800,7 @@ BUILDER_GROUPS: list[Callable] = [
     _recommendation_objects,
     _io_http_objects,
     _streaming_objects,
+    _resilience_objects,
 ]
 
 

@@ -1,0 +1,939 @@
+"""Resilience layer: retry policies, breakers, chaos, supervision,
+load shedding.
+
+Everything time-shaped runs on FakeClock / zero-length backoff ladders —
+the whole suite injects 5xx bursts, latency spikes, connection drops and
+crashes without one real-time sleep (the ISSUE's acceptance bar). The
+capstone is the chaos soak: a supervised streaming query under seeded
+faults plus a kill-restart still produces byte-identical exactly-once
+output.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.schema import Table
+from mmlspark_tpu.core.table_io import write_csv
+from mmlspark_tpu.io_http.clients import HTTPClient, http_send
+from mmlspark_tpu.io_http.schema import HTTPRequestData, HTTPResponseData
+from mmlspark_tpu.io_http.serving import ServingServer
+from mmlspark_tpu.resilience import (
+    BreakerRegistry,
+    ChaosError,
+    ChaosTransformer,
+    CircuitBreaker,
+    CircuitBreakerTransformer,
+    CircuitOpenError,
+    FakeClock,
+    FaultInjector,
+    QuerySupervisor,
+    RestartPolicy,
+    RetryBudgetExceeded,
+    RetryPolicy,
+    is_fatal_exception,
+    is_retryable_status,
+)
+from mmlspark_tpu.streaming import DirectorySource, MemorySink, StreamingQuery
+from mmlspark_tpu.utils.async_utils import RetryError, retry_with_backoff
+
+# a ladder of instant retries: the budget shape without the waiting
+INSTANT = dict(backoffs_ms=[0.0, 0.0, 0.0])
+
+
+def _wait_until(cond, timeout_s=10.0, interval_s=0.002):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval_s)
+    return False
+
+
+# --------------------------------------------------------------------- #
+# RetryPolicy
+# --------------------------------------------------------------------- #
+
+
+class TestRetryPolicy:
+    def test_seeded_decorrelated_jitter_is_deterministic(self):
+        def schedule(clock):
+            sess = RetryPolicy(max_retries=6, base_ms=100, seed=11,
+                               clock=clock).session()
+            out = []
+            while sess.should_retry():
+                out.append(sess.backoff())
+            return out
+
+        a, b = schedule(FakeClock()), schedule(FakeClock())
+        assert a == b and len(a) == 6
+        # decorrelated jitter stays within [base, max]
+        assert all(0.1 <= d <= 10.0 for d in a)
+
+    def test_explicit_ladder_replays_legacy_schedule(self):
+        clk = FakeClock()
+        sess = RetryPolicy(backoffs_ms=[100, 500, 1000], clock=clk).session()
+        while sess.should_retry():
+            sess.backoff()
+        assert clk.sleeps == [0.1, 0.5, 1.0]
+
+    def test_total_deadline_budget_stops_and_clips(self):
+        clk = FakeClock()
+        sess = RetryPolicy(max_retries=100, backoffs_ms=[400.0],
+                           total_deadline_ms=1000.0, clock=clk).session()
+        slept = []
+        while sess.should_retry():
+            slept.append(sess.backoff())
+        # 0.4 + 0.4 + clipped 0.2 == exactly the 1s budget, then refusal
+        assert slept == pytest.approx([0.4, 0.4, 0.2])
+        assert clk.monotonic() == pytest.approx(1.0)
+
+    def test_retry_after_wins_but_is_capped(self):
+        clk = FakeClock()
+        sess = RetryPolicy(max_retries=3, backoffs_ms=[50.0],
+                           retry_after_cap_s=2.0, clock=clk).session()
+        assert sess.backoff(retry_after_s=0.25) == 0.25
+        assert sess.backoff(retry_after_s=1e9) == 2.0  # the hang, capped
+
+    def test_call_retries_then_raises_budget_exceeded(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            raise IOError("boom")
+
+        policy = RetryPolicy(max_retries=2, clock=FakeClock(), **INSTANT)
+        with pytest.raises(RetryBudgetExceeded):
+            policy.call(flaky)
+        assert len(calls) == 3  # first try + 2 retries
+
+    def test_call_fails_fast_on_fatal(self):
+        calls = []
+
+        def broken():
+            calls.append(1)
+            raise TypeError("bug, not weather")
+
+        policy = RetryPolicy(max_retries=5, clock=FakeClock(), **INSTANT)
+        with pytest.raises(TypeError):
+            policy.call(broken)
+        assert len(calls) == 1
+
+    def test_classification(self):
+        assert all(is_retryable_status(c) for c in (0, 408, 429, 500, 503, 599))
+        assert not any(is_retryable_status(c) for c in (200, 201, 400, 404))
+        assert is_fatal_exception(ValueError("x"))
+        assert not is_fatal_exception(IOError("x"))
+
+    def test_retry_with_backoff_delegates_to_policy(self):
+        clk = FakeClock()
+        attempts = []
+
+        def fail():
+            attempts.append(1)
+            raise IOError("no")
+
+        with pytest.raises(RetryError):
+            retry_with_backoff(
+                fail, policy=RetryPolicy(backoffs_ms=[10, 20], clock=clk))
+        assert len(attempts) == 3
+        assert clk.sleeps == [0.01, 0.02]
+        # non-retryable classification still propagates the original
+        with pytest.raises(ValueError):
+            retry_with_backoff(
+                lambda: (_ for _ in ()).throw(ValueError("v")),
+                retryable=lambda e: isinstance(e, IOError),
+                policy=RetryPolicy(backoffs_ms=[0], clock=clk))
+
+
+# --------------------------------------------------------------------- #
+# http_send retry matrix (scripted local server, FakeClock — no sleeps)
+# --------------------------------------------------------------------- #
+
+
+@pytest.fixture()
+def script_server():
+    """Server whose per-path response sequence is scripted by the test:
+    script[path] = [(status, headers), ...]; exhausted scripts answer 200."""
+    from mmlspark_tpu.io_http.serving import SingleSegmentHandler
+
+    script: dict[str, list] = {}
+    hits: dict[str, int] = {}
+    lock = threading.Lock()
+
+    class Handler(SingleSegmentHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            self.rfile.read(n)
+            with lock:
+                hits[self.path] = hits.get(self.path, 0) + 1
+                step = script.get(self.path) or []
+                status, headers = step.pop(0) if step else (200, {})
+            body = json.dumps({"path": self.path}).encode()
+            self.send_response(status)
+            for k, v in headers.items():
+                self.send_header(k, str(v))
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield {"url": f"http://127.0.0.1:{srv.server_address[1]}",
+           "script": script, "hits": hits}
+    srv.shutdown()
+    srv.server_close()
+
+
+def _post(url):
+    return HTTPRequestData(method="POST", url=url,
+                           headers={"Content-Type": "application/json"},
+                           entity=b"{}")
+
+
+class TestHttpSendMatrix:
+    def test_429_retry_after_honored_without_real_sleep(self, script_server):
+        clk = FakeClock()
+        script_server["script"]["/ra"] = [
+            (429, {"Retry-After": "7"}), (429, {"Retry-After": "3"})]
+        resp = http_send(
+            _post(script_server["url"] + "/ra"),
+            policy=RetryPolicy(max_retries=3, clock=clk, **INSTANT))
+        assert resp.status_code == 200
+        assert script_server["hits"]["/ra"] == 3
+        assert clk.sleeps == [7.0, 3.0]  # server hint, not the ladder
+
+    def test_unbounded_retry_after_is_capped(self, script_server):
+        # the satellite bug: a server answering `Retry-After: 1e9` used to
+        # park the pipeline thread for 31 years
+        clk = FakeClock()
+        script_server["script"]["/evil"] = [(503, {"Retry-After": "1e9"})]
+        resp = http_send(
+            _post(script_server["url"] + "/evil"),
+            policy=RetryPolicy(max_retries=2, retry_after_cap_s=5.0,
+                               clock=clk, **INSTANT))
+        assert resp.status_code == 200
+        assert clk.sleeps == [5.0]
+
+    def test_5xx_walks_the_backoff_ladder(self, script_server):
+        clk = FakeClock()
+        script_server["script"]["/flaky"] = [(500, {}), (502, {}), (503, {})]
+        resp = http_send(
+            _post(script_server["url"] + "/flaky"),
+            policy=RetryPolicy(backoffs_ms=[100, 500, 1000], clock=clk))
+        assert resp.status_code == 200
+        assert clk.sleeps == [0.1, 0.5, 1.0]
+
+    def test_budget_exhaustion_returns_last_error_response(self, script_server):
+        clk = FakeClock()
+        script_server["script"]["/down"] = [(503, {})] * 10
+        resp = http_send(
+            _post(script_server["url"] + "/down"),
+            policy=RetryPolicy(max_retries=2, clock=clk, **INSTANT))
+        assert resp.status_code == 503
+        assert script_server["hits"]["/down"] == 3
+
+    def test_4xx_never_retries(self, script_server):
+        script_server["script"]["/bad"] = [(404, {})]
+        resp = http_send(
+            _post(script_server["url"] + "/bad"),
+            policy=RetryPolicy(max_retries=5, clock=FakeClock(), **INSTANT))
+        assert resp.status_code == 404
+        assert script_server["hits"]["/bad"] == 1
+
+    def test_connection_error_retries_then_reports_status_zero(self):
+        clk = FakeClock()
+        # a port nothing listens on: every attempt is a connection error
+        resp = http_send(
+            _post("http://127.0.0.1:9/none"), timeout=0.5,
+            policy=RetryPolicy(max_retries=2, clock=clk, **INSTANT))
+        assert resp.status_code == 0
+        assert resp.reason
+        assert len(clk.sleeps) == 2
+
+    def test_legacy_retries_arg_still_shapes_the_budget(self, script_server):
+        # retries=1 == single attempt, the pre-resilience contract
+        script_server["script"]["/once"] = [(503, {})] * 3
+        resp = http_send(_post(script_server["url"] + "/once"), retries=1)
+        assert resp.status_code == 503
+        assert script_server["hits"]["/once"] == 1
+
+    def test_open_breaker_short_circuits_without_network(self, script_server):
+        clk = FakeClock()
+        br = CircuitBreaker(name="svc", min_calls=1, window=4,
+                            failure_rate_threshold=0.5,
+                            open_duration_s=60.0, clock=clk)
+        policy = RetryPolicy(max_retries=0, clock=clk)
+        script_server["script"]["/svc"] = [(500, {})] * 5
+        http_send(_post(script_server["url"] + "/svc"), policy=policy,
+                  breaker=br)
+        assert br.state == "open"
+        hits_before = script_server["hits"]["/svc"]
+        resp = http_send(_post(script_server["url"] + "/svc"), policy=policy,
+                         breaker=br)
+        assert resp.status_code == 503
+        assert "circuit open" in resp.reason
+        assert "Retry-After" in resp.headers
+        assert script_server["hits"]["/svc"] == hits_before  # no network
+
+    def test_http_client_send_all_with_policy(self, script_server):
+        clk = FakeClock()
+        script_server["script"]["/batch"] = [(429, {"Retry-After": "1"})]
+        client = HTTPClient(concurrency=2,
+                            policy=RetryPolicy(max_retries=2, clock=clk,
+                                               **INSTANT))
+        resps = client.send_all(
+            [_post(script_server["url"] + "/batch") for _ in range(4)])
+        assert [r.status_code for r in resps] == [200] * 4
+
+
+# --------------------------------------------------------------------- #
+# CircuitBreaker
+# --------------------------------------------------------------------- #
+
+
+class TestCircuitBreaker:
+    def test_full_cycle_closed_open_half_open_closed(self):
+        clk = FakeClock()
+        br = CircuitBreaker(name="t", failure_rate_threshold=0.5, window=4,
+                            min_calls=4, open_duration_s=10.0, clock=clk)
+        states = [br.state]
+        for _ in range(2):
+            br.record_success()
+        for _ in range(2):
+            br.record_failure()
+        states.append(br.state)          # 2/4 failed == threshold -> open
+        assert not br.allow()
+        assert 0 < br.retry_after_s() <= 10.0
+        clk.advance(10.0)
+        states.append(br.state)          # cool-off elapsed -> half_open
+        assert br.allow()                # the probe
+        assert not br.allow()            # only one probe admitted
+        br.record_success()
+        states.append(br.state)          # probe succeeded -> closed
+        assert states == ["closed", "open", "half_open", "closed"]
+        assert br.allow()
+        assert br.times_opened == 1
+
+    def test_half_open_failure_reopens(self):
+        clk = FakeClock()
+        br = CircuitBreaker(min_calls=2, window=2, open_duration_s=5.0,
+                            clock=clk)
+        br.record_failure()
+        br.record_failure()
+        assert br.state == "open"
+        clk.advance(5.0)
+        assert br.allow()
+        br.record_failure()
+        assert br.state == "open"
+        assert not br.allow()
+        assert br.times_opened == 2
+
+    def test_below_min_calls_never_opens(self):
+        br = CircuitBreaker(min_calls=10, window=20, clock=FakeClock())
+        for _ in range(9):
+            br.record_failure()
+        assert br.state == "closed"
+
+    def test_call_wrapper_and_open_error(self):
+        clk = FakeClock()
+        br = CircuitBreaker(name="dep", min_calls=2, window=2,
+                            open_duration_s=3.0, clock=clk)
+        for _ in range(2):
+            with pytest.raises(IOError):
+                br.call(lambda: (_ for _ in ()).throw(IOError("x")))
+        with pytest.raises(CircuitOpenError) as ei:
+            br.call(lambda: "unreached")
+        assert ei.value.retry_after_s == pytest.approx(3.0)
+        assert br.calls_shed == 1
+
+    def test_registry_keys_per_endpoint(self):
+        clk = FakeClock()
+        reg = BreakerRegistry(clock=clk, min_calls=2)
+        a = reg.breaker_for("http://svc-a:8000/score?q=1")
+        a2 = reg.breaker_for("http://svc-a:8000/other")
+        b = reg.breaker_for("http://svc-b:8000/score")
+        assert a is a2 and a is not b
+        a.record_failure(), a.record_failure()
+        assert reg.states() == {"http://svc-a:8000": "open",
+                                "http://svc-b:8000": "closed"}
+
+
+class TestCircuitBreakerTransformer:
+    def _failing_stage(self):
+        from mmlspark_tpu.core.pipeline import Transformer
+
+        class Boom(Transformer):
+            def _transform(self, table):
+                raise IOError("dependency down")
+
+        return Boom()
+
+    def test_open_raises_or_passes_through(self):
+        t = Table({"a": np.arange(3.0)})
+        clk = FakeClock()
+        cb = CircuitBreakerTransformer(inner=self._failing_stage(),
+                                       min_calls=2, window=2,
+                                       open_duration_s=30.0)
+        cb.clock = clk
+        for _ in range(2):
+            with pytest.raises(IOError):
+                cb.transform(t)
+        assert cb.breaker.state == "open"
+        with pytest.raises(CircuitOpenError):
+            cb.transform(t)
+        cb.set(open_mode="passthrough")
+        out = cb.transform(t)           # degraded mode: input untouched
+        assert list(out.columns) == ["a"]
+
+    def test_success_path_and_serialization(self, tmp_path):
+        from mmlspark_tpu.core.serialize import load_stage, save_stage
+        from mmlspark_tpu.ops.stages import DropColumns
+
+        t = Table({"a": np.arange(3.0), "b": np.arange(3.0)})
+        cb = CircuitBreakerTransformer(inner=DropColumns(cols=["b"]),
+                                       min_calls=2)
+        assert cb.transform(t).columns == ["a"]
+        p = str(tmp_path / "cb")
+        save_stage(cb, p)
+        loaded = load_stage(p)
+        assert loaded.transform(t).columns == ["a"]
+        assert loaded.get("min_calls") == 2
+
+
+# --------------------------------------------------------------------- #
+# FaultInjector / ChaosTransformer
+# --------------------------------------------------------------------- #
+
+
+class TestFaultInjector:
+    def test_schedule_is_seed_deterministic(self):
+        kw = dict(status_prob=0.2, drop_prob=0.1, exception_prob=0.1,
+                  status_burst=3)
+        a = FaultInjector(seed=5, **kw)
+        b = FaultInjector(seed=5, **kw)
+        sched = [a.decide() for _ in range(200)]
+        assert sched == [b.decide() for _ in range(200)]
+        assert {"status", "drop", "exception", None} >= set(sched)
+        assert a.injected == b.injected
+
+    def test_status_faults_arrive_in_bursts(self):
+        fi = FaultInjector(seed=1, status_prob=0.15, status_burst=4)
+        sched = [fi.decide() for _ in range(300)]
+        runs, run = [], 0
+        for s in sched:
+            if s == "status":
+                run += 1
+            elif run:
+                runs.append(run)
+                run = 0
+        assert runs and max(runs) >= 4  # bursts, not isolated coin flips
+
+    def test_wrap_send_injects_status_and_latency(self):
+        clk = FakeClock()
+        fi = FaultInjector(seed=2, status_prob=1.0, retry_after_s=9.0,
+                           latency_prob=1.0, latency_s=0.5, clock=clk)
+        send = fi.wrap_send(lambda req: HTTPResponseData(200, "ok"))
+        r = send(_post("http://x/"))
+        assert r.status_code == 503
+        assert r.headers["Retry-After"] == "9.0"
+        assert clk.sleeps == [0.5]      # the spike went to the fake clock
+        assert fi.injected["status"] == 1 and fi.injected["latency"] == 1
+
+    def test_wrap_send_drops_connections(self):
+        fi = FaultInjector(seed=2, drop_prob=1.0)
+        send = fi.wrap_send(lambda req: HTTPResponseData(200, "ok"))
+        with pytest.raises(ConnectionError):
+            send(_post("http://x/"))
+
+    def test_wrap_source_and_sink_raise_on_schedule(self):
+        from mmlspark_tpu.streaming import MemorySource
+
+        fi = FaultInjector(seed=0, exception_prob=1.0)
+        src = fi.wrap_source(MemorySource())
+        src.add_rows(Table({"x": np.arange(2.0)}))  # passthrough attr
+        end = src.get_offset(None)
+        with pytest.raises(ChaosError):
+            src.get_batch(None, end)
+        sink = fi.wrap_sink(MemorySink())
+        with pytest.raises(ChaosError):
+            sink.add_batch(0, Table({"x": np.arange(2.0)}))
+
+    def test_chaos_transformer_fail_calls_pins_exact_batches(self):
+        t = Table({"x": np.arange(3.0)})
+        ct = ChaosTransformer(fail_calls=[1, 2])
+        assert ct.transform(t) is not None            # call 0 passes
+        for _ in range(2):
+            with pytest.raises(ChaosError):
+                ct.transform(t)
+        assert ct.transform(t) is not None            # call 3 passes
+
+
+# --------------------------------------------------------------------- #
+# StreamingQuery lifecycle satellites
+# --------------------------------------------------------------------- #
+
+
+class _ScriptedSink(MemorySink):
+    """MemorySink whose add_batch raises on scripted call indexes."""
+
+    def __init__(self, fail_calls=(), fail_exc=IOError):
+        super().__init__()
+        self.fail_calls = set(fail_calls)
+        self.fail_exc = fail_exc
+        self.calls = 0
+
+    def add_batch(self, batch_id, table):
+        i = self.calls
+        self.calls += 1
+        if i in self.fail_calls:
+            raise self.fail_exc(f"scripted sink failure on call {i}")
+        super().add_batch(batch_id, table)
+
+
+def _dir_query(tmp_path, n_files=3, sink=None, ck=True, **qkw):
+    d = str(tmp_path / "in")
+    os.makedirs(d, exist_ok=True)
+    for i in range(n_files):
+        write_csv(Table({"x": np.arange(i * 10.0, i * 10.0 + 4)}),
+                  os.path.join(d, f"f-{i:03d}.csv"))
+    src = DirectorySource(d, max_files_per_trigger=1)
+    sink = sink if sink is not None else MemorySink()
+    qkw.setdefault("trigger_interval_s", 0.005)
+    qkw.setdefault("batch_retry_policy", RetryPolicy(**INSTANT))
+    if ck:
+        qkw.setdefault("checkpoint_dir", str(tmp_path / "ck"))
+    return StreamingQuery(src, None, sink, **qkw), sink
+
+
+class TestStreamingQueryLifecycle:
+    def test_stop_is_idempotent_and_safe_unstarted(self, tmp_path):
+        q, _ = _dir_query(tmp_path, ck=False)
+        q.stop()   # never started: must not raise
+        q.stop()   # and again: close exactly once
+        with pytest.raises(RuntimeError):
+            q.start()   # stopped queries don't resurrect closed resources
+
+    def test_exception_clears_after_successful_batch(self, tmp_path):
+        sink = _ScriptedSink(fail_calls=[0])
+        q, _ = _dir_query(tmp_path, sink=sink)
+        q.start()
+        assert _wait_until(lambda: q.batches_processed >= 3)
+        assert q.exception is None      # recovered: not failed-looking
+        assert q.failed is False
+        q.stop()
+        assert sink.table()["x"].tolist() == pytest.approx(
+            list(np.arange(0, 4.0)) + list(np.arange(10, 14.0))
+            + list(np.arange(20, 24.0)))
+
+    def test_budget_exhaustion_terminates_with_failed_flag(self, tmp_path):
+        sink = _ScriptedSink(fail_calls=range(100))
+        q, _ = _dir_query(
+            tmp_path, sink=sink,
+            batch_retry_policy=RetryPolicy(max_retries=2, **INSTANT))
+        q.start()
+        assert _wait_until(lambda: not q.is_active)
+        assert q.failed and isinstance(q.exception, IOError)
+        assert sink.calls == 3          # first try + 2 retries, then death
+        q.stop()
+
+    def test_fatal_error_skips_the_retry_budget(self, tmp_path):
+        sink = _ScriptedSink(fail_calls=range(100), fail_exc=ValueError)
+        q, _ = _dir_query(
+            tmp_path, sink=sink,
+            batch_retry_policy=RetryPolicy(max_retries=50, **INSTANT))
+        q.start()
+        assert _wait_until(lambda: not q.is_active)
+        assert q.failed and isinstance(q.exception, ValueError)
+        assert sink.calls == 1          # no retries for programming errors
+        q.stop()
+
+
+# --------------------------------------------------------------------- #
+# QuerySupervisor
+# --------------------------------------------------------------------- #
+
+
+def _fast_restart_policy(max_restarts=10, **kw):
+    kw.setdefault("window_s", 1e6)
+    return RestartPolicy(max_restarts=max_restarts,
+                         backoff=RetryPolicy(max_retries=max_restarts,
+                                             backoffs_ms=[0.0]), **kw)
+
+
+class TestQuerySupervisor:
+    def test_restart_heals_a_transient_failure_streak(self, tmp_path):
+        # batch 1 fails 3x (budget 2 retries -> query dies), supervisor
+        # restarts; the sink works from call 3 on, so the stream completes
+        sink = _ScriptedSink(fail_calls=[1, 2])
+        q, _ = _dir_query(
+            tmp_path, sink=sink,
+            batch_retry_policy=RetryPolicy(max_retries=1, backoffs_ms=[0.0]))
+        restarts = []
+        sup = QuerySupervisor(
+            q, _fast_restart_policy(), poll_interval_s=0.002,
+            on_restart=lambda query, exc, n: restarts.append(type(exc)))
+        sup.start()
+        assert _wait_until(lambda: q.batches_processed >= 3)
+        assert sup.state == "running"
+        assert sup.restarts >= 1 and restarts[0] is IOError
+        sup.stop()
+        assert sup.state == "stopped"
+        # exactly-once across the restart: every row exactly once, in order
+        assert sink.table()["x"].tolist() == pytest.approx(
+            [0, 1, 2, 3, 10, 11, 12, 13, 20, 21, 22, 23])
+
+    def test_escalates_when_restart_budget_is_spent(self, tmp_path):
+        sink = _ScriptedSink(fail_calls=range(1000))
+        q, _ = _dir_query(
+            tmp_path, sink=sink,
+            batch_retry_policy=RetryPolicy(max_retries=0, backoffs_ms=[0.0]))
+        failures = []
+        sup = QuerySupervisor(
+            q, _fast_restart_policy(max_restarts=2), poll_interval_s=0.002,
+            on_failure=lambda query, exc: failures.append(exc))
+        sup.start()
+        assert sup.await_terminal(timeout_s=10)
+        assert sup.state == "failed"
+        assert sup.restarts == 2
+        assert len(failures) == 1 and isinstance(failures[0], IOError)
+        sup.stop()
+
+    def test_fatal_error_escalates_without_restarting(self, tmp_path):
+        sink = _ScriptedSink(fail_calls=range(1000), fail_exc=ValueError)
+        q, _ = _dir_query(
+            tmp_path, sink=sink,
+            batch_retry_policy=RetryPolicy(max_retries=0, backoffs_ms=[0.0]))
+        sup = QuerySupervisor(q, _fast_restart_policy(), poll_interval_s=0.002)
+        sup.start()
+        assert sup.await_terminal(timeout_s=10)
+        assert sup.state == "failed" and sup.restarts == 0
+        assert isinstance(sup.last_exception, ValueError)
+        sup.stop()
+
+    def test_user_stop_is_clean(self, tmp_path):
+        q, _ = _dir_query(tmp_path)
+        sup = QuerySupervisor(q, _fast_restart_policy(),
+                              poll_interval_s=0.002)
+        sup.start()
+        assert _wait_until(lambda: q.batches_processed >= 3)
+        sup.stop()
+        assert sup.state == "stopped" and not q.is_active
+
+
+# --------------------------------------------------------------------- #
+# ServingServer load shedding
+# --------------------------------------------------------------------- #
+
+
+def _post_raw(url_host, port, path="/", body=b"{}", timeout=10.0):
+    import http.client
+
+    conn = http.client.HTTPConnection(url_host, port, timeout=timeout)
+    try:
+        conn.request("POST", path, body=body,
+                     headers={"Content-Type": "application/json"})
+        r = conn.getresponse()
+        return r.status, dict(r.headers), r.read()
+    finally:
+        conn.close()
+
+
+class TestLoadShedding:
+    def test_overload_sheds_503_with_retry_after(self):
+        gate = threading.Event()
+        entered = threading.Event()
+
+        def slow_handler(table):
+            entered.set()
+            gate.wait(30.0)
+            return table.with_column(
+                "reply", [HTTPResponseData(200, "ok", entity=b"{}")
+                          for _ in range(table.num_rows)])
+
+        srv = ServingServer(slow_handler, max_pending=2,
+                            reply_timeout_s=10.0).start()
+        try:
+            results = []
+            lock = threading.Lock()
+
+            def fire():
+                st, hdrs, _ = _post_raw(srv.host, srv.port)
+                with lock:
+                    results.append((st, hdrs))
+
+            threads = [threading.Thread(target=fire)]
+            threads[0].start()
+            assert entered.wait(5.0)    # batch 1 is parked in the handler
+            for _ in range(2):          # fill the bounded queue behind it
+                t = threading.Thread(target=fire)
+                t.start()
+                threads.append(t)
+            assert _wait_until(lambda: srv._queue.qsize() >= 2)
+            # queue full: overload requests must shed IMMEDIATELY with
+            # 503 + Retry-After instead of queueing unbounded
+            shed = [_post_raw(srv.host, srv.port) for _ in range(3)]
+            assert [s for s, _, _ in shed] == [503] * 3
+            assert all("Retry-After" in h for _, h, _ in shed)
+            assert srv.requests_shed == 3
+            gate.set()                  # release the scorer; admitted win
+            for t in threads:
+                t.join(timeout=10)
+            assert sorted(s for s, _ in results) == [200, 200, 200]
+        finally:
+            gate.set()
+            srv.stop()
+
+    def test_request_deadline_answers_504_not_a_leak(self):
+        gate = threading.Event()
+
+        def stuck_handler(table):
+            gate.wait(5.0)
+            return table.with_column(
+                "reply", [HTTPResponseData(200, "ok", entity=b"{}")
+                          for _ in range(table.num_rows)])
+
+        srv = ServingServer(stuck_handler, request_deadline_s=0.15,
+                            reply_timeout_s=30.0).start()
+        try:
+            t0 = time.monotonic()
+            st, _, _ = _post_raw(srv.host, srv.port)
+            took = time.monotonic() - t0
+            assert st == 504
+            # the deadline (not reply_timeout_s=30) bounded the wait
+            assert took < 5.0
+        finally:
+            gate.set()
+            srv.stop()
+
+    def test_batcher_expires_stale_exchanges_without_scoring(self):
+        scored = []
+        first_in = threading.Event()
+        gate = threading.Event()
+
+        def handler(table):
+            scored.append(table.num_rows)
+            first_in.set()
+            gate.wait(5.0)
+            return table.with_column(
+                "reply", [HTTPResponseData(200, "ok", entity=b"{}")
+                          for _ in range(table.num_rows)])
+
+        srv = ServingServer(handler, request_deadline_s=0.2).start()
+        try:
+            threads = [threading.Thread(
+                target=lambda: _post_raw(srv.host, srv.port))
+                for _ in range(3)]
+            threads[0].start()
+            assert first_in.wait(5.0)   # batch 1 is in the handler
+            for t in threads[1:]:       # these two queue behind it...
+                t.start()
+            time.sleep(0.3)             # ...and expire while they wait
+            gate.set()
+            for t in threads:
+                t.join(timeout=10)
+            assert _wait_until(lambda: srv.requests_expired >= 2,
+                               timeout_s=5.0)
+            assert sum(scored) <= 1 + 1  # expired requests never scored
+        finally:
+            gate.set()
+            srv.stop()
+
+    def test_batch_mode_sheds_and_expires(self):
+        srv = ServingServer(None, mode="batch", max_pending=1,
+                            request_deadline_s=0.1,
+                            reply_timeout_s=5.0).start()
+        try:
+            codes = []
+
+            def fire():
+                st, _, _ = _post_raw(srv.host, srv.port)
+                codes.append(st)
+
+            t0 = threading.Thread(target=fire)
+            t0.start()
+            assert _wait_until(lambda: srv._load() >= 1)
+            # the replay set is at max_pending: these shed synchronously
+            for _ in range(2):
+                st, _, _ = _post_raw(srv.host, srv.port)
+                assert st == 503
+            t0.join(timeout=10)
+            # the admitted one expired to 504 (nothing ever scored it)
+            assert codes == [504]
+            assert srv.get_batch().num_rows == 0  # expired left the set
+            assert srv.requests_expired >= 1
+            assert srv.requests_shed == 2
+        finally:
+            srv.stop()
+
+    def test_draining_server_sheds_new_requests(self):
+        def handler(table):
+            return table.with_column(
+                "reply", [HTTPResponseData(200, "ok", entity=b"{}")
+                          for _ in range(table.num_rows)])
+
+        srv = ServingServer(handler).start()
+        try:
+            st, _, _ = _post_raw(srv.host, srv.port)
+            assert st == 200
+            srv._draining = True        # what stop(drain=True) sets first
+            st, hdrs, _ = _post_raw(srv.host, srv.port)
+            assert st == 503 and "Retry-After" in hdrs
+        finally:
+            srv.stop()
+
+
+# --------------------------------------------------------------------- #
+# Cognitive-service breaker fallback
+# --------------------------------------------------------------------- #
+
+
+class TestCognitiveBreaker:
+    def test_open_breaker_falls_back_to_error_col(self):
+        from mmlspark_tpu.io_http.cognitive import TextSentiment
+
+        clk = FakeClock()
+        calls = []
+
+        def dying_handler(req):
+            calls.append(1)
+            return HTTPResponseData(500, "downstream dead")
+
+        stage = TextSentiment(url="http://svc/text", output_col="sent",
+                              error_col="err")
+        stage.set_col(text="t")
+        stage.handler = dying_handler
+        stage.breaker = CircuitBreaker(name="svc", min_calls=3, window=3,
+                                       open_duration_s=60.0, clock=clk)
+        t = Table({"t": ["a", "b", "c"]})
+        out = stage.transform(t)
+        assert all(e is not None for e in out["err"])
+        assert stage.breaker.state == "open"
+        n_before = len(calls)
+        out2 = stage.transform(t)       # circuit open: local 503 fallback
+        assert len(calls) == n_before   # handler never invoked
+        assert all(e["status_code"] == 503 for e in out2["err"])
+        assert all("circuit open" in e["reason"] for e in out2["err"])
+
+    def test_simple_http_transformer_forwards_retries(self, script_server):
+        from mmlspark_tpu.io_http.transformer import SimpleHTTPTransformer
+
+        # the satellite: retries must reach the inner HTTPTransformer.
+        # retries=1 == no retry, so the scripted 503 surfaces in error_col
+        script_server["script"]["/"] = [(503, {})]
+        st = SimpleHTTPTransformer(url=script_server["url"] + "/",
+                                   input_col="p", output_col="o",
+                                   retries=1, error_col="err")
+        out = st.transform(Table({"p": [{"v": 1}, {"v": 2}]}))
+        errs = [e for e in out["err"] if e is not None]
+        assert len(errs) == 1 and errs[0]["status_code"] == 503
+        assert script_server["hits"]["/"] == 2
+        # with the budget raised the same script heals transparently
+        script_server["script"]["/"] = [(503, {})]
+        st2 = SimpleHTTPTransformer(url=script_server["url"] + "/",
+                                    input_col="p", output_col="o",
+                                    retries=3, error_col="err")
+        st2.retry_policy = RetryPolicy(max_retries=2, clock=FakeClock(),
+                                       **INSTANT)
+        out2 = st2.transform(Table({"p": [{"v": 1}]}))
+        assert out2["err"][0] is None
+
+
+# --------------------------------------------------------------------- #
+# The chaos soak (capstone)
+# --------------------------------------------------------------------- #
+
+
+class TestChaosSoak:
+    def test_supervised_query_is_exactly_once_under_chaos(self, tmp_path):
+        pytest.importorskip("pyarrow")
+        from mmlspark_tpu.streaming import ParquetSink
+
+        n_files, rows_per = 12, 5
+        d = str(tmp_path / "in")
+        os.makedirs(d)
+        for i in range(n_files):
+            base = float(i * rows_per)
+            write_csv(Table({"x": np.arange(base, base + rows_per)}),
+                      os.path.join(d, f"c-{i:03d}.csv"))
+        out_dir = str(tmp_path / "out")
+        ck = str(tmp_path / "ck")
+        transform = ChaosTransformer(seed=13, exception_prob=0.25)
+        chaos_clock = FakeClock()
+
+        def parts_written():
+            if not os.path.isdir(out_dir):
+                return 0
+            return sum(1 for f in os.listdir(out_dir)
+                       if f.startswith("part-") and f.endswith(".parquet"))
+
+        def run_phase(seed, until_parts):
+            """One process lifetime: chaotic source+sink, supervised query
+            over the shared checkpoint; returns once `until_parts` batch
+            outputs are durably on disk."""
+            src_chaos = FaultInjector(seed=seed, exception_prob=0.2,
+                                      latency_prob=0.3, latency_s=0.05,
+                                      clock=chaos_clock)
+            sink_chaos = FaultInjector(seed=seed + 1, exception_prob=0.2,
+                                       status_prob=0.1, status_burst=2,
+                                       clock=chaos_clock)
+            q = StreamingQuery(
+                src_chaos.wrap_source(
+                    DirectorySource(d, max_files_per_trigger=1)),
+                transform,
+                sink_chaos.wrap_sink(ParquetSink(out_dir)),
+                checkpoint_dir=ck, trigger_interval_s=0.001,
+                batch_retry_policy=RetryPolicy(max_retries=1,
+                                               backoffs_ms=[0.0]))
+            sup = QuerySupervisor(
+                q, _fast_restart_policy(max_restarts=500),
+                poll_interval_s=0.001)
+            sup.start()
+            assert _wait_until(lambda: parts_written() >= until_parts,
+                               timeout_s=30.0), \
+                f"stalled at {parts_written()} parts (state={sup.state})"
+            return q, sup, src_chaos, sink_chaos
+
+        # phase 1: run to ~half the stream, then KILL (no clean close —
+        # threads are abandoned exactly as a crash would leave them)
+        q1, sup1, src1, snk1 = run_phase(seed=101, until_parts=n_files // 2)
+        sup1._stop.set()
+        q1._stop.set()
+        q1.await_termination(10)
+        sup1.await_terminal(10)
+
+        # phase 2: a new process lifetime over the same checkpoint +
+        # output dir, different fault schedule, runs to completion
+        total = n_files * rows_per
+        q2, sup2, src2, snk2 = run_phase(seed=202, until_parts=n_files)
+        sup2.stop()
+
+        # chaos actually happened (this was not a fair-weather run), and
+        # every latency spike went to the fake clock — zero real sleeps
+        injected = [src1, snk1, src2, snk2]
+        assert sum(fi.injected["exception"] + fi.injected["status"]
+                   for fi in injected) > 0
+        assert sup1.restarts + sup2.restarts >= 1
+        if any(fi.injected["latency"] for fi in injected):
+            assert len(chaos_clock.sleeps) > 0
+
+        # byte-identical exactly-once output: the streamed parts, read in
+        # batch order, equal the one-shot batch transform of all input —
+        # no dropped rows, no duplicated replays
+        streamed = ParquetSink(out_dir).table()
+        expected = np.arange(float(total))
+        got = np.asarray(streamed["x"], dtype=np.float64)
+        assert got.shape == expected.shape
+        np.testing.assert_array_equal(got, expected)
+        assert streamed["x"].tobytes() == expected.tobytes()
