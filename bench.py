@@ -492,15 +492,14 @@ def bench_model_runner(peak_tflops: "float | None") -> dict:
 
     from mmlspark_tpu.utils.profiling import device_trace
 
-    runner.transform(table)          # warm-up / compile
-    with device_trace(None):
-        t0 = time.perf_counter()
-        out = runner.transform(table)
-    # the runner hands back host arrays, so materializing the output column
-    # includes any residual device->host sync
-    probs = np.asarray(out["output"])
-    elapsed = time.perf_counter() - t0
-    assert probs.shape[0] == N_IMAGES and np.isfinite(probs).all()
+    # async data plane: the same transform streamed at the STAGE'S default
+    # settings (mini_batch_size=64, f32, prefetch_depth=2, shape_buckets)
+    # with host prepare/upload and readback overlapping device compute;
+    # fused dispatch off so the pipelined loop — not the one-dispatch
+    # scan — is what's measured
+    pipelined_runner = DeepModelTransformer(
+        input_col="image", fused_dispatch=False,
+    ).set_model(bundle)
 
     # compute ceiling: the same bf16 forward on device-RESIDENT data — the
     # gap to the end-to-end number is host<->device transfer, not MXU time
@@ -515,26 +514,79 @@ def bench_model_runner(peak_tflops: "float | None") -> dict:
         xf = (xb.astype(jnp.float32) - 127.5) / 63.75
         return bundle.module.apply(v, xf.astype(jnp.bfloat16), train=False)
 
-    xd = jax.device_put(images)
-    jax.block_until_ready(fwd(bf16_vars, xd[:IMG_BATCH]))
+    # fused scan over resident batches — the SAME dispatch pattern as the
+    # e2e transform (a per-batch Python loop here measured 0.9x the e2e
+    # path: 8 dispatches + host concat, not the forward's ceiling)
+    @jax.jit
+    def fwd_scan(v, xall):
+        def body(_, xb):
+            return 0, fwd(v, xb)
 
-    def one_pass():
-        outs = [fwd(bf16_vars, xd[i:i + IMG_BATCH])
-                for i in range(0, N_IMAGES, IMG_BATCH)]
-        np.asarray(jnp.concatenate(outs))
+        _, outs = jax.lax.scan(body, 0, xall)
+        return outs
 
-    resident = N_IMAGES / median_timed(one_pass)
+    nb = N_IMAGES // IMG_BATCH
+    xd = jax.device_put(images[:nb * IMG_BATCH].reshape(
+        nb, IMG_BATCH, *images.shape[1:]))
+
+    # warm-up / compile all three paths, and check the e2e output once
+    out = runner.transform(table)
+    probs = np.asarray(out["output"])
+    assert probs.shape[0] == N_IMAGES and np.isfinite(probs).all()
+    pipelined_runner.transform(table)
+    jax.block_until_ready(fwd_scan(bf16_vars, xd))
+
+    def timed(fn):
+        t0 = time.perf_counter()
+        fn()
+        return time.perf_counter() - t0
+
+    # INTERLEAVED reps: the sequential/pipelined/resident comparison is
+    # the point of these rows, so each rep of each path runs under the
+    # same machine-load window — five paired passes, not one-sided
+    # samples taken minutes apart. Each row reports its MIN: external
+    # load only ever slows a pass down, so the minimum is the robust
+    # estimate of what the path costs (timeit's rationale)
+    seq_t, pipe_t, res_t = [], [], []
+    rows = [
+        # each rep materializes host arrays, so it includes the full
+        # device->host sync
+        (seq_t, lambda: np.asarray(runner.transform(table)["output"])),
+        (pipe_t, lambda: pipelined_runner.transform(table)),
+        (res_t, lambda: np.asarray(fwd_scan(bf16_vars, xd))),
+    ]
+    with device_trace(None):
+        for rep in range(5):
+            # rotate the within-pass order so no row systematically gets
+            # the coolest (or most contended) slot of each pass
+            for acc, fn in rows[rep % 3:] + rows[:rep % 3]:
+                acc.append(timed(fn))
+    elapsed = min(seq_t)
+    pipe_elapsed = min(pipe_t)
+    pipe_stats = pipelined_runner.last_pipeline_stats or {}
+    resident = (nb * IMG_BATCH) / min(res_t)
+    # the pipelined-vs-sequential comparison is PAIRED: both rows ran in
+    # every pass, so the per-pass ratio cancels that pass's machine-load
+    # noise; the median over passes is the robust comparison (a ratio of
+    # independent mins pairs each row's luckiest window against the
+    # other's and swings with whichever row noise favored)
+    pass_ratios = sorted(s / p for s, p in zip(seq_t, pipe_t))
+    pipe_vs_seq = pass_ratios[len(pass_ratios) // 2]
 
     # FLOPs from XLA's cost model of the exact compiled forward, sanity-
     # checked against the analytic count: ResNet-20 CIFAR forward ~= 8.2e7
     # FLOPs/img (2 * ~41M MACs)
-    step_flops = flops_of(fwd, bf16_vars, xd[:IMG_BATCH])
+    step_flops = flops_of(fwd, bf16_vars, xd[0])
     per_img = flops_sane(step_flops / IMG_BATCH if step_flops else None,
                          8.2e7, "runner fwd")
     tflops = resident * per_img / 1e12
     return {
         "images_per_sec": N_IMAGES / elapsed,
         "transform_seconds": elapsed,
+        "pipelined_images_per_sec": N_IMAGES / pipe_elapsed,
+        "pipelined_vs_sequential": pipe_vs_seq,
+        "pipeline_overlap_fraction": pipe_stats.get("overlap_fraction", 0.0),
+        "pipeline_bucket_ladder": pipe_stats.get("bucket_ladder"),
         "resident_images_per_sec": resident,
         "resident_tflops": tflops,
         "resident_mfu": _mfu(tflops, peak_tflops),
@@ -1090,6 +1142,10 @@ def _run_suite(platform: str) -> dict:
         print(f"bench: model-runner bench failed ({e!r})", file=sys.stderr)
         traceback.print_exc()
         runner = {"images_per_sec": 0.0, "transform_seconds": 0.0,
+                  "pipelined_images_per_sec": 0.0,
+                  "pipelined_vs_sequential": 0.0,
+                  "pipeline_overlap_fraction": 0.0,
+                  "pipeline_bucket_ladder": None,
                   "resident_images_per_sec": 0.0, "resident_tflops": 0.0,
                   "resident_mfu": None, "flops_per_image": 0.0}
     if os.environ.get(_SKIP_TRANSFORMER_ENV):
@@ -1162,6 +1218,18 @@ def _run_suite(platform: str) -> dict:
             "model_runner_vs_baseline": round(
                 runner["images_per_sec"] / BASELINE_IMAGES_PER_SEC, 3),
             "model_runner_baseline_images_per_sec": BASELINE_IMAGES_PER_SEC,
+            "runner_pipelined_images_per_sec": round(
+                runner.get("pipelined_images_per_sec", 0.0), 1),
+            # paired per-pass median from bench_model_runner; falls back
+            # to the ratio of independently-minimized rates
+            "runner_pipelined_vs_sequential": round(
+                runner.get("pipelined_vs_sequential")
+                or (runner.get("pipelined_images_per_sec", 0.0)
+                    / max(runner["images_per_sec"], 1e-9)), 3),
+            "runner_pipeline_overlap_fraction": round(
+                runner.get("pipeline_overlap_fraction", 0.0), 3),
+            "runner_pipeline_bucket_ladder": runner.get(
+                "pipeline_bucket_ladder"),
             "model_runner_resident_images_per_sec": round(resident, 1),
             "model_runner_resident_tflops": round(
                 runner.get("resident_tflops", 0.0), 3),

@@ -1,0 +1,436 @@
+"""Async data plane: host<->device pipelining primitives.
+
+BENCH_r05's headroom note names the bottleneck: end-to-end model-runner
+throughput is host->device transfer bound — the chip idles while Python
+featurizes, pads, and `device_put`s the next batch one step at a time.
+Input pipelining as a first-class reusable layer is the standard cure
+(tf.data, Murray et al. 2021; Pathways' asynchronous dispatch, Barham et
+al. 2022). This module is that layer, shared by the four batch loops that
+each reimplemented the sequential pattern (nn/runner.py, nn/trainer.py,
+streaming/query.py, io_http/serving.py):
+
+* `Prefetcher` — a bounded-depth background thread overlaps host-side
+  decode/featurize/pad + `device_put` of batch N+1 with device compute on
+  batch N. Depth 0 is the synchronous fallback (identical results, zero
+  threads) so pipelined-vs-sequential equivalence is a test, not a hope.
+* `AsyncReadback` — non-blocking result fetch with a bounded lag, so host
+  readback of batch N-1 overlaps compute on batch N instead of serializing
+  at the end of the loop.
+* `ShapeBucketer` — a pad-to-bucket ladder (powers of two up to the max
+  batch size) with row masks, so ragged tails and small serving batches
+  stop forcing a fresh XLA compile per row count: every observed shape
+  maps into a small closed set.
+* `ExecutableCache` — jitted executables keyed by (family, bucket shape)
+  with hit/miss/recompile counters, aggregated process-wide so a serving
+  info endpoint can report steady-state recompile health.
+* `Lookahead` — a single-slot keyed read-ahead for the streaming driver:
+  the next micro-batch's SOURCE READ overlaps the current batch's
+  transform+sink, while planning and commit stay strictly ordered (the
+  exactly-once contract is untouched).
+
+Deliberately jax-free: callers pass the `prepare`/build callables that
+touch the device, so the module imports under any backend (and in the
+orchestrator processes that must never initialize jax).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, Iterable, Iterator
+
+import numpy as np
+
+__all__ = ["Prefetcher", "AsyncReadback", "ShapeBucketer", "ExecutableCache",
+           "Lookahead", "cache_stats", "reset_cache_stats"]
+
+
+# --------------------------------------------------------------------- #
+# Prefetcher                                                            #
+# --------------------------------------------------------------------- #
+
+class _End:
+    """Queue sentinel (private class, never a legal prepared item)."""
+
+
+class _Raised:
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+class Prefetcher:
+    """Iterate `prepare(item)` for each item, preparing up to `depth`
+    items ahead in a background thread.
+
+    The consumer sees exactly the sequence `map(prepare, items)` in order
+    — depth changes WHEN host work happens, never WHAT is produced, which
+    is what makes pipelined-vs-sequential byte-equivalence testable.
+    Exceptions raised by `prepare` propagate to the consumer at the point
+    the failed item would have been yielded.
+
+    `stats` after (or during) iteration:
+      prepare_seconds — total wall time spent inside `prepare`
+      wait_seconds    — total time the consumer blocked waiting for an item
+      items           — items yielded so far
+
+    `overlap_fraction()` estimates how much of the host-side prepare cost
+    was hidden behind the consumer's own work: 1.0 means the consumer
+    never waited, 0.0 means fully serial (always 0.0 at depth 0).
+    """
+
+    def __init__(self, items: Iterable[Any], prepare: Callable[[Any], Any],
+                 depth: int = 2, name: str = "prefetch"):
+        self._items = items
+        self._prepare = prepare
+        self.depth = max(int(depth), 0)
+        self.name = name
+        self.stats = {"prepare_seconds": 0.0, "wait_seconds": 0.0, "items": 0}
+        self._queue: "queue.Queue | None" = None
+        self._thread: "threading.Thread | None" = None
+        self._stop = threading.Event()
+
+    def overlap_fraction(self) -> float:
+        prep = self.stats["prepare_seconds"]
+        if prep <= 0.0:
+            return 0.0
+        hidden = max(prep - self.stats["wait_seconds"], 0.0)
+        return min(hidden / prep, 1.0)
+
+    # -- synchronous path (depth 0) ------------------------------------- #
+
+    def _iter_sync(self) -> Iterator[Any]:
+        for item in self._items:
+            t0 = time.perf_counter()
+            out = self._prepare(item)
+            dt = time.perf_counter() - t0
+            # serial: every prepare second is also a consumer-wait second
+            self.stats["prepare_seconds"] += dt
+            self.stats["wait_seconds"] += dt
+            self.stats["items"] += 1
+            yield out
+
+    # -- pipelined path -------------------------------------------------- #
+
+    def _worker(self) -> None:
+        q = self._queue
+        try:
+            for item in self._items:
+                if self._stop.is_set():
+                    return
+                t0 = time.perf_counter()
+                try:
+                    out = self._prepare(item)
+                except BaseException as e:  # noqa: BLE001 — re-raised at consumer
+                    q.put(_Raised(e))
+                    return
+                self.stats["prepare_seconds"] += time.perf_counter() - t0
+                q.put(out)
+        except BaseException as e:  # noqa: BLE001 — iterator itself raised
+            q.put(_Raised(e))
+            return
+        q.put(_End)
+
+    def __iter__(self) -> Iterator[Any]:
+        if self.depth <= 0:
+            yield from self._iter_sync()
+            return
+        self._queue = queue.Queue(maxsize=self.depth)
+        self._thread = threading.Thread(
+            target=self._worker, name=f"dataplane-{self.name}", daemon=True)
+        self._thread.start()
+        try:
+            while True:
+                t0 = time.perf_counter()
+                out = self._queue.get()
+                self.stats["wait_seconds"] += time.perf_counter() - t0
+                if out is _End:
+                    return
+                if isinstance(out, _Raised):
+                    raise out.exc
+                self.stats["items"] += 1
+                yield out
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        """Stop the background thread (idempotent; called on generator
+        close so an abandoned iteration never leaks a producer)."""
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            # unblock a producer parked on a full queue
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+            t.join(timeout=5)
+
+
+class AsyncReadback:
+    """Bounded-lag device->host readback.
+
+    `push(outs)` parks the (still in-flight, thanks to async dispatch)
+    device results of the current batch and returns the FETCHED results of
+    batches that fell out of the lag window — so host readback of batch
+    N-1 runs while the device computes batch N, instead of all readbacks
+    serializing after the loop. `drain()` fetches whatever is left.
+    """
+
+    def __init__(self, fetch: Callable[[Any], Any], lag: int = 1):
+        self._fetch = fetch
+        self.lag = max(int(lag), 0)
+        self._pending: list[Any] = []
+
+    def push(self, outs: Any) -> list[Any]:
+        self._pending.append(outs)
+        ready = []
+        while len(self._pending) > self.lag:
+            ready.append(self._fetch(self._pending.pop(0)))
+        return ready
+
+    def drain(self) -> list[Any]:
+        ready = [self._fetch(o) for o in self._pending]
+        self._pending = []
+        return ready
+
+
+# --------------------------------------------------------------------- #
+# ShapeBucketer                                                         #
+# --------------------------------------------------------------------- #
+
+class ShapeBucketer:
+    """Pad-to-bucket ladder: geometric (default powers of two) batch-size
+    buckets up to `max_size`, each rounded up to `multiple_of` (the mesh
+    data-axis divisibility constraint).
+
+    Ragged row counts map onto a small closed set of shapes, so a jitted
+    per-shape executable compiles once per BUCKET instead of once per
+    observed row count — the serving p99-recompile-spike fix. `pad`
+    returns the padded array plus the row mask marking real rows (padding
+    repeats the last row, the same convention the runner always used, so
+    padded rows are well-formed inputs that get sliced away)."""
+
+    def __init__(self, max_size: int, min_size: int = 1, growth: int = 2,
+                 multiple_of: int = 1):
+        if max_size < 1:
+            raise ValueError(f"max_size must be >= 1, got {max_size}")
+        if growth < 2:
+            raise ValueError(f"growth must be >= 2, got {growth}")
+        m = max(int(multiple_of), 1)
+        self.max_size = ((int(max_size) + m - 1) // m) * m
+        self.multiple_of = m
+        ladder: list[int] = []
+        b = max(int(min_size), 1)
+        while b < self.max_size:
+            rounded = ((b + m - 1) // m) * m
+            if not ladder or rounded > ladder[-1]:
+                ladder.append(rounded)
+            b *= growth
+        if not ladder or ladder[-1] != self.max_size:
+            ladder.append(self.max_size)
+        self.ladder: tuple[int, ...] = tuple(ladder)
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest ladder bucket >= n (n must fit the ladder)."""
+        if n < 0:
+            raise ValueError(f"row count must be >= 0, got {n}")
+        for b in self.ladder:
+            if n <= b:
+                return b
+        raise ValueError(
+            f"{n} rows exceed the bucket ladder's max {self.max_size} — "
+            "chunk the input to max_size first")
+
+    def pad(self, x: np.ndarray, n_target: "int | None" = None
+            ) -> "tuple[np.ndarray, np.ndarray]":
+        """(padded, row_mask): rows padded to `n_target` (default: the
+        bucket for len(x)) by repeating the last row; mask is True for
+        real rows."""
+        n = len(x)
+        target = self.bucket_for(n) if n_target is None else int(n_target)
+        if target < n:
+            raise ValueError(f"cannot pad {n} rows down to {target}")
+        mask = np.zeros(target, dtype=bool)
+        mask[:n] = True
+        if target == n:
+            return x, mask
+        if n == 0:
+            raise ValueError("cannot pad an empty batch (no row to repeat)")
+        pad = np.repeat(x[-1:], target - n, axis=0)
+        return np.concatenate([x, pad], axis=0), mask
+
+
+# --------------------------------------------------------------------- #
+# ExecutableCache                                                       #
+# --------------------------------------------------------------------- #
+
+# process-wide aggregate across every live ExecutableCache — what a
+# serving info endpoint reports without having to find each model's
+# private cache instance
+_GLOBAL_STATS_LOCK = threading.Lock()
+_GLOBAL_STATS = {"hits": 0, "misses": 0, "recompiles": 0}
+
+
+def cache_stats() -> dict[str, int]:
+    """Process-wide executable-cache counters (sum over all caches)."""
+    with _GLOBAL_STATS_LOCK:
+        return dict(_GLOBAL_STATS)
+
+
+def reset_cache_stats() -> None:
+    """Zero the process-wide counters (tests / soak baselines)."""
+    with _GLOBAL_STATS_LOCK:
+        for k in _GLOBAL_STATS:
+            _GLOBAL_STATS[k] = 0
+
+
+class ExecutableCache:
+    """Compiled-executable cache keyed by (family, shape).
+
+    `family` is everything that selects a distinct program lineage —
+    fetches, dtype flags, shardings, model identity; `shape` is the
+    bucketed batch shape. Counters:
+
+      hits       — the executable existed
+      misses     — the builder ran (an XLA compile happened)
+      recompiles — the subset of misses where the family was already
+                   cached at a DIFFERENT shape: the signal that ragged
+                   shapes are defeating the bucket ladder. Steady-state
+                   recompiles == 0 is the serving soak acceptance bar.
+    """
+
+    def __init__(self) -> None:
+        self._entries: dict[tuple, Any] = {}
+        self._families: dict[Any, set] = {}
+        self._lock = threading.RLock()
+        self.hits = 0
+        self.misses = 0
+        self.recompiles = 0
+
+    def _bump(self, **deltas: int) -> None:
+        with _GLOBAL_STATS_LOCK:
+            for k, v in deltas.items():
+                _GLOBAL_STATS[k] += v
+
+    def get_or_build(self, family: Any, shape: Any,
+                     builder: Callable[[], Any]) -> Any:
+        with self._lock:
+            key = (family, shape)
+            if key in self._entries:
+                self.hits += 1
+                self._bump(hits=1)
+                return self._entries[key]
+            seen = self._families.setdefault(family, set())
+            recompile = bool(seen) and shape not in seen
+            self.misses += 1
+            deltas = {"misses": 1}
+            if recompile:
+                self.recompiles += 1
+                deltas["recompiles"] = 1
+            self._bump(**deltas)
+            value = builder()
+            self._entries[key] = value
+            seen.add(shape)
+            return value
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._families.clear()
+
+    def stats(self) -> dict[str, int]:
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "recompiles": self.recompiles, "entries": len(self._entries)}
+
+
+# --------------------------------------------------------------------- #
+# Lookahead                                                             #
+# --------------------------------------------------------------------- #
+
+class Lookahead:
+    """Single-slot keyed read-ahead.
+
+    `submit(key, fn)` runs `fn()` on a background thread; `take(key)`
+    waits for it and returns the result IF the key matches the pending
+    submission, else discards it and reports a miss. A read that raised
+    is also a miss (the caller re-reads synchronously, surfacing a
+    persistent error through the normal path).
+
+    Built for the streaming driver: the next batch's source read overlaps
+    the current batch's transform+sink, while the caller keeps planning
+    and committing strictly in order — a mismatched or failed lookahead
+    costs one synchronous read, never correctness.
+    """
+
+    _MISS = object()
+
+    def __init__(self, name: str = "lookahead"):
+        self.name = name
+        self._key: Any = None
+        self._done = threading.Event()
+        self._result: Any = self._MISS
+        self._error: "BaseException | None" = None
+        self._thread: "threading.Thread | None" = None
+        self.hits = 0
+        self.misses = 0
+
+    @property
+    def pending(self) -> bool:
+        return self._thread is not None
+
+    def submit(self, key: Any, fn: Callable[[], Any]) -> None:
+        """Start a background read for `key`; any previous unclaimed
+        submission is discarded first."""
+        self.discard()
+        self._key = key
+        self._done = threading.Event()
+        self._result, self._error = self._MISS, None
+        done = self._done
+
+        def run() -> None:
+            try:
+                result = fn()
+            except BaseException as e:  # noqa: BLE001 — reported as a miss
+                self._error = e
+            else:
+                self._result = result
+            finally:
+                done.set()
+
+        self._thread = threading.Thread(
+            target=run, name=f"dataplane-{self.name}", daemon=True)
+        self._thread.start()
+
+    def take(self, key: Any) -> "tuple[bool, Any]":
+        """(hit, result): hit=True only when `key` matches the pending
+        submission and its read succeeded."""
+        if self._thread is None:
+            return False, None
+        self._done.wait()
+        self._thread.join()
+        self._thread = None
+        matched = (self._key == key and self._error is None
+                   and self._result is not self._MISS)
+        result = self._result if matched else None
+        self._key, self._result, self._error = None, self._MISS, None
+        if matched:
+            self.hits += 1
+        else:
+            self.misses += 1
+        return matched, result
+
+    def discard(self) -> None:
+        """Drop any pending submission (waits for its thread so no read
+        ever races a caller's next synchronous source call)."""
+        if self._thread is not None:
+            self._done.wait()
+            self._thread.join()
+            self._thread = None
+        self._key, self._result, self._error = None, self._MISS, None

@@ -34,6 +34,7 @@ from typing import Any, Callable
 
 import numpy as np
 
+from ..core.dataplane import ShapeBucketer, cache_stats
 from ..core.schema import Table
 from .schema import HTTPRequestData, HTTPResponseData, make_reply, parse_request
 
@@ -99,6 +100,7 @@ class ServingServer:
         max_pending: int = 0,
         request_deadline_s: float | None = None,
         drain_timeout_s: float = 5.0,
+        bucket_batches: bool = False,
     ):
         if mode not in ("continuous", "batch"):
             raise ValueError(f"mode must be 'continuous' or 'batch', got {mode!r}")
@@ -125,6 +127,19 @@ class ServingServer:
         # being scored — an expired exchange must not occupy a batch slot
         self.request_deadline_s = request_deadline_s
         self.drain_timeout_s = drain_timeout_s
+        # Pad each scored batch up to a power-of-two bucket (repeating the
+        # last request; padded replies are sliced off before completion).
+        # A greedy batcher hands the handler every row count from 1 to
+        # max_batch_size — one fresh XLA compile per NEW count, i.e. p99
+        # recompile spikes deep into a deployment. The ladder bounds the
+        # handler's input sizes to a small closed set, so the jitted model
+        # is fully warm after one pass over the ladder. OPT-IN: padding
+        # re-presents the last request to the handler, which is only safe
+        # for pure scoring handlers (serve_model enables it) — a handler
+        # with side effects per row (e.g. forwarding upstream) would see
+        # duplicates.
+        self.bucketer = (ShapeBucketer(max_batch_size)
+                         if bucket_batches and max_batch_size > 1 else None)
         self.api_path = api_path
         # "continuous": batcher thread drains the queue and replies directly
         # (HTTPSourceV2.scala:336-474). "batch": the micro-batch engine is the
@@ -280,6 +295,9 @@ class ServingServer:
                     outer._latencies.append(time.perf_counter() - ex.enqueued_at)
 
             def do_GET(self):  # noqa: N802 — health/info endpoint
+                # process-wide executable-cache counters: steady-state
+                # recompiles staying flat is the bucket ladder working
+                exe = cache_stats()
                 info = json.dumps({
                     "name": "mmlspark_tpu.serving",
                     "host": outer.host, "port": outer.port,
@@ -288,6 +306,12 @@ class ServingServer:
                     "answered": outer.requests_answered,
                     "shed": outer.requests_shed,
                     "expired": outer.requests_expired,
+                    "executable_cache_hits": exe["hits"],
+                    "executable_cache_misses": exe["misses"],
+                    "executable_cache_recompiles": exe["recompiles"],
+                    "bucket_ladder": (list(outer.bucketer.ladder)
+                                      if outer.bucketer is not None
+                                      else [outer.max_batch_size]),
                     "latency": outer.latency_stats(),
                 }).encode()
                 self.send_response(200)
@@ -471,15 +495,21 @@ class ServingServer:
                 if not batch:
                     continue
             try:
-                table = Table({"request": [ex.request for ex in batch]})
+                requests = [ex.request for ex in batch]
+                if self.bucketer is not None:
+                    target = self.bucketer.bucket_for(len(requests))
+                    requests = requests + \
+                        [requests[-1]] * (target - len(requests))
+                table = Table({"request": requests})
                 out = self.handler(table)
                 replies = out["reply"]
-                if len(replies) != len(batch):
+                if len(replies) != len(requests):
                     raise ValueError(
                         f"handler returned {len(replies)} replies for a "
-                        f"batch of {len(batch)} requests — handlers must "
+                        f"batch of {len(requests)} requests — handlers must "
                         "preserve row count and order"
                     )
+                replies = list(replies)[:len(batch)]
             except Exception as e:  # noqa: BLE001 — per-batch failure -> 500s
                 replies = [_handler_error_response(e)] * len(batch)
             for ex, resp in zip(batch, replies):
@@ -614,6 +644,9 @@ def serve_model(
         scored = model.transform(t)
         return make_reply(scored, output_col)
 
+    # scoring is pure per-row, so batch-size bucketing is safe here and
+    # keeps the jitted model's compiled-shape set closed
+    server_kw.setdefault("bucket_batches", True)
     return ServingServer(handler, host=host, port=port, **server_kw).start()
 
 

@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.dataplane import (AsyncReadback, ExecutableCache, Prefetcher,
+                              ShapeBucketer)
 from ..core.params import Param
 from ..core.pipeline import Model
 from ..core.schema import SCORE_KIND, Table
@@ -68,15 +70,36 @@ class DeepModelTransformer(Model):
         False, "run the forward in bfloat16 (MXU-native; outputs stay float32)",
         ptype=bool,
     )
+    # Async data plane (non-fused path): a bounded background thread
+    # featurizes/pads/uploads minibatch N+1 while the device computes
+    # minibatch N, and host readback of minibatch N-1 overlaps both.
+    # Depth 0 is the strictly sequential fallback — outputs are
+    # byte-identical at any depth (shapes and order never change).
+    prefetch_depth = Param(
+        2, "minibatches prepared ahead of device compute (0 = sequential)",
+        ptype=int,
+    )
+    # Ragged tails pad to a power-of-two bucket ladder (<= mini_batch_size)
+    # instead of all the way up to mini_batch_size: less wasted tail
+    # compute, and the compiled-shape set stays a small closed ladder.
+    shape_buckets = Param(
+        True, "pad ragged tails to a pow-2 bucket ladder (vs full batch)",
+        ptype=bool,
+    )
 
     bundle: ModelBundle | None = None
     _apply_cache: dict | None = None
     _outbytes_cache: dict | None = None
+    _exec_cache: ExecutableCache | None = None
+    #: stats from the most recent pipelined (non-fused) _transform:
+    #: prepare/wait seconds, overlap_fraction, executable-cache counters
+    last_pipeline_stats: dict | None = None
 
     def set_model(self, bundle: ModelBundle) -> "DeepModelTransformer":
         self.bundle = bundle
         self._apply_cache = {}
         self._outbytes_cache = {}
+        self._exec_cache = ExecutableCache()
         return self
 
     # ------------------------------------------------------------------ #
@@ -159,14 +182,12 @@ class DeepModelTransformer(Model):
         fetches = tuple(fetch.values())
 
         bs = int(self.get("mini_batch_size"))
+        d = 1
         if self.get("use_mesh"):
             d = get_mesh().shape[DATA_AXIS]
             bs = ((bs + d - 1) // d) * d
 
-        # pad to a whole number of fixed-size batches: ONE compiled shape
         pad = (-n) % bs
-        if pad:
-            x = np.concatenate([x, np.repeat(x[-1:], pad, axis=0)])
         fused = bool(self.get("fused_dispatch"))
         if fused:
             # the fused scan holds the inputs AND every fetched output for
@@ -188,7 +209,8 @@ class DeepModelTransformer(Model):
                     int(np.prod(o.shape)) * o.dtype.itemsize for o in out_abs
                 )
             per_batch = self._outbytes_cache[okey]
-            total = x.nbytes + per_batch * (len(x) // bs)
+            row_bytes = x.nbytes // n if n else 0
+            total = row_bytes * (n + pad) + per_batch * ((n + pad) // bs)
             fused = total <= int(self.get("fused_dispatch_budget_mb")) * 2**20
 
         if self._apply_cache is None:
@@ -211,22 +233,71 @@ class DeepModelTransformer(Model):
         apply_fn, variables = self._apply_cache[key]
 
         if fused:
+            if pad:
+                x = np.concatenate([x, np.repeat(x[-1:], pad, axis=0)])
             nb = len(x) // bs
             outs = apply_fn(variables, jnp.asarray(x.reshape(nb, bs, *x.shape[1:])))
             cols = [np.asarray(o).reshape(nb * bs, *o.shape[2:])[:n] for o in outs]
         else:
-            chunks: list[tuple[np.ndarray, ...]] = []
-            for i in range(0, len(x), bs):
-                outs = apply_fn(variables, jnp.asarray(x[i : i + bs]))
-                chunks.append(outs)
-            cols = [np.concatenate([np.asarray(c[j]) for c in chunks])[:n]
-                    for j in range(len(fetches))]
+            cols = self._transform_pipelined(x, bs, d, key, apply_fn, variables,
+                                             fetches)
 
         out = table
         for (col_name, fetch_name), arr in zip(fetch.items(), cols):
             kind = "probability" if fetch_name == "probability" else "raw_prediction"
             out = out.with_column(col_name, arr, meta={SCORE_KIND: kind})
         return out
+
+    def _transform_pipelined(self, x: np.ndarray, bs: int, d: int, family,
+                             apply_fn, variables,
+                             fetches: tuple[str, ...]) -> list[np.ndarray]:
+        """Non-fused loop on the async data plane: prepare (slice + pad +
+        upload) of minibatch N+1 overlaps device compute on N, and host
+        readback lags one batch so it overlaps too. Shapes, batch order,
+        and per-row outputs are identical at every prefetch depth."""
+        n = x.shape[0]
+        bucketer = (ShapeBucketer(bs, multiple_of=d)
+                    if self.get("shape_buckets") else None)
+        if self._exec_cache is None:
+            self._exec_cache = ExecutableCache()
+
+        def prepare(i: int):
+            chunk = x[i:i + bs]
+            m = chunk.shape[0]
+            if bucketer is not None:
+                padded, _ = bucketer.pad(chunk)
+            elif m < bs:
+                padded = np.concatenate(
+                    [chunk, np.repeat(chunk[-1:], bs - m, axis=0)])
+            else:
+                padded = chunk
+            return jnp.asarray(padded), m
+
+        prefetch = Prefetcher(range(0, n, bs), prepare,
+                              depth=int(self.get("prefetch_depth")),
+                              name="runner")
+        # fetch = block on the device result and slice the padding off;
+        # lag 1 keeps batch N-1's readback behind batch N's dispatch
+        readback = AsyncReadback(
+            lambda om: tuple(np.asarray(a)[:om[1]] for a in om[0]), lag=1)
+        chunks: list[tuple[np.ndarray, ...]] = []
+        for xb, m in prefetch:
+            shape_key = (int(xb.shape[0]), tuple(xb.shape[1:]), str(xb.dtype))
+            # jit compiles once per entry here; the counters make ragged
+            # shapes defeating the ladder visible (recompiles > 0)
+            fn = self._exec_cache.get_or_build(family, shape_key,
+                                               lambda: apply_fn)
+            chunks.extend(readback.push((fn(variables, xb), m)))
+        chunks.extend(readback.drain())
+        self.last_pipeline_stats = {
+            **prefetch.stats,
+            "overlap_fraction": prefetch.overlap_fraction(),
+            "prefetch_depth": prefetch.depth,
+            "bucket_ladder": list(bucketer.ladder) if bucketer else [bs],
+            **self._exec_cache.stats(),
+        }
+        return [np.concatenate([c[j] for c in chunks])
+                for j in range(len(fetches))]
 
     # -- persistence ---------------------------------------------------- #
 
@@ -264,3 +335,4 @@ class DeepModelTransformer(Model):
         finally:
             os.unlink(tmp)
         self._apply_cache = {}
+        self._exec_cache = ExecutableCache()

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from ..core.dataplane import Prefetcher
 from ..core.params import HasFeaturesCol, HasLabelCol, Param
 from ..core.pipeline import Estimator, Model
 from ..core.schema import SCORE_KIND, Table
@@ -74,6 +75,15 @@ class DNNLearner(HasFeaturesCol, HasLabelCol, Estimator):
     fused_epochs = Param(True, "scan a whole epoch in one dispatch", ptype=bool)
     fused_epoch_budget_mb = Param(
         512, "max table MB resident on device for the fused epoch path", ptype=int
+    )
+    # Streamed (non-fused) epochs: gather + upload of minibatch N+1 and its
+    # fold_in rng overlap the device's train step on minibatch N. Safe with
+    # donate_argnums=(0,1,2): only params/batch_stats/opt_state are donated,
+    # never the prefetched batch buffers. Batch order and per-step rngs are
+    # depth-invariant, so training is bit-identical at any depth.
+    prefetch_depth = Param(
+        2, "minibatches prepared ahead in the streamed epoch loop (0 = sync)",
+        ptype=int,
     )
 
     init_bundle: ModelBundle | None = None  # programmatic warm start
@@ -232,13 +242,19 @@ class DNNLearner(HasFeaturesCol, HasLabelCol, Estimator):
                 )
                 mean_loss = float(mean_loss)
             else:
+                def prep(ki, _order=order, _rng=epoch_rng):
+                    k, i = ki
+                    idx = _order[i : i + bs]
+                    return (jnp.asarray(x[idx]), jnp.asarray(y[idx]),
+                            jax.random.fold_in(_rng, k))
+
                 losses = []
-                for k, i in enumerate(range(0, n - bs + 1, bs)):
-                    idx = order[i : i + bs]
+                for bx, by, step_rng in Prefetcher(
+                    enumerate(range(0, n - bs + 1, bs)), prep,
+                    depth=int(self.get("prefetch_depth")), name="trainer",
+                ):
                     params, batch_stats, opt_state, loss = step(
-                        params, batch_stats, opt_state,
-                        jnp.asarray(x[idx]), jnp.asarray(y[idx]),
-                        jax.random.fold_in(epoch_rng, k),
+                        params, batch_stats, opt_state, bx, by, step_rng
                     )
                     losses.append(loss)
                 mean_loss = (

@@ -29,6 +29,7 @@ import threading
 import time
 from typing import Any, Callable
 
+from ..core.dataplane import Lookahead
 from ..core.schema import Table
 from ..resilience.policy import RetryPolicy, is_fatal_exception
 from .checkpoint import CommitLog
@@ -74,6 +75,7 @@ class StreamingQuery:
                  trigger_interval_s: float = 0.1,
                  compact_every: int = 100,
                  batch_retry_policy: "RetryPolicy | None" = None,
+                 source_lookahead: int = 1,
                  name: str = "query") -> None:
         self.source = source
         self.transform = transform
@@ -94,6 +96,14 @@ class StreamingQuery:
             [s for s in _walk_stages(transform)
              if isinstance(s, StatefulOperator)]
             if hasattr(transform, "transform") else [])
+        # Async data plane: read ahead on the SOURCE only — batch N+1's
+        # get_offset/get_batch overlap batch N's transform + sink write.
+        # Planning and commit stay strictly ordered in process_next, so
+        # exactly-once and kill-restart replay are untouched; a stale or
+        # failed lookahead is discarded and the source re-read in line.
+        # Single-slot (values > 1 behave as 1).
+        self._lookahead = (Lookahead(name=f"source-{name}")
+                           if source_lookahead > 0 else None)
         self._log = CommitLog(checkpoint_dir) if checkpoint_dir else None
         self._lock = threading.RLock()
         self._stop = threading.Event()
@@ -135,6 +145,16 @@ class StreamingQuery:
             return self.transform.transform(batch)
         return self.transform(batch)
 
+    def _read_ahead(self, start: "dict | None"):
+        """Background source read for the batch AFTER the current one:
+        (end_offset, batch-or-None). Deterministic per the Source contract
+        (get_batch(start, end) always yields the same rows), so a result
+        claimed after a failed attempt's replay is still exact."""
+        end = self.source.get_offset(start)
+        if end is None or end == start or self.source.empty_range(start, end):
+            return end, None
+        return end, self.source.get_batch(start, end)
+
     def process_next(self) -> bool:
         """Run at most one micro-batch; False when no new data is
         available. Raises on batch failure (the background loop catches,
@@ -142,6 +162,7 @@ class StreamingQuery:
         WAL plan makes the retry deterministic)."""
         with self._lock:
             bid = self._next_id
+            ahead = None
             replay = self._log.planned(bid) if self._log is not None else None
             if replay is not None:
                 start, end = replay["start"], replay["end"]
@@ -153,7 +174,15 @@ class StreamingQuery:
                     return True
             else:
                 start = self._last_end
-                end = self.source.get_offset(start)
+                hit = False
+                if self._lookahead is not None:
+                    hit, pre = self._lookahead.take(start)
+                if hit and pre[1] is not None:
+                    end, ahead = pre
+                else:
+                    # no pending read-ahead, or it saw no data when it ran
+                    # — poll fresh so rows that arrived since aren't missed
+                    end = self.source.get_offset(start)
                 if end is None or end == start or \
                         self.source.empty_range(start, end):
                     return False
@@ -162,7 +191,15 @@ class StreamingQuery:
             saved = [op.state_doc() for op in self._ops]
             t0 = time.monotonic()
             try:
-                batch = self.source.get_batch(start, end)
+                batch = (ahead if ahead is not None
+                         else self.source.get_batch(start, end))
+                # overlap the NEXT batch's source read with this batch's
+                # transform + sink write (keyed by its start offset; a
+                # replay or restart simply misses and reads in line)
+                if self._lookahead is not None:
+                    nxt = end
+                    self._lookahead.submit(
+                        nxt, lambda: self._read_ahead(nxt))
                 out = self._apply(batch)
                 if self._log is not None and self._ops:
                     self._log.write_state(
@@ -195,6 +232,9 @@ class StreamingQuery:
             "batch_id": bid, "num_rows": rows,
             "duration_s": duration_s, "end_offset": end,
         }
+        if self._lookahead is not None:
+            self.last_progress["lookahead_hits"] = self._lookahead.hits
+            self.last_progress["lookahead_misses"] = self._lookahead.misses
 
     def process_all_available(self) -> int:
         """Drain everything currently available (Spark's availableNow
@@ -276,6 +316,9 @@ class StreamingQuery:
         if self._closed:
             return
         self._closed = True
+        if self._lookahead is not None:
+            # join any in-flight background read before closing the source
+            self._lookahead.discard()
         if self._log is not None:
             self._log.close()
         self.source.close()

@@ -10,11 +10,13 @@
 #' @param fused_dispatch scan all minibatches in one dispatch
 #' @param fused_dispatch_budget_mb max input MB eligible for the fused single-dispatch path
 #' @param bfloat16 run the forward in bfloat16 (MXU-native; outputs stay float32)
+#' @param prefetch_depth minibatches prepared ahead of device compute (0 = sequential)
+#' @param shape_buckets pad ragged tails to a pow-2 bucket ladder (vs full batch)
 #' @param prediction_col predicted label column
 #' @param classifier argmax labels (vs raw regression output)
 #' @param features_col input features column
 #' @export
-ml_dnn_model <- function(x, input_col = "features", fetch_dict = NULL, mini_batch_size = 64L, use_mesh = FALSE, fused_dispatch = TRUE, fused_dispatch_budget_mb = 512L, bfloat16 = FALSE, prediction_col = "prediction", classifier = TRUE, features_col = "features")
+ml_dnn_model <- function(x, input_col = "features", fetch_dict = NULL, mini_batch_size = 64L, use_mesh = FALSE, fused_dispatch = TRUE, fused_dispatch_budget_mb = 512L, bfloat16 = FALSE, prefetch_depth = 2L, shape_buckets = TRUE, prediction_col = "prediction", classifier = TRUE, features_col = "features")
 {
   params <- list()
   if (!is.null(input_col)) params$input_col <- as.character(input_col)
@@ -24,6 +26,8 @@ ml_dnn_model <- function(x, input_col = "features", fetch_dict = NULL, mini_batc
   if (!is.null(fused_dispatch)) params$fused_dispatch <- as.logical(fused_dispatch)
   if (!is.null(fused_dispatch_budget_mb)) params$fused_dispatch_budget_mb <- as.integer(fused_dispatch_budget_mb)
   if (!is.null(bfloat16)) params$bfloat16 <- as.logical(bfloat16)
+  if (!is.null(prefetch_depth)) params$prefetch_depth <- as.integer(prefetch_depth)
+  if (!is.null(shape_buckets)) params$shape_buckets <- as.logical(shape_buckets)
   if (!is.null(prediction_col)) params$prediction_col <- as.character(prediction_col)
   if (!is.null(classifier)) params$classifier <- as.logical(classifier)
   if (!is.null(features_col)) params$features_col <- as.character(features_col)

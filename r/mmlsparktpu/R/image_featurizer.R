@@ -10,12 +10,14 @@
 #' @param fused_dispatch scan all minibatches in one dispatch
 #' @param fused_dispatch_budget_mb max input MB eligible for the fused single-dispatch path
 #' @param bfloat16 run the forward in bfloat16 (MXU-native; outputs stay float32)
+#' @param prefetch_depth minibatches prepared ahead of device compute (0 = sequential)
+#' @param shape_buckets pad ragged tails to a pow-2 bucket ladder (vs full batch)
 #' @param cut_output_layers how many layers to cut from the output
 #' @param layer_name explicit layer path (overrides cut_output_layers)
 #' @param output_col featurized output column
 #' @param resize_to (h, w) to resize inputs to the model's input size
 #' @export
-ml_image_featurizer <- function(x, input_col = "features", fetch_dict = NULL, mini_batch_size = 64L, use_mesh = FALSE, fused_dispatch = TRUE, fused_dispatch_budget_mb = 512L, bfloat16 = FALSE, cut_output_layers = 1L, layer_name = NULL, output_col = "features_out", resize_to = NULL)
+ml_image_featurizer <- function(x, input_col = "features", fetch_dict = NULL, mini_batch_size = 64L, use_mesh = FALSE, fused_dispatch = TRUE, fused_dispatch_budget_mb = 512L, bfloat16 = FALSE, prefetch_depth = 2L, shape_buckets = TRUE, cut_output_layers = 1L, layer_name = NULL, output_col = "features_out", resize_to = NULL)
 {
   params <- list()
   if (!is.null(input_col)) params$input_col <- as.character(input_col)
@@ -25,6 +27,8 @@ ml_image_featurizer <- function(x, input_col = "features", fetch_dict = NULL, mi
   if (!is.null(fused_dispatch)) params$fused_dispatch <- as.logical(fused_dispatch)
   if (!is.null(fused_dispatch_budget_mb)) params$fused_dispatch_budget_mb <- as.integer(fused_dispatch_budget_mb)
   if (!is.null(bfloat16)) params$bfloat16 <- as.logical(bfloat16)
+  if (!is.null(prefetch_depth)) params$prefetch_depth <- as.integer(prefetch_depth)
+  if (!is.null(shape_buckets)) params$shape_buckets <- as.logical(shape_buckets)
   if (!is.null(cut_output_layers)) params$cut_output_layers <- as.integer(cut_output_layers)
   if (!is.null(layer_name)) params$layer_name <- as.character(layer_name)
   if (!is.null(output_col)) params$output_col <- as.character(output_col)

@@ -276,6 +276,14 @@ def _nn_objects(ctx) -> dict[str, list[TestObject]]:
         "mmlspark_tpu.nn.runner.DeepModelTransformer": [TestObject(
             DeepModelTransformer(input_col="features").set_model(_mlp_bundle(8, 3)),
             transform_table=f_table,
+        ), TestObject(
+            # async data plane knobs: pipelined non-fused loop with a
+            # bucketed ragged tail (12 rows, bs 8 -> buckets 8 + 4)
+            DeepModelTransformer(
+                input_col="features", fused_dispatch=False,
+                mini_batch_size=8, prefetch_depth=1, shape_buckets=True,
+            ).set_model(_mlp_bundle(8, 3)),
+            transform_table=f_table,
         )],
         "mmlspark_tpu.nn.featurizer.ImageFeaturizer": [TestObject(
             ImageFeaturizer(input_col="image").set_model(
@@ -289,6 +297,16 @@ def _nn_objects(ctx) -> dict[str, list[TestObject]]:
                 epochs=2, batch_size=32, use_mesh=False, bfloat16=False, seed=5,
             ),
             fit_table=_vec_table(n=64, f=8),
+            model_class="mmlspark_tpu.nn.trainer.DNNModel",
+        ), TestObject(
+            # streamed epoch loop with batch prefetch (the data plane's
+            # trainer knob; fused_epochs off so the loop actually runs)
+            DNNLearner(
+                architecture="mlp", model_config={"features": (8,)},
+                epochs=1, batch_size=16, use_mesh=False, bfloat16=False,
+                seed=6, fused_epochs=False, prefetch_depth=2,
+            ),
+            fit_table=_vec_table(n=48, f=8),
             model_class="mmlspark_tpu.nn.trainer.DNNModel",
         )],
     }

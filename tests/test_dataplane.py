@@ -1,0 +1,613 @@
+"""Async data plane: prefetcher / bucketer / executable cache / lookahead.
+
+The contract under test everywhere: pipelining changes WHEN host work
+happens, never WHAT is produced. Runner and trainer outputs are
+byte-identical at prefetch depth 0/1/2, a streaming query's exactly-once
+parquet output survives kill-restart chaos with the source lookahead on,
+and a serving soak over mixed batch sizes stops recompiling once the
+bucket ladder is warm.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataplane import (
+    AsyncReadback,
+    ExecutableCache,
+    Lookahead,
+    Prefetcher,
+    ShapeBucketer,
+    cache_stats,
+    reset_cache_stats,
+)
+from mmlspark_tpu.core.schema import Table
+
+
+# --------------------------------------------------------------------- #
+# ShapeBucketer
+# --------------------------------------------------------------------- #
+
+
+class TestShapeBucketer:
+    def test_pow2_ladder_up_to_max(self):
+        b = ShapeBucketer(64)
+        assert b.ladder == (1, 2, 4, 8, 16, 32, 64)
+
+    def test_non_pow2_max_caps_the_ladder(self):
+        b = ShapeBucketer(48)
+        assert b.ladder == (1, 2, 4, 8, 16, 32, 48)
+
+    def test_multiple_of_rounds_every_bucket(self):
+        # mesh divisibility: every bucket must divide over the data axis
+        b = ShapeBucketer(64, multiple_of=8)
+        assert b.ladder == (8, 16, 32, 64)
+        assert all(x % 8 == 0 for x in b.ladder)
+
+    def test_bucket_for_picks_smallest_fit(self):
+        b = ShapeBucketer(64)
+        assert b.bucket_for(1) == 1
+        assert b.bucket_for(3) == 4
+        assert b.bucket_for(33) == 64
+        assert b.bucket_for(64) == 64
+
+    def test_bucket_for_rejects_oversize(self):
+        with pytest.raises(ValueError, match="exceed"):
+            ShapeBucketer(16).bucket_for(17)
+
+    def test_pad_repeats_last_row_and_masks_real_rows(self):
+        b = ShapeBucketer(8)
+        x = np.arange(6, dtype=np.float32).reshape(3, 2)
+        padded, mask = b.pad(x)
+        assert padded.shape == (4, 2)
+        np.testing.assert_array_equal(padded[3], x[-1])
+        np.testing.assert_array_equal(mask, [True, True, True, False])
+
+    def test_pad_exact_bucket_is_a_noop(self):
+        b = ShapeBucketer(8)
+        x = np.ones((4, 2), np.float32)
+        padded, mask = b.pad(x)
+        assert padded is x and mask.all()
+
+    def test_pad_empty_batch_raises(self):
+        with pytest.raises(ValueError, match="empty"):
+            ShapeBucketer(8).pad(np.empty((0, 2), np.float32))
+
+
+# --------------------------------------------------------------------- #
+# ExecutableCache
+# --------------------------------------------------------------------- #
+
+
+class TestExecutableCache:
+    def test_hit_miss_recompile_counters(self):
+        c = ExecutableCache()
+        built = []
+
+        def builder(tag):
+            def build():
+                built.append(tag)
+                return tag
+            return build
+
+        assert c.get_or_build("fam", (8,), builder("a")) == "a"
+        assert c.stats() == {"hits": 0, "misses": 1, "recompiles": 0,
+                             "entries": 1}
+        # same family+shape: hit, builder NOT rerun
+        assert c.get_or_build("fam", (8,), builder("b")) == "a"
+        assert c.hits == 1 and built == ["a"]
+        # same family, NEW shape: the recompile signal
+        c.get_or_build("fam", (4,), builder("c"))
+        assert c.misses == 2 and c.recompiles == 1
+        # new family at its first shape is a plain miss, not a recompile
+        c.get_or_build("fam2", (8,), builder("d"))
+        assert c.misses == 3 and c.recompiles == 1
+
+    def test_global_stats_aggregate_across_caches(self):
+        reset_cache_stats()
+        c1, c2 = ExecutableCache(), ExecutableCache()
+        c1.get_or_build("f", (1,), lambda: 1)
+        c2.get_or_build("f", (1,), lambda: 2)
+        c2.get_or_build("f", (1,), lambda: 3)
+        g = cache_stats()
+        assert g["misses"] == 2 and g["hits"] == 1
+
+    def test_clear_empties_entries_and_family_shapes(self):
+        c = ExecutableCache()
+        c.get_or_build("f", (1,), lambda: 1)
+        c.clear()
+        assert len(c) == 0
+        c.get_or_build("f", (2,), lambda: 2)
+        # post-clear the family history is gone: first shape, no recompile
+        assert c.recompiles == 0
+
+
+# --------------------------------------------------------------------- #
+# Prefetcher / AsyncReadback / Lookahead
+# --------------------------------------------------------------------- #
+
+
+class TestPrefetcher:
+    @pytest.mark.parametrize("depth", [0, 1, 2, 5])
+    def test_yields_prepared_items_in_order(self, depth):
+        out = list(Prefetcher(range(20), lambda i: i * i, depth=depth))
+        assert out == [i * i for i in range(20)]
+
+    @pytest.mark.parametrize("depth", [0, 2])
+    def test_prepare_exception_propagates_to_consumer(self, depth):
+        def prep(i):
+            if i == 3:
+                raise RuntimeError("boom at 3")
+            return i
+
+        pf = Prefetcher(range(10), prep, depth=depth)
+        got = []
+        with pytest.raises(RuntimeError, match="boom at 3"):
+            for v in pf:
+                got.append(v)
+        assert got == [0, 1, 2]
+
+    def test_bounded_depth_limits_readahead(self):
+        prepared = []
+        gate = threading.Event()
+
+        def prep(i):
+            prepared.append(i)
+            return i
+
+        pf = Prefetcher(range(10), prep, depth=2)
+        it = iter(pf)
+        assert next(it) == 0
+        # depth 2: with one item consumed the producer may sit at most at
+        # item 3 (queue holds 1,2 and one more in flight)
+        gate.wait(0.2)
+        assert len(prepared) <= 4
+        pf.close()
+
+    def test_abandoned_iteration_joins_the_producer(self):
+        pf = Prefetcher(range(1000), lambda i: i, depth=2)
+        it = iter(pf)
+        next(it)
+        it.close()                      # generator close -> Prefetcher.close
+        assert pf._thread is not None and not pf._thread.is_alive()
+
+    def test_stats_and_overlap_fraction(self):
+        pf = Prefetcher(range(5), lambda i: i, depth=0)
+        list(pf)
+        assert pf.stats["items"] == 5
+        # depth 0 is serial by definition
+        assert pf.overlap_fraction() == 0.0
+
+        pf2 = Prefetcher(range(8), lambda i: time.sleep(0.002) or i, depth=2)
+        consumed = []
+        for v in pf2:
+            time.sleep(0.004)           # consumer slower than producer
+            consumed.append(v)
+        assert consumed == list(range(8))
+        # nearly all prepare time hides behind the consumer's work
+        assert pf2.overlap_fraction() > 0.5
+
+
+class TestAsyncReadback:
+    def test_lag_window_defers_fetch(self):
+        fetched = []
+        rb = AsyncReadback(lambda v: fetched.append(v) or v * 10, lag=1)
+        assert rb.push(1) == []
+        assert rb.push(2) == [10]
+        assert rb.push(3) == [20]
+        assert rb.drain() == [30]
+        assert fetched == [1, 2, 3]
+
+    def test_lag_zero_is_synchronous(self):
+        rb = AsyncReadback(lambda v: v, lag=0)
+        assert rb.push(7) == [7]
+        assert rb.drain() == []
+
+
+class TestLookahead:
+    def test_matching_key_is_a_hit(self):
+        la = Lookahead()
+        la.submit("k1", lambda: 42)
+        hit, val = la.take("k1")
+        assert hit and val == 42 and la.hits == 1
+
+    def test_mismatched_key_discards_the_result(self):
+        la = Lookahead()
+        la.submit("k1", lambda: 42)
+        hit, val = la.take("other")
+        assert not hit and val is None and la.misses == 1
+        # slot consumed either way
+        assert not la.take("k1")[0]
+
+    def test_failed_read_is_a_miss_not_a_raise(self):
+        la = Lookahead()
+        la.submit("k", lambda: (_ for _ in ()).throw(IOError("flaky")))
+        hit, val = la.take("k")
+        assert not hit and val is None
+
+    def test_resubmit_discards_previous_slot(self):
+        la = Lookahead()
+        la.submit("k1", lambda: 1)
+        la.submit("k2", lambda: 2)
+        hit, val = la.take("k2")
+        assert hit and val == 2
+
+    def test_discard_joins_the_thread(self):
+        la = Lookahead()
+        la.submit("k", lambda: time.sleep(0.01) or 5)
+        la.discard()
+        assert not la.pending and not la.take("k")[0]
+
+
+# --------------------------------------------------------------------- #
+# pipelined-vs-sequential equivalence: runner + trainer
+# --------------------------------------------------------------------- #
+
+
+def _mlp_bundle(f=8, outputs=3):
+    from mmlspark_tpu.nn.models import ModelBundle
+
+    return ModelBundle.init("mlp", (f,), seed=0, num_outputs=outputs)
+
+
+class TestRunnerPipelineEquivalence:
+    def test_outputs_byte_identical_across_prefetch_depths(self):
+        from mmlspark_tpu.nn.runner import DeepModelTransformer
+
+        rng = np.random.default_rng(0)
+        table = Table({"features": rng.normal(size=(150, 8)).astype(np.float32)})
+        bundle = _mlp_bundle()
+        outs = {}
+        for depth in (0, 1, 2):
+            r = DeepModelTransformer(
+                input_col="features", mini_batch_size=64,
+                fused_dispatch=False, prefetch_depth=depth,
+            ).set_model(bundle)
+            outs[depth] = np.asarray(r.transform(table)["output"])
+        assert outs[0].tobytes() == outs[1].tobytes() == outs[2].tobytes()
+
+    def test_bucketed_tail_matches_full_batch_padding(self):
+        from mmlspark_tpu.nn.runner import DeepModelTransformer
+
+        rng = np.random.default_rng(1)
+        table = Table({"features": rng.normal(size=(70, 8)).astype(np.float32)})
+        bundle = _mlp_bundle()
+        got = {}
+        for buckets in (True, False):
+            r = DeepModelTransformer(
+                input_col="features", mini_batch_size=64,
+                fused_dispatch=False, shape_buckets=buckets,
+            ).set_model(bundle)
+            got[buckets] = np.asarray(r.transform(table)["output"])
+        # row-independent forward: pad-to-8 vs pad-to-64 tails score alike
+        np.testing.assert_allclose(got[True], got[False], rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_pipeline_stats_and_cache_counters_populate(self):
+        from mmlspark_tpu.nn.runner import DeepModelTransformer
+
+        rng = np.random.default_rng(2)
+        table = Table({"features": rng.normal(size=(150, 8)).astype(np.float32)})
+        r = DeepModelTransformer(
+            input_col="features", mini_batch_size=64, fused_dispatch=False,
+        ).set_model(_mlp_bundle())
+        r.transform(table)
+        s1 = dict(r.last_pipeline_stats)
+        # 150 rows / bs 64 -> two shapes: full 64s + a 32-bucket tail
+        assert s1["misses"] == 2 and s1["bucket_ladder"][-1] == 64
+        r.transform(table)
+        s2 = r.last_pipeline_stats
+        # steady state: every shape already compiled
+        assert s2["misses"] == 2 and s2["hits"] > s1["hits"]
+        assert 0.0 <= s2["overlap_fraction"] <= 1.0
+
+    def test_pipelined_matches_fused_dispatch(self):
+        from mmlspark_tpu.nn.runner import DeepModelTransformer
+
+        rng = np.random.default_rng(3)
+        table = Table({"features": rng.normal(size=(100, 8)).astype(np.float32)})
+        bundle = _mlp_bundle()
+        fused = DeepModelTransformer(
+            input_col="features", mini_batch_size=32).set_model(bundle)
+        piped = DeepModelTransformer(
+            input_col="features", mini_batch_size=32,
+            fused_dispatch=False).set_model(bundle)
+        np.testing.assert_allclose(
+            np.asarray(fused.transform(table)["output"]),
+            np.asarray(piped.transform(table)["output"]),
+            rtol=1e-5, atol=1e-6)
+
+
+class TestTrainerPipelineEquivalence:
+    def test_training_byte_identical_across_prefetch_depths(self):
+        from mmlspark_tpu.nn.trainer import DNNLearner
+
+        rng = np.random.default_rng(4)
+        x = rng.normal(size=(96, 8)).astype(np.float32)
+        y = (rng.random(96) * 3).astype(np.int64)
+        table = Table({"features": x, "label": y})
+        preds = {}
+        for depth in (0, 1, 2):
+            learner = DNNLearner(
+                architecture="mlp", model_config={"features": (16,)},
+                epochs=2, batch_size=32, use_mesh=False, bfloat16=False,
+                seed=11, fused_epochs=False, prefetch_depth=depth,
+            )
+            model = learner.fit(table)
+            preds[depth] = np.asarray(
+                model.transform(table)["raw_prediction"])
+        assert preds[0].tobytes() == preds[1].tobytes() == preds[2].tobytes()
+
+
+# --------------------------------------------------------------------- #
+# streaming: source lookahead
+# --------------------------------------------------------------------- #
+
+
+class TestStreamingLookahead:
+    def _csv_dir(self, tmp_path, n_files=6, rows_per=4):
+        from mmlspark_tpu.core.table_io import write_csv
+
+        d = str(tmp_path / "in")
+        os.makedirs(d, exist_ok=True)
+        for i in range(n_files):
+            base = float(i * rows_per)
+            write_csv(Table({"x": np.arange(base, base + rows_per)}),
+                      os.path.join(d, f"c-{i:03d}.csv"))
+        return d, n_files * rows_per
+
+    @pytest.mark.parametrize("lookahead", [0, 1])
+    def test_drain_produces_identical_output(self, tmp_path, lookahead):
+        from mmlspark_tpu.streaming import DirectorySource, MemorySink, StreamingQuery
+
+        d, total = self._csv_dir(tmp_path)
+        q = StreamingQuery(
+            DirectorySource(d, max_files_per_trigger=1),
+            lambda t: t.with_column("y", np.asarray(t["x"]) * 2.0),
+            MemorySink(), source_lookahead=lookahead)
+        n = q.process_all_available()
+        assert n == 6
+        out = q.sink.table()
+        np.testing.assert_array_equal(
+            np.asarray(out["y"], np.float64), np.arange(total) * 2.0)
+        if lookahead:
+            # batches 2..6 rode the background read of the previous tick
+            assert q.last_progress["lookahead_hits"] >= 4
+        q.stop()
+
+    def test_data_arriving_after_lookahead_is_not_missed(self):
+        from mmlspark_tpu.streaming import MemorySink, MemorySource, StreamingQuery
+
+        src = MemorySource()
+        q = StreamingQuery(src, None, MemorySink(), source_lookahead=1)
+        src.add_rows(Table({"x": np.arange(3.0)}))
+        assert q.process_all_available() == 1
+        # the pending lookahead saw an empty source when it ran; rows
+        # added afterwards must still be picked up on the next drain
+        src.add_rows(Table({"x": np.arange(3.0, 6.0)}))
+        assert q.process_all_available() == 1
+        np.testing.assert_array_equal(
+            np.asarray(q.sink.table()["x"]), np.arange(6.0))
+        q.stop()
+
+    def test_kill_restart_exactly_once_with_lookahead(self, tmp_path):
+        """The chaos-soak contract from tests/test_resilience.py, with the
+        source lookahead doing the reads: seeded faults + a mid-stream
+        kill + a second lifetime over the same checkpoint still produce
+        byte-identical parquet output."""
+        pytest.importorskip("pyarrow")
+        from mmlspark_tpu.core.table_io import write_csv
+        from mmlspark_tpu.resilience import (
+            ChaosTransformer, FakeClock, FaultInjector, QuerySupervisor,
+            RestartPolicy, RetryPolicy,
+        )
+        from mmlspark_tpu.streaming import DirectorySource, ParquetSink, StreamingQuery
+
+        n_files, rows_per = 10, 5
+        d, _ = self._csv_dir(tmp_path, n_files=n_files, rows_per=rows_per)
+        out_dir = str(tmp_path / "out")
+        ck = str(tmp_path / "ck")
+        transform = ChaosTransformer(seed=13, exception_prob=0.25)
+        chaos_clock = FakeClock()
+
+        def parts_written():
+            if not os.path.isdir(out_dir):
+                return 0
+            return sum(1 for f in os.listdir(out_dir)
+                       if f.startswith("part-") and f.endswith(".parquet"))
+
+        def wait_until(cond, timeout_s=30.0):
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                if cond():
+                    return True
+                time.sleep(0.002)
+            return False
+
+        def run_phase(seed, until_parts):
+            src_chaos = FaultInjector(seed=seed, exception_prob=0.2,
+                                      latency_prob=0.3, latency_s=0.05,
+                                      clock=chaos_clock)
+            q = StreamingQuery(
+                src_chaos.wrap_source(
+                    DirectorySource(d, max_files_per_trigger=1)),
+                transform, ParquetSink(out_dir),
+                checkpoint_dir=ck, trigger_interval_s=0.001,
+                source_lookahead=1,
+                batch_retry_policy=RetryPolicy(max_retries=1,
+                                               backoffs_ms=[0.0]))
+            sup = QuerySupervisor(
+                q,
+                RestartPolicy(max_restarts=500, window_s=1e6,
+                              backoff=RetryPolicy(max_retries=500,
+                                                  backoffs_ms=[0.0])),
+                poll_interval_s=0.001)
+            sup.start()
+            assert wait_until(lambda: parts_written() >= until_parts), \
+                f"stalled at {parts_written()} parts (state={sup.state})"
+            return q, sup, src_chaos
+
+        # phase 1: run to ~half the stream, then KILL (no clean close)
+        q1, sup1, src1 = run_phase(seed=101, until_parts=n_files // 2)
+        sup1._stop.set()
+        q1._stop.set()
+        q1.await_termination(10)
+        sup1.await_terminal(10)
+
+        # phase 2: fresh lifetime over the same checkpoint, to completion
+        q2, sup2, src2 = run_phase(seed=202, until_parts=n_files)
+        sup2.stop()
+
+        # faults really fired — this was not a fair-weather run
+        assert src1.injected["exception"] + src2.injected["exception"] > 0
+
+        streamed = ParquetSink(out_dir).table()
+        expected = np.arange(float(n_files * rows_per))
+        got = np.asarray(streamed["x"], dtype=np.float64)
+        np.testing.assert_array_equal(got, expected)
+        assert streamed["x"].tobytes() == expected.tobytes()
+
+
+# --------------------------------------------------------------------- #
+# serving: bucket ladder + executable-cache observability
+# --------------------------------------------------------------------- #
+
+
+def _post(url: str, payload: dict, timeout=10) -> dict:
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _get(url: str, timeout=10) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+class TestServingBuckets:
+    def test_batcher_pads_to_bucket_and_slices_replies(self):
+        from mmlspark_tpu.io_http.schema import make_reply, parse_request
+        from mmlspark_tpu.io_http.serving import ServingServer
+
+        batch_sizes = []
+
+        def handler(table):
+            t = parse_request(table)
+            batch_sizes.append(len(t))
+            return make_reply(
+                t.with_column("y", np.asarray(t["x"]) * 2), "y")
+
+        srv = ServingServer(handler, max_batch_size=16,
+                            bucket_batches=True).start()
+        try:
+            for i in range(5):
+                out = _post(srv.url, {"x": float(i)})
+                assert out == {"y": float(i) * 2}
+        finally:
+            srv.stop()
+        # every scored batch size is on the ladder, never a ragged count
+        ladder = set(ShapeBucketer(16).ladder)
+        assert batch_sizes and all(b in ladder for b in batch_sizes)
+
+    def test_mixed_size_soak_has_zero_steady_state_recompiles(self):
+        """The acceptance bar: once the ladder is warm, a soak of mixed-
+        size request batches never compiles a fresh executable."""
+        from mmlspark_tpu.io_http.serving import serve_model
+        from mmlspark_tpu.nn.runner import DeepModelTransformer
+
+        scorer = DeepModelTransformer(
+            input_col="features", mini_batch_size=16, fused_dispatch=False,
+        ).set_model(_mlp_bundle(2, 2))
+        # warm every ladder bucket DETERMINISTICALLY through the scorer
+        # (the serving handler stacks requests into float64 (n, 2)
+        # features; the batcher's coalesced sizes are timing-dependent,
+        # so HTTP traffic alone can't guarantee full ladder coverage)
+        for n in ShapeBucketer(16).ladder:
+            scorer.transform(Table({"features": np.ones((n, 2), np.float64)}))
+        srv = serve_model(scorer, input_cols=["a", "b"], output_col="output",
+                          max_batch_size=16)
+        try:
+            def fire(n):
+                """n concurrent posts -> the batcher scores them together
+                (sizes vary with timing; the ladder absorbs all of them)."""
+                errs = []
+
+                def one(i):
+                    try:
+                        _post(srv.url, {"a": float(i), "b": 1.0})
+                    except Exception as e:  # noqa: BLE001
+                        errs.append(repr(e))
+
+                ts = [threading.Thread(target=one, args=(i,))
+                      for i in range(n)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join(timeout=30)
+                assert not errs, errs
+
+            # a little live traffic, then snapshot the warm counters
+            for n in (1, 4, 8):
+                fire(n)
+            warm = _get(srv.url)
+            # soak: mixed sizes, all inside the warmed ladder
+            for n in (3, 7, 1, 12, 16, 2, 9, 5):
+                fire(n)
+            soaked = _get(srv.url)
+        finally:
+            srv.stop()
+        assert soaked["executable_cache_recompiles"] == \
+            warm["executable_cache_recompiles"]
+        assert soaked["executable_cache_misses"] == \
+            warm["executable_cache_misses"]
+        assert soaked["executable_cache_hits"] > warm["executable_cache_hits"]
+
+    def test_info_endpoint_reports_cache_and_ladder(self):
+        from mmlspark_tpu.io_http.schema import make_reply, parse_request
+        from mmlspark_tpu.io_http.serving import ServingServer
+
+        def handler(table):
+            t = parse_request(table)
+            return make_reply(t.with_column("y", np.asarray(t["x"])), "y")
+
+        srv = ServingServer(handler, max_batch_size=8,
+                            bucket_batches=True).start()
+        try:
+            _post(srv.url, {"x": 1.0})
+            info = _get(srv.url)
+        finally:
+            srv.stop()
+        assert info["bucket_ladder"] == [1, 2, 4, 8]
+        for k in ("executable_cache_hits", "executable_cache_misses",
+                  "executable_cache_recompiles", "shed", "expired"):
+            assert isinstance(info[k], int)
+
+    def test_bucketing_off_keeps_raw_batch_sizes(self):
+        from mmlspark_tpu.io_http.schema import make_reply, parse_request
+        from mmlspark_tpu.io_http.serving import ServingServer
+
+        batch_sizes = []
+
+        def handler(table):
+            t = parse_request(table)
+            batch_sizes.append(len(t))
+            return make_reply(
+                t.with_column("y", np.asarray(t["x"])), "y")
+
+        srv = ServingServer(handler, max_batch_size=16).start()
+        try:
+            _post(srv.url, {"x": 1.0})
+        finally:
+            srv.stop()
+        # default (off): a single request is scored as a batch of one —
+        # side-effectful handlers must never see padded duplicates
+        assert batch_sizes == [1]

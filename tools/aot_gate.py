@@ -80,6 +80,23 @@ def flash_build(t, grad=False):
             (q, q, q))
 
 
+def runner_bucket_build(n):
+    """Pipelined model-runner forward at ONE shape-bucket ladder size.
+
+    The async data plane (core/dataplane.py) pads ragged tails to a pow-2
+    bucket ladder instead of the full batch, so at serve time any ladder
+    shape may be dispatched — each one is a distinct XLA program and must
+    compile. Gating every bucket here is what makes "zero steady-state
+    recompiles" a pre-verified fact rather than a first-request surprise."""
+    from mmlspark_tpu.nn.models import ModelBundle
+    from mmlspark_tpu.nn.runner import DeepModelTransformer
+
+    t = DeepModelTransformer(input_col="x", fused_dispatch=False)
+    t.set_model(ModelBundle.init("mlp", (8,), seed=0, num_outputs=3))
+    fwd = t._forward_fn(("logits",))
+    return fwd, (t.bundle.variables, sds((n, 8), jnp.float32))
+
+
 def main():
     dev = jax.devices()[0]
     print(f"device: {dev.platform} {getattr(dev, 'device_kind', '?')}",
@@ -100,6 +117,11 @@ def main():
     gate("flash_fwd_seq512", lambda: flash_build(512))
     gate("flash_fwd_seq4096", lambda: flash_build(4096))
     gate("flash_fwd_bwd_seq512", lambda: flash_build(512, grad=True))
+
+    from mmlspark_tpu.core.dataplane import ShapeBucketer
+    for bucket in ShapeBucketer(64).ladder:
+        gate(f"runner_bucket_b{bucket}",
+             lambda n=bucket: runner_bucket_build(n))
 
     n_fail = sum(1 for _, v, _, _ in VERDICTS if v == "FAIL")
     print(f"\nAOT GATE SUMMARY: {len(VERDICTS) - n_fail}/{len(VERDICTS)} "
