@@ -14,7 +14,8 @@ def __getattr__(name):
     # heavy subsystems import lazily so `import mmlspark_tpu` stays fast
     if name in ("nn", "image", "gbdt", "ops", "automl", "text",
                 "recommendation", "io_http", "utils", "plot", "native",
-                "parallel", "core", "streaming", "resilience"):
+                "parallel", "core", "streaming", "resilience",
+                "observability"):
         import importlib
 
         return importlib.import_module(f".{name}", __name__)

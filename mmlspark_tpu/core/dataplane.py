@@ -97,6 +97,27 @@ class Prefetcher:
         hidden = max(prep - self.stats["wait_seconds"], 0.0)
         return min(hidden / prep, 1.0)
 
+    def _gauges(self):
+        """(queue_depth, overlap) gauge children for this prefetcher, or
+        (None, None) when telemetry is unavailable. Resolved lazily at
+        iteration start — never at import — to keep this module free of
+        package-load ordering."""
+        try:
+            from ..observability.metrics import get_registry
+
+            reg = get_registry()
+            depth = reg.gauge(
+                "mmlspark_tpu_dataplane_prefetch_queue_depth",
+                "prepared items parked in the prefetch queue",
+                labels=("name",)).labels(name=self.name)
+            overlap = reg.gauge(
+                "mmlspark_tpu_dataplane_overlap_ratio",
+                "fraction of prepare cost hidden behind consumer work",
+                labels=("name",)).labels(name=self.name)
+            return depth, overlap
+        except Exception:
+            return None, None
+
     # -- synchronous path (depth 0) ------------------------------------- #
 
     def _iter_sync(self) -> Iterator[Any]:
@@ -139,6 +160,7 @@ class Prefetcher:
         self._thread = threading.Thread(
             target=self._worker, name=f"dataplane-{self.name}", daemon=True)
         self._thread.start()
+        g_depth, g_overlap = self._gauges()
         try:
             while True:
                 t0 = time.perf_counter()
@@ -149,8 +171,13 @@ class Prefetcher:
                 if isinstance(out, _Raised):
                     raise out.exc
                 self.stats["items"] += 1
+                if g_depth is not None:
+                    g_depth.set(self._queue.qsize())
                 yield out
         finally:
+            if g_overlap is not None:
+                g_overlap.set(self.overlap_fraction())
+                g_depth.set(0)
             self.close()
 
     def close(self) -> None:
@@ -284,6 +311,22 @@ def reset_cache_stats() -> None:
     with _GLOBAL_STATS_LOCK:
         for k in _GLOBAL_STATS:
             _GLOBAL_STATS[k] = 0
+
+
+def ensure_cache_metrics(registry=None) -> None:
+    """Expose the process-wide executable-cache counters as pull-style
+    telemetry series (scraped from `/metrics`). Idempotent; the import is
+    deferred so this module stays importable before the package finishes
+    loading (observability itself imports core.pipeline)."""
+    from ..observability.metrics import get_registry
+
+    reg = registry if registry is not None else get_registry()
+    for key in ("hits", "misses", "recompiles"):
+        name = f"mmlspark_tpu_executable_cache_{key}_total"
+        if not reg.has(name):
+            reg.register_callback(
+                name, f"executable-cache {key} across all caches",
+                (lambda k=key: cache_stats()[k]), kind="counter")
 
 
 class ExecutableCache:

@@ -2,36 +2,105 @@
 
 Reference: `core/env/src/main/scala/Logging.scala:14-23` (log4j logger with
 config-derived root). TPU-first: std-lib logging under root "mmlspark_tpu",
-level from config key `log.level` (env MMLSPARK_TPU_LOG__LEVEL).
+level from config key `log.level` (env MMLSPARK_TPU_LOG__LEVEL), format from
+`log.format` (env MMLSPARK_TPU_LOG__FORMAT) — "text" (default) or "json".
+
+The JSON formatter stamps the active trace context on every record: the
+current span's trace_id/span_id plus the nearest `batch_id` span argument,
+so log lines from inside a streaming micro-batch join to the exported
+trace without any caller plumbing.
+
+The first `get_logger` call configures the root once; `set_level` and
+`reconfigure` re-open that decision at runtime (the original module
+latched `_configured` forever, so a config change after the first log
+line was silently ignored).
 """
 
 from __future__ import annotations
 
+import json
 import logging
 
 from .config import get_config
 
-__all__ = ["get_logger"]
+__all__ = ["get_logger", "set_level", "reconfigure", "JsonFormatter"]
 
 _ROOT = "mmlspark_tpu"
 _configured = False
+_handler: "logging.Handler | None" = None
+
+
+class JsonFormatter(logging.Formatter):
+    """One JSON object per line; opt-in via log.format=json. Trace fields
+    come from the process-default tracer's active span (lazy import — this
+    module loads long before observability does)."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        doc = {
+            "ts": self.formatTime(record),
+            "level": record.levelname,
+            "logger": record.name,
+            "message": record.getMessage(),
+        }
+        if record.exc_info:
+            doc["exc"] = self.formatException(record.exc_info)
+        try:
+            from ..observability.tracing import get_tracer
+
+            span = get_tracer().current_span()
+            if span is not None:
+                doc["trace_id"] = span.trace_id
+                doc["span_id"] = span.span_id
+                batch_id = span.find_arg("batch_id")
+                if batch_id is not None:
+                    doc["batch_id"] = batch_id
+        except Exception:
+            pass
+        return json.dumps(doc)
+
+
+def _make_formatter() -> logging.Formatter:
+    fmt = str(get_config("log.format", "text")).lower()
+    if fmt == "json":
+        return JsonFormatter()
+    return logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
 
 
 def _configure() -> None:
-    global _configured
+    global _configured, _handler
     if _configured:
         return
     logger = logging.getLogger(_ROOT)
     if not logger.handlers:
-        handler = logging.StreamHandler()
-        handler.setFormatter(
-            logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
-        )
-        logger.addHandler(handler)
+        _handler = logging.StreamHandler()
+        _handler.setFormatter(_make_formatter())
+        logger.addHandler(_handler)
     level = str(get_config("log.level", "WARNING")).upper()
     logger.setLevel(getattr(logging, level, logging.WARNING))
     logger.propagate = False
     _configured = True
+
+
+def reconfigure() -> None:
+    """Re-read log.level and log.format from config and re-apply them —
+    the un-latch for `_configured` (config edits after the first log line
+    take effect here)."""
+    global _configured
+    _configure()
+    logger = logging.getLogger(_ROOT)
+    level = str(get_config("log.level", "WARNING")).upper()
+    logger.setLevel(getattr(logging, level, logging.WARNING))
+    if _handler is not None:
+        _handler.setFormatter(_make_formatter())
+    _configured = True
+
+
+def set_level(level: "str | int") -> None:
+    """Set the root level directly (accepts "DEBUG"/"info"/logging.INFO)."""
+    _configure()
+    if isinstance(level, str):
+        level = getattr(logging, level.upper(), logging.WARNING)
+    logging.getLogger(_ROOT).setLevel(level)
 
 
 def get_logger(name: str | None = None) -> logging.Logger:

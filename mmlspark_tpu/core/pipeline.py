@@ -175,6 +175,18 @@ class Timer(Transformer):
         get_logger("timer").info(
             "%s.transform took %.4fs", type(inner).__name__, self.last_elapsed
         )
+        # also land the measurement in the process registry (lazy import:
+        # observability's package init imports THIS module)
+        try:
+            from ..observability.metrics import get_registry
+
+            get_registry().histogram(
+                "mmlspark_tpu_pipeline_stage_seconds",
+                "pipeline stage transform wall time",
+                labels=("stage",)).labels(
+                    stage=type(inner).__name__).observe(self.last_elapsed)
+        except Exception:
+            pass
         return out
 
     def _save_state(self) -> dict[str, Any]:

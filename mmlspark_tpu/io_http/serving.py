@@ -41,6 +41,10 @@ from .schema import HTTPRequestData, HTTPResponseData, make_reply, parse_request
 __all__ = ["ServingServer", "ServingFleet", "MicroBatchQuery", "serve_model",
            "ServiceInfo", "FleetRendezvous"]
 
+# unique `server=` label per ServingServer in this process: the registry is
+# shared, the per-server counts must stay exact (tests assert them)
+_SERVER_SEQ = itertools.count()
+
 
 def _handler_error_response(e: Exception) -> "HTTPResponseData":
     """Uniform 500 payload for a failed scoring batch (continuous and
@@ -101,6 +105,7 @@ class ServingServer:
         request_deadline_s: float | None = None,
         drain_timeout_s: float = 5.0,
         bucket_batches: bool = False,
+        metrics: Any = None,
     ):
         if mode not in ("continuous", "batch"):
             raise ValueError(f"mode must be 'continuous' or 'batch', got {mode!r}")
@@ -167,16 +172,71 @@ class ServingServer:
             for ex_id, req in self.journal.unanswered().items():
                 self._pending[ex_id] = _Exchange(req)
         # serving counters (reference requestsSeen/Accepted/Answered,
-        # DistributedHTTPSource.scala:98-107); incremented from concurrent
-        # ThreadingHTTPServer handler threads, so guarded by a lock
-        self.requests_seen = 0
-        self.requests_answered = 0
-        self.requests_shed = 0      # refused with 503 (overload / draining)
-        self.requests_expired = 0   # answered 504 past their deadline
+        # DistributedHTTPSource.scala:98-107), registry-backed so one
+        # GET /metrics scrape covers every server in the process; each
+        # server owns uniquely-labeled children and the requests_*
+        # properties read them back, keeping per-server accounting exact.
+        # Imports are deferred: observability's package init pulls in
+        # core.pipeline, and resilience must stay import-order free.
+        from ..core.dataplane import ensure_cache_metrics
+        from ..observability.metrics import get_registry
+        from ..resilience.breaker import ensure_metrics as _breaker_metrics
+
+        self.metrics = metrics if metrics is not None else get_registry()
+        self.server_label = f"srv{next(_SERVER_SEQ)}"
+
+        def _own(name: str, doc: str):
+            return self.metrics.counter(name, doc, labels=("server",)) \
+                .labels(server=self.server_label)
+
+        self._c_seen = _own("mmlspark_tpu_serving_requests_seen_total",
+                            "requests received, any outcome")
+        self._c_accepted = _own("mmlspark_tpu_serving_requests_accepted_total",
+                                "requests admitted past load shedding")
+        self._c_answered = _own("mmlspark_tpu_serving_requests_answered_total",
+                                "requests answered with a scored reply")
+        self._c_shed = _own("mmlspark_tpu_serving_requests_shed_total",
+                            "requests refused 503 (overload / draining)")
+        self._c_expired = _own("mmlspark_tpu_serving_requests_expired_total",
+                               "requests answered 504 past their deadline")
+        self._h_latency = self.metrics.histogram(
+            "mmlspark_tpu_serving_latency_seconds",
+            "service latency, enqueue to reply written",
+            labels=("server",)).labels(server=self.server_label)
+        self._c_bucket = self.metrics.counter(
+            "mmlspark_tpu_serving_bucket_batches_total",
+            "scored batches per bucket-ladder rung",
+            labels=("server", "bucket"))
+        # declare the process-wide executable-cache and breaker families on
+        # this registry so a scrape shows them even before they move
+        ensure_cache_metrics(self.metrics)
+        _breaker_metrics(self.metrics)
         self._draining = False
         self._counter_lock = threading.Lock()
         # rolling service latencies (seconds, enqueue -> reply written)
         self._latencies: collections.deque[float] = collections.deque(maxlen=8192)
+
+    # read-only views over the registry children — the historical int
+    # attributes, same exact per-server values
+    @property
+    def requests_seen(self) -> int:
+        return int(self._c_seen.value)
+
+    @property
+    def requests_accepted(self) -> int:
+        return int(self._c_accepted.value)
+
+    @property
+    def requests_answered(self) -> int:
+        return int(self._c_answered.value)
+
+    @property
+    def requests_shed(self) -> int:
+        return int(self._c_shed.value)
+
+    @property
+    def requests_expired(self) -> int:
+        return int(self._c_expired.value)
 
     # ------------------------------------------------------------------ #
 
@@ -209,8 +269,7 @@ class ServingServer:
                     self.connection.settimeout(self.timeout)
 
             def _handle_post(self):
-                with outer._counter_lock:
-                    outer.requests_seen += 1
+                outer._c_seen.inc()
                 if self.headers.get("Transfer-Encoding"):
                     # chunked bodies aren't framed by Content-Length; reading
                     # them wrong would desync the keep-alive stream — refuse
@@ -231,13 +290,13 @@ class ServingServer:
                 if outer._draining or (
                         outer.max_pending and
                         outer._load() >= outer.max_pending):
-                    with outer._counter_lock:
-                        outer.requests_shed += 1
+                    outer._c_shed.inc()
                     self.send_response(503)
                     self.send_header("Retry-After", "1")
                     self.send_header("Content-Length", "0")
                     self.end_headers()
                     return
+                outer._c_accepted.inc()
                 now = time.perf_counter()
                 ex = _Exchange(HTTPRequestData(
                     method="POST", url=self.path,
@@ -269,8 +328,7 @@ class ServingServer:
                         # connection gets a 504.
                         with outer._counter_lock:
                             outer._pending.pop(ex_id, None)
-                    with outer._counter_lock:
-                        outer.requests_expired += 1
+                    outer._c_expired.inc()
                     self.send_response(504)
                     self.send_header("Content-Length", "0")
                     self.end_headers()
@@ -290,11 +348,25 @@ class ServingServer:
                 self.end_headers()
                 if entity:
                     self.wfile.write(entity)
+                elapsed = time.perf_counter() - ex.enqueued_at
+                outer._c_answered.inc()
+                outer._h_latency.observe(elapsed)
                 with outer._counter_lock:
-                    outer.requests_answered += 1
-                    outer._latencies.append(time.perf_counter() - ex.enqueued_at)
+                    outer._latencies.append(elapsed)
 
-            def do_GET(self):  # noqa: N802 — health/info endpoint
+            def do_GET(self):  # noqa: N802 — health/info + /metrics
+                # Prometheus scrape surface; every other path keeps the
+                # info JSON (FleetRendezvous polls GET / per replica)
+                if self.path.split("?", 1)[0] == "/metrics":
+                    body = outer.metrics.render_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 # process-wide executable-cache counters: steady-state
                 # recompiles staying flat is the bucket ladder working
                 exe = cache_stats()
@@ -405,7 +477,7 @@ class ServingServer:
                     ex.response = HTTPResponseData(
                         504, "deadline exceeded before scoring")
                     ex.event.set()
-                    self.requests_expired += 1
+                    self._c_expired.inc()
             ids = list(self._pending)
             if max_rows is not None:
                 ids = ids[:max_rows]
@@ -484,8 +556,7 @@ class ServingServer:
             expired = [ex for ex in batch
                        if ex.deadline is not None and now > ex.deadline]
             if expired:
-                with self._counter_lock:
-                    self.requests_expired += len(expired)
+                self._c_expired.inc(len(expired))
                 for ex in expired:
                     ex.response = HTTPResponseData(
                         504, "deadline exceeded before scoring")
@@ -498,6 +569,8 @@ class ServingServer:
                 requests = [ex.request for ex in batch]
                 if self.bucketer is not None:
                     target = self.bucketer.bucket_for(len(requests))
+                    self._c_bucket.labels(
+                        server=self.server_label, bucket=str(target)).inc()
                     requests = requests + \
                         [requests[-1]] * (target - len(requests))
                 table = Table({"request": requests})

@@ -26,6 +26,7 @@ from ..core.params import Param
 from ..core.pipeline import Model
 from ..core.schema import SCORE_KIND, Table
 from ..core.serialize import register_stage
+from ..observability.tracing import get_tracer
 from ..parallel.mesh import DATA_AXIS, get_mesh
 from .models import ModelBundle
 
@@ -281,14 +282,20 @@ class DeepModelTransformer(Model):
         readback = AsyncReadback(
             lambda om: tuple(np.asarray(a)[:om[1]] for a in om[0]), lag=1)
         chunks: list[tuple[np.ndarray, ...]] = []
-        for xb, m in prefetch:
-            shape_key = (int(xb.shape[0]), tuple(xb.shape[1:]), str(xb.dtype))
-            # jit compiles once per entry here; the counters make ragged
-            # shapes defeating the ladder visible (recompiles > 0)
-            fn = self._exec_cache.get_or_build(family, shape_key,
-                                               lambda: apply_fn)
-            chunks.extend(readback.push((fn(variables, xb), m)))
-        chunks.extend(readback.drain())
+        tracer = get_tracer()
+        with tracer.start_span("runner.transform", rows=n, batch_size=bs):
+            for xb, m in prefetch:
+                shape_key = (int(xb.shape[0]), tuple(xb.shape[1:]),
+                             str(xb.dtype))
+                with tracer.start_span("runner.step", padded=int(xb.shape[0]),
+                                       rows=m):
+                    # jit compiles once per entry here; the counters make
+                    # ragged shapes defeating the ladder visible
+                    # (recompiles > 0)
+                    fn = self._exec_cache.get_or_build(family, shape_key,
+                                                       lambda: apply_fn)
+                    chunks.extend(readback.push((fn(variables, xb), m)))
+            chunks.extend(readback.drain())
         self.last_pipeline_stats = {
             **prefetch.stats,
             "overlap_fraction": prefetch.overlap_fraction(),

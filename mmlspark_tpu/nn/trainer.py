@@ -29,6 +29,7 @@ from ..core.params import HasFeaturesCol, HasLabelCol, Param
 from ..core.pipeline import Estimator, Model
 from ..core.schema import SCORE_KIND, Table
 from ..core.serialize import register_stage
+from ..observability.tracing import get_tracer
 from ..parallel.mesh import DATA_AXIS, get_mesh
 from .models import ModelBundle
 from .runner import DeepModelTransformer
@@ -228,41 +229,47 @@ class DNNLearner(HasFeaturesCol, HasLabelCol, Estimator):
             epoch_fn = jax.jit(run_epoch, donate_argnums=(0, 1, 2))
 
         log = self._log()
+        tracer = get_tracer()
         for epoch in range(start_epoch, int(self.get("epochs"))):
-            order = rng.permutation(n)
-            # drop the ragged tail (shuffled: all rows seen across epochs);
-            # XLA compiles one batch shape
-            epoch_rng = jax.random.fold_in(base_rng, epoch)
-            if fused:
-                idx = jnp.asarray(
-                    order[: steps * bs].reshape(steps, bs), jnp.int32
-                )
-                params, batch_stats, opt_state, mean_loss = epoch_fn(
-                    params, batch_stats, opt_state, idx, epoch_rng
-                )
-                mean_loss = float(mean_loss)
-            else:
-                def prep(ki, _order=order, _rng=epoch_rng):
-                    k, i = ki
-                    idx = _order[i : i + bs]
-                    return (jnp.asarray(x[idx]), jnp.asarray(y[idx]),
-                            jax.random.fold_in(_rng, k))
-
-                losses = []
-                for bx, by, step_rng in Prefetcher(
-                    enumerate(range(0, n - bs + 1, bs)), prep,
-                    depth=int(self.get("prefetch_depth")), name="trainer",
-                ):
-                    params, batch_stats, opt_state, loss = step(
-                        params, batch_stats, opt_state, bx, by, step_rng
+            with tracer.start_span("trainer.epoch", epoch=epoch,
+                                   fused=fused, steps=steps) as ep_span:
+                order = rng.permutation(n)
+                # drop the ragged tail (shuffled: all rows seen across
+                # epochs); XLA compiles one batch shape
+                epoch_rng = jax.random.fold_in(base_rng, epoch)
+                if fused:
+                    idx = jnp.asarray(
+                        order[: steps * bs].reshape(steps, bs), jnp.int32
                     )
-                    losses.append(loss)
-                mean_loss = (
-                    float(jnp.mean(jnp.stack(losses))) if losses else float("nan")
-                )
-            if log:
-                log(f"epoch {epoch + 1}/{self.get('epochs')}: loss={mean_loss:.4f}")
-            self._maybe_checkpoint(epoch, params, batch_stats, opt_state)
+                    params, batch_stats, opt_state, mean_loss = epoch_fn(
+                        params, batch_stats, opt_state, idx, epoch_rng
+                    )
+                    mean_loss = float(mean_loss)
+                else:
+                    def prep(ki, _order=order, _rng=epoch_rng):
+                        k, i = ki
+                        idx = _order[i : i + bs]
+                        return (jnp.asarray(x[idx]), jnp.asarray(y[idx]),
+                                jax.random.fold_in(_rng, k))
+
+                    losses = []
+                    for bx, by, step_rng in Prefetcher(
+                        enumerate(range(0, n - bs + 1, bs)), prep,
+                        depth=int(self.get("prefetch_depth")), name="trainer",
+                    ):
+                        params, batch_stats, opt_state, loss = step(
+                            params, batch_stats, opt_state, bx, by, step_rng
+                        )
+                        losses.append(loss)
+                    mean_loss = (
+                        float(jnp.mean(jnp.stack(losses)))
+                        if losses else float("nan")
+                    )
+                ep_span.set(loss=mean_loss)
+                if log:
+                    log(f"epoch {epoch + 1}/{self.get('epochs')}: "
+                        f"loss={mean_loss:.4f}")
+                self._maybe_checkpoint(epoch, params, batch_stats, opt_state)
 
         variables = {"params": jax.device_get(params)}
         if has_bn:

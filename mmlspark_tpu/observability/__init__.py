@@ -1,0 +1,20 @@
+"""Unified telemetry: metrics registry, span tracing, /metrics exposition.
+
+Dependency-free (stdlib only at the metrics/tracing layer) so every hot
+module — serving, streaming, dataplane, resilience, nn — can emit into
+one process-default registry and tracer. See docs/observability.md.
+"""
+
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
+                      DEFAULT_BUCKETS, METRIC_NAME_RE, get_registry,
+                      set_default_registry, set_enabled)
+from .tracing import (Span, Tracer, get_tracer, set_default_tracer,
+                      load_jsonl, CHROME_EVENT_KEYS)
+from .stage import InstrumentedTransformer
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "DEFAULT_BUCKETS",
+    "METRIC_NAME_RE", "get_registry", "set_default_registry", "set_enabled",
+    "Span", "Tracer", "get_tracer", "set_default_tracer", "load_jsonl",
+    "CHROME_EVENT_KEYS", "InstrumentedTransformer",
+]

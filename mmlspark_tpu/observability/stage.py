@@ -1,0 +1,95 @@
+"""InstrumentedTransformer: Timer.scala parity, emitting into the registry.
+
+The reference's `Timer` stage (Timer.scala:55-124) logs wall-clock per
+transform; core.pipeline.Timer reproduces that. This stage is the
+telemetry-era version of the same wrapper: per-transform duration lands
+in a labeled histogram, row throughput in a counter, and the transform
+runs inside a tracer span — so any pipeline stage becomes scrapeable
+from `/metrics` and visible in the exported trace by wrapping it.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..core.params import Param
+from ..core.pipeline import Transformer
+from ..core.schema import Table
+from ..core.serialize import register_stage
+from .metrics import MetricsRegistry, get_registry
+from .tracing import Tracer, get_tracer
+
+__all__ = ["InstrumentedTransformer"]
+
+STAGE_SECONDS = "mmlspark_tpu_pipeline_stage_seconds"
+STAGE_ROWS = "mmlspark_tpu_pipeline_stage_rows_total"
+
+
+@register_stage
+class InstrumentedTransformer(Transformer):
+    """Wrap a transformer: duration histogram + row counter + span.
+
+    Series (labeled `stage=` the wrapped class name, or `stage_name`):
+      mmlspark_tpu_pipeline_stage_seconds      transform wall time
+      mmlspark_tpu_pipeline_stage_rows_total   rows transformed
+
+    `metrics`/`tracer` are injectable attributes (process defaults when
+    left None) — the MetricsRegistry surface is deliberately NOT a Param:
+    registries hold live locks and belong to the process, not the saved
+    stage."""
+
+    inner = Param(None, "wrapped transformer stage", required=True)
+    stage_name = Param(None, "series label (default: inner class name)",
+                       ptype=str)
+    disable = Param(False, "if true, pass through uninstrumented", ptype=bool)
+
+    metrics: "MetricsRegistry | None" = None   # injectable; default registry
+    tracer: "Tracer | None" = None             # injectable; default tracer
+    last_elapsed: "float | None" = None        # Timer-parity attribute
+
+    def __init__(self, inner: "Transformer | None" = None, **kw):
+        super().__init__(**kw)
+        if inner is not None:
+            self.set(inner=inner)
+
+    def _label(self) -> str:
+        return self.get("stage_name") or type(self.get("inner")).__name__
+
+    def _transform(self, table: Table) -> Table:
+        inner: Transformer = self.get("inner")
+        if self.get("disable"):
+            return inner.transform(table)
+        reg = self.metrics if self.metrics is not None else get_registry()
+        tracer = self.tracer if self.tracer is not None else get_tracer()
+        label = self._label()
+        hist = reg.histogram(
+            STAGE_SECONDS, "pipeline stage transform wall time",
+            labels=("stage",)).labels(stage=label)
+        rows = reg.counter(
+            STAGE_ROWS, "rows through instrumented pipeline stages",
+            labels=("stage",)).labels(stage=label)
+        import time as _time
+
+        t0 = _time.perf_counter()
+        with tracer.start_span(f"stage:{label}", rows=table.num_rows):
+            with hist.time():
+                out = inner.transform(table)
+        self.last_elapsed = _time.perf_counter() - t0
+        rows.inc(table.num_rows)
+        from ..core.logging import get_logger
+
+        get_logger("timer").info(
+            "%s.transform took %.4fs", label, self.last_elapsed)
+        return out
+
+    # nested-stage serialization (same contract as CircuitBreakerTransformer)
+    def _save_state(self) -> dict[str, Any]:
+        return {"inner": self.get("inner")}
+
+    def _load_state(self, state: dict[str, Any]) -> None:
+        self.set(inner=state["inner"])
+
+    def params_to_dict(self) -> dict[str, Any]:
+        d = dict(self._values)
+        d.pop("inner", None)
+        return d

@@ -1,0 +1,309 @@
+"""Span tracing: Dapper-style host spans exported as Chrome-trace JSONL.
+
+`Tracer.start_span` is a context manager; nested spans pick up the
+active span as parent through a contextvar, and cross-thread work
+propagates explicitly (`parent=span` or `bind(span)` in the worker).
+Completed spans land in a bounded ring buffer and export as
+Chrome-trace/Perfetto events — one JSON object per line (JSONL), each a
+complete `"ph": "X"` duration event, so `chrome://tracing`, Perfetto's
+legacy-JSON importer, or a five-line script can load them
+(`export_jsonl` / `load_jsonl`).
+
+Device correlation: when `MMLSPARK_TPU_TRACE_DIR` is set (the switch
+that makes utils/profiling.device_trace capture an XPlane trace), every
+host span ALSO enters a `jax.profiler.TraceAnnotation`, so the same
+span names appear inside the device trace's annotation track and host
+spans line up with device activity in xprof/Perfetto.
+
+The disabled path is a no-op fast path: one attribute check, a shared
+null context manager — no allocation, no locks, no contextvar writes.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any
+
+__all__ = ["Span", "Tracer", "get_tracer", "set_default_tracer",
+           "load_jsonl", "CHROME_EVENT_KEYS"]
+
+# the schema contract for exported events (load_jsonl verifies it)
+CHROME_EVENT_KEYS = ("name", "cat", "ph", "ts", "dur", "pid", "tid")
+
+
+class Span:
+    """One timed region. `set(**args)` attaches arguments post-start
+    (they export into the Chrome event's "args")."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "parent",
+                 "start_us", "dur_us", "args", "tid")
+
+    def __init__(self, name: str, trace_id: int, span_id: int,
+                 parent: "Span | None", start_us: float, args: dict):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent = parent
+        self.parent_id = parent.span_id if parent is not None else 0
+        self.start_us = start_us
+        self.dur_us = 0.0
+        self.args = args
+        self.tid = threading.get_ident()
+
+    def set(self, **args: Any) -> None:
+        self.args.update(args)
+
+    def find_arg(self, key: str) -> Any:
+        """Look up an argument on this span or the nearest ancestor that
+        carries it (e.g. the batch id a streaming batch span stamped)."""
+        node: "Span | None" = self
+        while node is not None:
+            if key in node.args:
+                return node.args[key]
+            node = node.parent
+        return None
+
+
+class _NullSpan:
+    __slots__ = ()
+    name = ""
+    trace_id = 0
+    span_id = 0
+    parent_id = 0
+    parent = None
+    args: dict = {}
+
+    def set(self, **args: Any) -> None:
+        pass
+
+    def find_arg(self, key: str) -> Any:
+        return None
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _NullCtx:
+    __slots__ = ()
+
+    def __enter__(self):
+        return _NULL_SPAN
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+_NULL_CTX = _NullCtx()
+
+
+def _device_annotation(name: str):
+    """jax.profiler.TraceAnnotation when a device trace is active; the
+    import is lazy and fail-soft so the tracer stays dependency-free."""
+    try:
+        import jax
+
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:
+        return None
+
+
+class _SpanCtx:
+    __slots__ = ("_tracer", "_span", "_token", "_ann")
+
+    def __init__(self, tracer: "Tracer", span: Span):
+        self._tracer = tracer
+        self._span = span
+        self._token = None
+        self._ann = None
+
+    def __enter__(self) -> Span:
+        self._token = self._tracer._current.set(self._span)
+        if self._tracer.annotate_device:
+            self._ann = _device_annotation(self._span.name)
+            if self._ann is not None:
+                self._ann.__enter__()
+        return self._span
+
+    def __exit__(self, *exc) -> bool:
+        if self._ann is not None:
+            self._ann.__exit__(*exc)
+        span = self._span
+        span.dur_us = self._tracer._now_us() - span.start_us
+        self._tracer._current.reset(self._token)
+        self._tracer._record(span)
+        return False
+
+
+class Tracer:
+    """Bounded-buffer span collector.
+
+    clock            duck-typed `monotonic()` (resilience FakeClock fits);
+                     span timestamps are microseconds on this clock
+    max_spans        ring-buffer bound on retained completed spans
+    annotate_device  also enter jax.profiler.TraceAnnotation per span;
+                     default: on exactly when MMLSPARK_TPU_TRACE_DIR is
+                     set, so host spans appear in the device trace the
+                     same env var turns on
+    """
+
+    def __init__(self, clock: Any = None, enabled: bool = True,
+                 max_spans: int = 65536,
+                 annotate_device: "bool | None" = None):
+        self._clock = clock
+        self.enabled = bool(enabled)
+        self.annotate_device = (
+            bool(os.environ.get("MMLSPARK_TPU_TRACE_DIR"))
+            if annotate_device is None else bool(annotate_device))
+        self._spans: deque[Span] = deque(maxlen=int(max_spans))
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._current: contextvars.ContextVar["Span | None"] = \
+            contextvars.ContextVar(f"tracer_span_{id(self):x}",
+                                   default=None)
+
+    def _now_us(self) -> float:
+        if self._clock is not None:
+            return self._clock.monotonic() * 1e6
+        return time.monotonic() * 1e6
+
+    def _record(self, span: Span) -> None:
+        with self._lock:
+            self._spans.append(span)
+
+    # -- span API ------------------------------------------------------- #
+
+    def start_span(self, name: str, parent: "Span | None" = None,
+                   **args: Any):
+        """Context manager yielding the Span. Parent resolution: explicit
+        `parent=` (cross-thread propagation) beats the thread's active
+        span. Disabled tracers return a shared null context: no locks, no
+        allocation, no contextvar writes."""
+        if not self.enabled:
+            return _NULL_CTX
+        if parent is None:
+            parent = self._current.get()
+        trace_id = parent.trace_id if parent is not None else next(self._ids)
+        span = Span(name, trace_id, next(self._ids), parent,
+                    self._now_us(), dict(args))
+        return _SpanCtx(self, span)
+
+    def current_span(self) -> "Span | None":
+        """The active span on this thread (None when outside any span)."""
+        if not self.enabled:
+            return None
+        return self._current.get()
+
+    def bind(self, span: "Span | None"):
+        """Adopt `span` as the active parent on THIS thread — the worker
+        half of cross-thread propagation (the submitting thread passes the
+        span object, the worker binds it)."""
+        if not self.enabled or span is None:
+            return _NULL_CTX
+        return _Bind(self, span)
+
+    # -- export --------------------------------------------------------- #
+
+    def spans(self) -> list[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    def chrome_events(self) -> list[dict]:
+        """Completed spans as Chrome-trace duration events."""
+        pid = os.getpid()
+        out = []
+        for s in self.spans():
+            out.append({
+                "name": s.name, "cat": "mmlspark_tpu", "ph": "X",
+                "ts": s.start_us, "dur": s.dur_us,
+                "pid": pid, "tid": s.tid,
+                "args": {**s.args, "trace_id": s.trace_id,
+                         "span_id": s.span_id, "parent_id": s.parent_id},
+            })
+        return out
+
+    def export_jsonl(self, path: str) -> int:
+        """Write one Chrome-trace event per line; returns the event count.
+        Perfetto/chrome://tracing load the same events wrapped in a list —
+        `json.dumps({"traceEvents": [json.loads(l) for l in open(p)]})`."""
+        events = self.chrome_events()
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "w", encoding="utf-8") as fh:
+            for ev in events:
+                fh.write(json.dumps(ev) + "\n")
+        return len(events)
+
+
+class _Bind:
+    __slots__ = ("_tracer", "_span", "_token")
+
+    def __init__(self, tracer: Tracer, span: Span):
+        self._tracer = tracer
+        self._span = span
+        self._token = None
+
+    def __enter__(self) -> Span:
+        self._token = self._tracer._current.set(self._span)
+        return self._span
+
+    def __exit__(self, *exc) -> bool:
+        self._tracer._current.reset(self._token)
+        return False
+
+
+def load_jsonl(path: str) -> list[dict]:
+    """Load an exported trace, verifying the Chrome-trace event schema
+    (every line a JSON object with name/cat/ph/ts/dur/pid/tid)."""
+    events = []
+    with open(path, encoding="utf-8") as fh:
+        for i, line in enumerate(fh):
+            line = line.strip()
+            if not line:
+                continue
+            ev = json.loads(line)
+            missing = [k for k in CHROME_EVENT_KEYS if k not in ev]
+            if missing:
+                raise ValueError(
+                    f"{path}:{i + 1}: event missing keys {missing}")
+            if ev["ph"] != "X":
+                raise ValueError(
+                    f"{path}:{i + 1}: expected duration event, got "
+                    f"ph={ev['ph']!r}")
+            events.append(ev)
+    return events
+
+
+# --------------------------------------------------------------------- #
+# process-default tracer                                                #
+# --------------------------------------------------------------------- #
+
+_DEFAULT: "Tracer | None" = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def get_tracer() -> Tracer:
+    global _DEFAULT
+    t = _DEFAULT
+    if t is None:
+        with _DEFAULT_LOCK:
+            if _DEFAULT is None:
+                _DEFAULT = Tracer()
+            t = _DEFAULT
+    return t
+
+
+def set_default_tracer(tracer: "Tracer | None") -> "Tracer | None":
+    """Swap the process-default tracer (tests); returns the previous one."""
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        old, _DEFAULT = _DEFAULT, tracer
+    return old

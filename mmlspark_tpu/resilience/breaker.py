@@ -28,7 +28,26 @@ from .policy import Clock, SYSTEM_CLOCK
 R = TypeVar("R")
 
 __all__ = ["CircuitOpenError", "CircuitBreaker", "BreakerRegistry",
-           "CircuitBreakerTransformer"]
+           "CircuitBreakerTransformer", "ensure_metrics"]
+
+
+def ensure_metrics(registry=None):
+    """Declare the breaker telemetry families on `registry` (process
+    default when None) and return (transitions, shed). Idempotent;
+    ServingServer calls this at construction so the series render from
+    `/metrics` before any breaker ever trips."""
+    from ..observability.metrics import get_registry
+
+    reg = registry if registry is not None else get_registry()
+    transitions = reg.counter(
+        "mmlspark_tpu_resilience_breaker_transitions_total",
+        "breaker state transitions, labeled by destination state",
+        labels=("breaker", "to"))
+    shed = reg.counter(
+        "mmlspark_tpu_resilience_breaker_shed_total",
+        "calls refused while the circuit was open or probing",
+        labels=("breaker",))
+    return transitions, shed
 
 
 class CircuitOpenError(RuntimeError):
@@ -62,6 +81,7 @@ class CircuitBreaker:
         open_duration_s: float = 30.0,
         half_open_max_calls: int = 1,
         clock: Clock = SYSTEM_CLOCK,
+        metrics: Any = None,
     ):
         self.name = name
         self.failure_rate_threshold = float(failure_rate_threshold)
@@ -78,6 +98,22 @@ class CircuitBreaker:
         self._probes = 0          # half-open calls admitted, not yet resolved
         self.times_opened = 0
         self.calls_shed = 0
+        # labeled counter children, resolved once; telemetry stays optional
+        try:
+            transitions, shed = ensure_metrics(metrics)
+            label = self.name or "breaker"
+            self._m_to = {
+                to: transitions.labels(breaker=label, to=to)
+                for to in ("open", "half_open", "closed")}
+            self._m_shed = shed.labels(breaker=label)
+        except Exception:
+            self._m_to = {}
+            self._m_shed = None
+
+    def _transitioned(self, to: str) -> None:
+        child = self._m_to.get(to)
+        if child is not None:
+            child.inc()
 
     # -- state ---------------------------------------------------------- #
 
@@ -88,6 +124,7 @@ class CircuitBreaker:
                 self.clock.monotonic() - self._opened_at >= self.open_duration_s:
             self._state = "half_open"
             self._probes = 0
+            self._transitioned("half_open")
 
     @property
     def state(self) -> str:
@@ -123,6 +160,8 @@ class CircuitBreaker:
                 self._probes += 1
                 return True
             self.calls_shed += 1
+            if self._m_shed is not None:
+                self._m_shed.inc()
             return False
 
     def record_success(self) -> None:
@@ -132,6 +171,7 @@ class CircuitBreaker:
                 # the dependency healed: close and forget the bad window
                 self._state = "closed"
                 self._outcomes.clear()
+                self._transitioned("closed")
                 return
             self._outcomes.append(True)
 
@@ -154,6 +194,7 @@ class CircuitBreaker:
         self._probes = 0
         self.times_opened += 1
         self._outcomes.clear()
+        self._transitioned("open")
 
     def call(self, fn: Callable[[], R]) -> R:
         """Guarded invocation: CircuitOpenError while open, outcome

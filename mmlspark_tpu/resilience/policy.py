@@ -107,6 +107,20 @@ class RetryBudgetExceeded(RuntimeError):
     """Raised by RetryPolicy.call when every attempt failed."""
 
 
+def _count_exhausted(reason: str) -> None:
+    """Count a spent retry budget by its limiting constraint ("retries" or
+    "deadline"). Cold path only; telemetry stays optional."""
+    try:
+        from ..observability.metrics import get_registry
+
+        get_registry().counter(
+            "mmlspark_tpu_resilience_retry_exhausted_total",
+            "retry budgets exhausted, by limiting constraint",
+            labels=("reason",)).labels(reason=reason).inc()
+    except Exception:
+        pass
+
+
 # -- policy ---------------------------------------------------------------- #
 
 
@@ -175,6 +189,9 @@ class RetryPolicy:
                 if not ok_to_retry:
                     raise
                 if not sess.should_retry():
+                    _count_exhausted(
+                        "retries" if sess.attempt >= self.max_retries
+                        else "deadline")
                     raise RetryBudgetExceeded(
                         f"all retries failed: {e}") from e
                 sess.backoff()

@@ -78,8 +78,10 @@ class QuerySupervisor:
         on_failure: "Callable | None" = None,
         poll_interval_s: float = 0.02,
         clock: Clock = SYSTEM_CLOCK,
+        metrics: Any = None,
     ):
         self.query = query
+        self._metrics = metrics
         self.policy = policy if policy is not None else RestartPolicy()
         self.on_restart = on_restart
         self.on_failure = on_failure
@@ -91,6 +93,22 @@ class QuerySupervisor:
         self._restart_times: collections.deque[float] = collections.deque()
         self._stop = threading.Event()
         self._thread: "threading.Thread | None" = None
+
+    def _count_restart(self) -> None:
+        """Supervised restarts, labeled by query name. The counter lives in
+        the registry, so the tally survives the query object's death/rebirth
+        cycle (the query itself restarts from scratch)."""
+        try:
+            from ..observability.metrics import get_registry
+
+            reg = self._metrics if self._metrics is not None else get_registry()
+            reg.counter(
+                "mmlspark_tpu_streaming_restarts_total",
+                "supervised query restarts",
+                labels=("query",)).labels(
+                    query=getattr(self.query, "name", "query")).inc()
+        except Exception:
+            pass
 
     # -- lifecycle ------------------------------------------------------- #
 
@@ -165,6 +183,7 @@ class QuerySupervisor:
                 break
             self._restart_times.append(self.clock.monotonic())
             self.restarts += 1
+            self._count_restart()
             batches_at_restart = self.query.batches_processed
             if self.on_restart is not None:
                 self.on_restart(self.query, exc, self.restarts)

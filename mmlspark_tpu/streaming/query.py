@@ -31,6 +31,8 @@ from typing import Any, Callable
 
 from ..core.dataplane import Lookahead
 from ..core.schema import Table
+from ..observability.metrics import get_registry
+from ..observability.tracing import get_tracer
 from ..resilience.policy import RetryPolicy, is_fatal_exception
 from .checkpoint import CommitLog
 from .sinks import MemorySink, Sink
@@ -76,7 +78,9 @@ class StreamingQuery:
                  compact_every: int = 100,
                  batch_retry_policy: "RetryPolicy | None" = None,
                  source_lookahead: int = 1,
-                 name: str = "query") -> None:
+                 name: str = "query",
+                 metrics: Any = None,
+                 tracer: Any = None) -> None:
         self.source = source
         self.transform = transform
         self.sink = sink if sink is not None else MemorySink()
@@ -116,6 +120,33 @@ class StreamingQuery:
         self.batches_processed = 0
         self.rows_processed = 0
         self.last_progress: dict = {}
+        # telemetry: every series labeled by query name; a restarted query
+        # (new object, same name) keeps accumulating the same children
+        self.tracer = tracer
+        reg = metrics if metrics is not None else get_registry()
+        self.metrics = reg
+        lbl = {"query": name}
+        self._m_batches = reg.counter(
+            "mmlspark_tpu_streaming_batches_total",
+            "micro-batches committed", labels=("query",)).labels(**lbl)
+        self._m_rows = reg.counter(
+            "mmlspark_tpu_streaming_rows_total",
+            "rows through committed micro-batches",
+            labels=("query",)).labels(**lbl)
+        self._m_batch_seconds = reg.histogram(
+            "mmlspark_tpu_streaming_batch_seconds",
+            "micro-batch wall time, source read to sink write",
+            labels=("query",)).labels(**lbl)
+        self._m_wal_plan = reg.histogram(
+            "mmlspark_tpu_streaming_wal_plan_seconds",
+            "WAL plan-record write time", labels=("query",)).labels(**lbl)
+        self._m_wal_commit = reg.histogram(
+            "mmlspark_tpu_streaming_wal_commit_seconds",
+            "WAL commit-record write time", labels=("query",)).labels(**lbl)
+        self._m_lookahead = reg.gauge(
+            "mmlspark_tpu_streaming_lookahead_hit_ratio",
+            "fraction of source reads served by the lookahead",
+            labels=("query",)).labels(**lbl)
         if self._log is not None:
             self._recover()
 
@@ -187,38 +218,44 @@ class StreamingQuery:
                         self.source.empty_range(start, end):
                     return False
                 if self._log is not None:
-                    self._log.plan(bid, start, end)
+                    with self._m_wal_plan.time():
+                        self._log.plan(bid, start, end)
             saved = [op.state_doc() for op in self._ops]
             t0 = time.monotonic()
-            try:
-                batch = (ahead if ahead is not None
-                         else self.source.get_batch(start, end))
-                # overlap the NEXT batch's source read with this batch's
-                # transform + sink write (keyed by its start offset; a
-                # replay or restart simply misses and reads in line)
-                if self._lookahead is not None:
-                    nxt = end
-                    self._lookahead.submit(
-                        nxt, lambda: self._read_ahead(nxt))
-                out = self._apply(batch)
-                if self._log is not None and self._ops:
-                    self._log.write_state(
-                        bid, {"ops": [op.state_doc() for op in self._ops]})
-                self.sink.add_batch(bid, out)
-            except BaseException:
-                # a failed attempt must not leak half-folded state into
-                # the retry: restore the pre-batch snapshots
-                for op, doc in zip(self._ops, saved):
-                    op.load_state_doc(doc)
-                raise
-            self._commit(bid, end, rows=batch.num_rows,
-                         duration_s=time.monotonic() - t0)
+            tr = self.tracer if self.tracer is not None else get_tracer()
+            with tr.start_span("streaming.batch", query=self.name,
+                               batch_id=bid) as span:
+                try:
+                    batch = (ahead if ahead is not None
+                             else self.source.get_batch(start, end))
+                    # overlap the NEXT batch's source read with this batch's
+                    # transform + sink write (keyed by its start offset; a
+                    # replay or restart simply misses and reads in line)
+                    if self._lookahead is not None:
+                        nxt = end
+                        self._lookahead.submit(
+                            nxt, lambda: self._read_ahead(nxt))
+                    out = self._apply(batch)
+                    if self._log is not None and self._ops:
+                        self._log.write_state(
+                            bid, {"ops": [op.state_doc() for op in self._ops]})
+                    self.sink.add_batch(bid, out)
+                except BaseException:
+                    # a failed attempt must not leak half-folded state into
+                    # the retry: restore the pre-batch snapshots
+                    for op, doc in zip(self._ops, saved):
+                        op.load_state_doc(doc)
+                    raise
+                span.set(rows=batch.num_rows)
+                self._commit(bid, end, rows=batch.num_rows,
+                             duration_s=time.monotonic() - t0)
             return True
 
     def _commit(self, bid: int, end: "dict | None", rows: int,
                 duration_s: float = 0.0) -> None:
         if self._log is not None:
-            self._log.commit(bid)
+            with self._m_wal_commit.time():
+                self._log.commit(bid)
             if self._ops:
                 self._log.prune_state(keep_from=bid)
             if self.compact_every and (bid + 1) % self.compact_every == 0:
@@ -232,9 +269,16 @@ class StreamingQuery:
             "batch_id": bid, "num_rows": rows,
             "duration_s": duration_s, "end_offset": end,
         }
+        self._m_batches.inc()
+        if rows:
+            self._m_rows.inc(rows)
+        self._m_batch_seconds.observe(duration_s)
         if self._lookahead is not None:
             self.last_progress["lookahead_hits"] = self._lookahead.hits
             self.last_progress["lookahead_misses"] = self._lookahead.misses
+            seen = self._lookahead.hits + self._lookahead.misses
+            if seen:
+                self._m_lookahead.set(self._lookahead.hits / seen)
 
     def process_all_available(self) -> int:
         """Drain everything currently available (Spark's availableNow

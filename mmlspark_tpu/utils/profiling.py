@@ -57,17 +57,52 @@ def block_until_ready(tree: Any) -> Any:
 
 
 def profile_fn(fn: Callable, *args, warmup: int = 1, iters: int = 3,
-               **kwargs) -> dict:
-    """Quick timing: compile (first-call) time, then steady-state wall time
-    with device completion awaited. Returns seconds."""
+               registry: Any = None, name: "str | None" = None,
+               **kwargs) -> "tuple[Any, dict]":
+    """Quick timing: compile (first-call) time, then per-iteration steady
+    wall times with device completion awaited. Returns `(out, stats)` —
+    the model output separated from the stats dict (the old API buried the
+    output under an `"out"` key inside the numbers). All times in seconds:
+    first_call_s, steady_s (mean), compile_overhead_s, iter_min_s,
+    iter_median_s, iter_max_s, iters.
+
+    The measurements also land in `registry` (the process default when
+    None) as `mmlspark_tpu_profile_*` series labeled `fn=` the callable's
+    name (override with `name=`)."""
     t0 = time.perf_counter()
     out = block_until_ready(fn(*args, **kwargs))
     first = time.perf_counter() - t0
     for _ in range(max(warmup - 1, 0)):
         block_until_ready(fn(*args, **kwargs))
-    t0 = time.perf_counter()
+    samples = []
     for _ in range(iters):
+        t0 = time.perf_counter()
         out = block_until_ready(fn(*args, **kwargs))
-    steady = (time.perf_counter() - t0) / iters
-    return {"first_call_s": first, "steady_s": steady,
-            "compile_overhead_s": max(first - steady, 0.0), "out": out}
+        samples.append(time.perf_counter() - t0)
+    steady = sum(samples) / len(samples) if samples else 0.0
+    ordered = sorted(samples)
+    stats = {
+        "first_call_s": first, "steady_s": steady,
+        "compile_overhead_s": max(first - steady, 0.0),
+        "iter_min_s": ordered[0] if ordered else 0.0,
+        "iter_median_s": ordered[len(ordered) // 2] if ordered else 0.0,
+        "iter_max_s": ordered[-1] if ordered else 0.0,
+        "iters": len(samples),
+    }
+    try:
+        from ..observability.metrics import get_registry
+
+        reg = registry if registry is not None else get_registry()
+        label = name or getattr(fn, "__name__", None) or "fn"
+        reg.gauge("mmlspark_tpu_profile_steady_seconds",
+                  "profile_fn steady-state wall time (mean over iters)",
+                  labels=("fn",)).labels(fn=label).set(steady)
+        reg.gauge("mmlspark_tpu_profile_first_call_seconds",
+                  "profile_fn first-call (compile-inclusive) wall time",
+                  labels=("fn",)).labels(fn=label).set(first)
+        reg.counter("mmlspark_tpu_profile_runs_total",
+                    "profile_fn invocations",
+                    labels=("fn",)).labels(fn=label).inc()
+    except Exception:
+        pass
+    return out, stats

@@ -1,0 +1,17 @@
+#' InstrumentedTransformer (Transformer)
+#'
+#' Wrap a transformer: duration histogram + row counter + span.
+#'
+#' @param x a data.frame or tpu_table
+#' @param inner wrapped transformer stage
+#' @param stage_name series label (default: inner class name)
+#' @param disable if true, pass through uninstrumented
+#' @export
+ml_instrumented_transformer <- function(x, inner, stage_name = NULL, disable = FALSE)
+{
+  params <- list()
+  if (!is.null(inner)) params$inner <- inner
+  if (!is.null(stage_name)) params$stage_name <- as.character(stage_name)
+  if (!is.null(disable)) params$disable <- as.logical(disable)
+  .tpu_apply_stage("mmlspark_tpu.observability.stage.InstrumentedTransformer", params, x, is_estimator = FALSE)
+}

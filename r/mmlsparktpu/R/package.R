@@ -7,7 +7,7 @@
 .tpu <- function() {
   if (is.null(.tpu_env$pkg)) {
     .tpu_env$pkg <- reticulate::import("mmlspark_tpu")
-    for (sub in c("core", "gbdt", "nn", "image", "ops", "text", "automl", "recommendation", "io_http", "plot", "parallel", "streaming", "resilience", "utils")) {
+    for (sub in c("core", "gbdt", "nn", "image", "ops", "text", "automl", "recommendation", "io_http", "plot", "parallel", "streaming", "resilience", "observability", "utils")) {
       reticulate::import(paste0("mmlspark_tpu.", sub))
     }
   }

@@ -807,6 +807,21 @@ def _resilience_objects(ctx) -> dict[str, list[TestObject]]:
     }
 
 
+def _observability_objects(ctx) -> dict[str, list[TestObject]]:
+    from mmlspark_tpu.observability import InstrumentedTransformer
+    from mmlspark_tpu.ops.stages import DropColumns
+
+    ab = Table({"a": np.arange(6.0), "b": np.arange(6.0) * 2})
+    return {
+        "mmlspark_tpu.observability.stage.InstrumentedTransformer": [
+            TestObject(
+                InstrumentedTransformer(inner=DropColumns(cols=["b"]),
+                                        stage_name="fuzz"),
+                transform_table=ab,
+            )],
+    }
+
+
 BUILDER_GROUPS: list[Callable] = [
     _core_objects,
     _ops_objects,
@@ -819,6 +834,7 @@ BUILDER_GROUPS: list[Callable] = [
     _io_http_objects,
     _streaming_objects,
     _resilience_objects,
+    _observability_objects,
 ]
 
 

@@ -241,7 +241,9 @@ class TestProfiling:
             with annotate("square"):
                 return (v * v).sum()
 
-        stats = profile_fn(f, jnp.arange(64.0), iters=2)
+        out, stats = profile_fn(f, jnp.arange(64.0), iters=2)
         assert stats["steady_s"] > 0
         assert stats["first_call_s"] >= stats["steady_s"] * 0.5
-        assert float(stats["out"]) == float((np.arange(64.0) ** 2).sum())
+        assert stats["iter_min_s"] <= stats["iter_median_s"] <= stats["iter_max_s"]
+        assert stats["iters"] == 2
+        assert float(out) == float((np.arange(64.0) ** 2).sum())

@@ -33,7 +33,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 SUBPACKAGES = ("core", "gbdt", "nn", "image", "ops", "text", "automl",
                "recommendation", "io_http", "plot", "parallel", "streaming",
-               "resilience", "utils")
+               "resilience", "observability", "utils")
 
 R_DIR = os.path.join(os.path.dirname(__file__), "..", "r", "mmlsparktpu")
 

@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Static lint for metric-name literals.
+
+The registry already rejects malformed names at runtime
+(observability/metrics.py METRIC_NAME_RE), but a metric on a rarely-taken
+path — a breaker transition, a retry-budget exhaustion — may never be
+constructed in CI, so a bad name would ship and only explode in
+production. This walks every Python source under mmlspark_tpu/ plus
+bench.py, extracts every string literal starting with ``mmlspark_tpu_``
+(f-strings included: ``{...}`` placeholders are stripped before
+validation, so ``f"mmlspark_tpu_executable_cache_{key}_total"`` checks
+the static skeleton), and enforces:
+
+  1. charset: ``^mmlspark_tpu_[a-z0-9_]+$`` — the registry's rule.
+  2. unit suffix: the name must end in one of UNIT_SUFFIXES, the
+     Prometheus base-unit convention (counters ``_total``, timings
+     ``_seconds``, sizes ``_bytes``, plus the dimensionless ``_ratio`` /
+     ``_depth`` / ``_count`` gauges this codebase uses).
+
+Usage: python tools/metric_lint.py    # exit 1 with a report if any fail
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+ROOT = os.path.normpath(os.path.join(os.path.dirname(__file__), ".."))
+SCAN = [os.path.join(ROOT, "mmlspark_tpu"), os.path.join(ROOT, "bench.py")]
+
+NAME_RE = re.compile(r"^mmlspark_tpu_[a-z0-9_]+$")
+UNIT_SUFFIXES = ("_total", "_seconds", "_bytes", "_ratio", "_depth",
+                 "_count")
+# any single- or double-quoted literal (optionally an f-string) whose
+# contents begin with the namespace prefix
+LITERAL_RE = re.compile(
+    r"""[fF]?("mmlspark_tpu_[^"\n]*"|'mmlspark_tpu_[^'\n]*')""")
+PLACEHOLDER_RE = re.compile(r"\{[^{}]*\}")
+
+
+def iter_sources() -> list[str]:
+    paths = []
+    for entry in SCAN:
+        if os.path.isfile(entry):
+            paths.append(entry)
+            continue
+        for root, _dirs, names in os.walk(entry):
+            paths.extend(os.path.join(root, n) for n in names
+                         if n.endswith(".py"))
+    return sorted(paths)
+
+
+def lint_file(path: str) -> list[str]:
+    problems = []
+    with open(path) as fh:
+        for lineno, line in enumerate(fh, 1):
+            for match in LITERAL_RE.finditer(line):
+                name = PLACEHOLDER_RE.sub("x", match.group(1)[1:-1])
+                where = f"{os.path.relpath(path, ROOT)}:{lineno}"
+                if not NAME_RE.match(name):
+                    problems.append(
+                        f"{where}: {name!r} violates "
+                        "^mmlspark_tpu_[a-z0-9_]+$")
+                elif not name.endswith(UNIT_SUFFIXES):
+                    problems.append(
+                        f"{where}: {name!r} lacks a unit suffix "
+                        f"({', '.join(UNIT_SUFFIXES)})")
+    return problems
+
+
+def main() -> None:
+    checked = 0
+    problems: list[str] = []
+    for path in iter_sources():
+        found = lint_file(path)
+        problems.extend(found)
+        with open(path) as fh:
+            checked += sum(1 for line in fh
+                           for _ in LITERAL_RE.finditer(line))
+    if problems:
+        print(f"metric_lint: {len(problems)} problem(s):")
+        for p in problems:
+            print(f"  {p}")
+        raise SystemExit(1)
+    print(f"metric_lint: {checked} metric-name literal(s) OK")
+
+
+if __name__ == "__main__":
+    main()
