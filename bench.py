@@ -1012,6 +1012,85 @@ def bench_streaming() -> dict:
     }
 
 
+def bench_pipeline_fusion() -> dict:
+    """Whole-pipeline fusion (core/fusion.py): the SAME three-stage image
+    scoring pipeline (ImageTransformer -> CNN -> DataConversion) run
+    staged — per-stage transforms, a host materialization at every stage
+    boundary — vs fused into one jitted composition with columns
+    device-resident between stages. The comparison is paired like
+    runner_pipelined_vs_sequential: both paths run in each of five
+    interleaved passes, the per-pass ratio cancels that pass's machine
+    load, and the median over passes is the reported speedup. Transfer
+    counts come from the fused model's own upload/download accounting vs
+    the plan's analytic staged count (2 per device stage per batch)."""
+    from mmlspark_tpu.core.fusion import fuse
+    from mmlspark_tpu.core.pipeline import pipeline_model
+    from mmlspark_tpu.core.schema import Table
+    from mmlspark_tpu.image.transformer import ImageTransformer
+    from mmlspark_tpu.nn.models import ModelBundle
+    from mmlspark_tpu.nn.runner import DeepModelTransformer
+    from mmlspark_tpu.ops.conversion import DataConversion
+
+    n_images, bs = 2048, 256
+    rng = np.random.default_rng(11)
+    table = Table({"image": rng.integers(
+        0, 256, size=(n_images, 16, 16, 3), dtype=np.uint8).astype(
+            np.float64)})
+    stages = [
+        ImageTransformer(input_col="image", output_col="image")
+        .resize(8, 8).gray(keep_channels=True),
+        DeepModelTransformer(
+            input_col="image", mini_batch_size=bs).set_model(
+                ModelBundle.init("simple_cnn", (8, 8, 3), seed=0,
+                                 num_outputs=10)),
+        DataConversion(cols=["output"], convert_to="float"),
+    ]
+    staged = pipeline_model(*stages)
+    fused = fuse(pipeline_model(*stages), mini_batch_size=bs)
+    plan = fused.plan()
+
+    # warm-up: compile both paths and check equivalence once — fusion
+    # changes WHERE stages run, never what they produce
+    out_s = np.asarray(staged.transform(table)["output"])
+    out_f = np.asarray(fused.transform(table)["output"])
+    assert out_s.tobytes() == out_f.tobytes(), "fused != staged"
+    assert fused.last_stats["segments"][0]["kind"] == "fused"
+
+    def timed(fn):
+        t0 = time.perf_counter()
+        fn()
+        return time.perf_counter() - t0
+
+    staged_t, fused_t = [], []
+    rows = [
+        (staged_t, lambda: np.asarray(staged.transform(table)["output"])),
+        (fused_t, lambda: np.asarray(fused.transform(table)["output"])),
+    ]
+    for rep in range(5):
+        # rotate within-pass order so neither path owns the cooler slot
+        for acc, fn in rows[rep % 2:] + rows[:rep % 2]:
+            acc.append(timed(fn))
+    pass_ratios = sorted(s / f for s, f in zip(staged_t, fused_t))
+    speedup = pass_ratios[len(pass_ratios) // 2]
+
+    n_batches = -(-n_images // bs)
+    stats = fused.last_stats
+    # column-granular count from the fused model's own accounting (3 here:
+    # the in-place image column's final value + the score come back; the
+    # staged path pays a full host round-trip at every stage boundary)
+    fused_transfers = (stats["uploads"] + stats["downloads"]) / n_batches
+    boundary_transfers, staged_transfers = plan.transfers_per_batch()
+    return {
+        "fused_vs_staged": speedup,
+        "fused_images_per_sec": n_images / min(fused_t),
+        "staged_images_per_sec": n_images / min(staged_t),
+        "fusion_ratio": plan.fusion_ratio,
+        "fused_transfers_per_batch": fused_transfers,
+        "fused_boundary_transfers_per_batch": float(boundary_transfers),
+        "staged_transfers_per_batch": float(staged_transfers),
+    }
+
+
 def bench_instrumentation() -> dict:
     """Per-iteration cost of the telemetry layer on a runner-style loop
     (counter + histogram.time + span around each step), as a slowdown
@@ -1276,6 +1355,12 @@ def _run_suite(platform: str) -> dict:
         print(f"bench: streaming bench failed ({e!r})", file=sys.stderr)
         streaming = None
     try:
+        fusion = bench_pipeline_fusion()
+    except Exception as e:  # noqa: BLE001 — fusion row is auxiliary
+        print(f"bench: pipeline fusion bench failed ({e!r})", file=sys.stderr)
+        traceback.print_exc()
+        fusion = None
+    try:
         instrumentation = bench_instrumentation()
     except Exception as e:  # noqa: BLE001 — overhead row is auxiliary
         print(f"bench: instrumentation bench failed ({e!r})", file=sys.stderr)
@@ -1347,6 +1432,22 @@ def _run_suite(platform: str) -> dict:
             "serving_degraded_error_rate": round(
                 degraded["error_rate"], 4) if degraded else None,
             **_streaming_extra(streaming),
+            # paired per-pass median, like runner_pipelined_vs_sequential
+            "pipeline_fused_vs_staged": round(
+                fusion["fused_vs_staged"], 3) if fusion else None,
+            "pipeline_fused_images_per_sec": round(
+                fusion["fused_images_per_sec"], 1) if fusion else None,
+            "pipeline_staged_images_per_sec": round(
+                fusion["staged_images_per_sec"], 1) if fusion else None,
+            "pipeline_fusion_ratio": round(
+                fusion["fusion_ratio"], 3) if fusion else None,
+            "pipeline_fused_transfers_per_batch": round(
+                fusion["fused_transfers_per_batch"], 2) if fusion else None,
+            "pipeline_fused_boundary_transfers_per_batch": round(
+                fusion["fused_boundary_transfers_per_batch"], 2)
+                if fusion else None,
+            "pipeline_staged_transfers_per_batch": round(
+                fusion["staged_transfers_per_batch"], 2) if fusion else None,
             "instrumentation_overhead": round(
                 instrumentation["ratio_enabled"], 3)
                 if instrumentation else None,

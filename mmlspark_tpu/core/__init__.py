@@ -38,4 +38,13 @@ from .table_io import (
     write_parquet,
     from_pandas,
     to_pandas,
+    DeviceTable,
+)
+from .fusion import (
+    DeviceKernel,
+    FusionPlan,
+    FusedPipelineModel,
+    fuse,
+    kernel_of,
+    plan_fusion,
 )

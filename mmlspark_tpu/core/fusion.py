@@ -1,0 +1,531 @@
+"""Whole-pipeline fusion: compile adjacent device-capable stages into ONE
+XLA program with device-resident tables.
+
+The role model is Spark SQL's whole-stage codegen (Neumann, "Efficiently
+Compiling Efficient Query Plans"; Spark's `WholeStageCodegenExec`): instead
+of running operators one at a time with materialized intermediates, compile
+a maximal run of compatible operators into a single tight program.  Here
+the operators are pipeline stages and the program is an XLA executable:
+`PipelineModel._transform` runs stage-by-stage, so a featurize -> model ->
+post-process chain crosses the host/device boundary once per jittable
+stage (device_put, jit dispatch, full host read-back — 3x per batch for
+that chain).  Fusion partitions the stage list into maximal runs of stages
+that declare a pure device kernel, compiles each run into one jitted
+composition, and keeps columns device-resident across stage boundaries.
+Host materialization happens only at non-fusable boundaries (HTTP /
+cognitive / text / grouping stages), which run exactly as before.
+
+Stage protocol
+--------------
+A stage opts in by implementing::
+
+    def device_kernel(self) -> DeviceKernel | str | None
+
+returning a `DeviceKernel` when it can run on device, or a reason string
+(or None) when it cannot.  A kernel's `fn(params, cols)` must be a pure,
+jit-traceable, ROW-INDEPENDENT function over a dict of device columns —
+row independence is what makes the engine's pad-to-bucket and chunked
+execution semantics exact (padding rows are sliced away, chunk boundaries
+cannot change any real row's value).  `params` is the kernel's
+device-resident table (model variables, GBDT node arrays, ...): uploaded
+once per segment via `device_put` and reused across every batch, never
+baked into the executable as constants.
+
+Integration
+-----------
+* `ExecutableCache` (core.dataplane) tracks one family per fused segment;
+  ragged row counts pad up a `ShapeBucketer` ladder so steady-state
+  recompiles stay at zero.
+* Large tables stream through the segment in `mini_batch_size` chunks on
+  the async data plane (`prefetch_depth` overlaps upload of chunk N+1
+  with device compute on N).
+* Each segment execution opens a `pipeline.fused_segment` span and the
+  model publishes a `mmlspark_tpu_pipeline_fusion_ratio` gauge.
+
+`serve_model` and `StreamingQuery` fuse `PipelineModel` handlers
+automatically; `fuse()` is idempotent and `FusedPipelineModel` serializes
+like the `PipelineModel` it wraps.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+from .dataplane import AsyncReadback, ExecutableCache, Prefetcher, ShapeBucketer
+from .params import Param
+from .pipeline import PipelineModel, Transformer
+from .schema import Table
+from .serialize import register_stage
+from .table_io import DeviceTable
+
+__all__ = [
+    "DeviceKernel",
+    "StagePlan",
+    "SegmentPlan",
+    "FusionPlan",
+    "kernel_of",
+    "plan_fusion",
+    "fuse",
+    "FusedPipelineModel",
+]
+
+
+@dataclass
+class DeviceKernel:
+    """One stage's pure device program plus its column contract.
+
+    fn(params, cols) -> dict of output columns; `cols` maps column name to
+    a device array and contains at least `input_cols`.  The function must
+    be row-independent (see module docstring).  `out_dtypes` maps output
+    columns to the HOST dtype the staged path would produce — the engine
+    casts after read-back so fused and staged tables carry identical
+    schemas (e.g. float32 device features widening to a float64 column is
+    exact).  `out_meta` carries per-column `ColumnMeta`; a value may be a
+    callable taking the downloaded ndarray (for shape-dependent metadata
+    like IMAGE_SPEC).  `ready(table)` is the runtime fusability check on
+    the HOST inputs (dtype / uniformity preconditions); returning a string
+    vetoes fusion for that table and the segment falls back to the staged
+    path."""
+
+    fn: Callable[[Any, dict], dict]
+    input_cols: tuple[str, ...]
+    output_cols: tuple[str, ...]
+    params: Any = None
+    name: str = ""
+    out_dtypes: dict[str, Any] = field(default_factory=dict)
+    out_meta: dict[str, Any] = field(default_factory=dict)
+    ready: "Callable[[Table], Any] | None" = None
+
+
+@dataclass
+class StagePlan:
+    stage: Any
+    kernel: "DeviceKernel | None"
+    reason: str = ""  # why the stage stays on host ("" when fused)
+
+    @property
+    def fused(self) -> bool:
+        return self.kernel is not None
+
+
+@dataclass
+class SegmentPlan:
+    fused: bool
+    stages: list[StagePlan]
+
+
+@dataclass
+class FusionPlan:
+    segments: list[SegmentPlan]
+
+    @property
+    def n_stages(self) -> int:
+        return sum(len(s.stages) for s in self.segments)
+
+    @property
+    def n_fused_stages(self) -> int:
+        return sum(len(s.stages) for s in self.segments if s.fused)
+
+    @property
+    def fusion_ratio(self) -> float:
+        n = self.n_stages
+        return (self.n_fused_stages / n) if n else 0.0
+
+    def transfers_per_batch(self) -> tuple[int, int]:
+        """(fused, staged) host<->device boundary crossings per batch:
+        fused pays one upload + one read-back per fused segment; the
+        staged path pays the same pair once per device-capable STAGE."""
+        fused = 2 * sum(1 for s in self.segments if s.fused)
+        staged = 2 * self.n_fused_stages
+        return fused, staged
+
+    def describe(self) -> str:
+        """Human-readable segment plan (tools/fusion_report.py prints it)."""
+        lines = []
+        fused_t, staged_t = self.transfers_per_batch()
+        for i, seg in enumerate(self.segments):
+            kind = "FUSED" if seg.fused else "HOST"
+            lines.append(f"segment {i} [{kind}]")
+            for sp in seg.stages:
+                name = type(sp.stage).__name__
+                if seg.fused:
+                    k = sp.kernel
+                    lines.append(
+                        f"  {name}: {','.join(k.input_cols)} -> "
+                        f"{','.join(k.output_cols)}")
+                else:
+                    lines.append(f"  {name}: {sp.reason}")
+        lines.append(
+            f"fused {self.n_fused_stages}/{self.n_stages} stages "
+            f"(ratio {self.fusion_ratio:.2f}); transfers/batch: "
+            f"{fused_t} fused vs {staged_t} staged device-stage pairs")
+        return "\n".join(lines)
+
+
+def kernel_of(stage: Any) -> tuple["DeviceKernel | None", str]:
+    """(kernel, reason): a stage's declared device kernel, or why it has
+    none.  Never raises — a broken declaration just keeps the stage on the
+    host path."""
+    decl = getattr(stage, "device_kernel", None)
+    if decl is None:
+        return None, "no device kernel declared"
+    try:
+        k = decl()
+    except Exception as e:  # noqa: BLE001 — declaration failure == host
+        return None, f"device_kernel() failed: {e}"
+    if isinstance(k, DeviceKernel):
+        if not k.name:
+            k.name = type(stage).__name__
+        return k, ""
+    return None, (k if isinstance(k, str) else "stage declared itself non-fusable")
+
+
+def _flatten(stages: Sequence[Any]) -> list[Any]:
+    """Flatten nested PipelineModels into their leaf stages (sequential
+    composition is associative, so this never changes semantics — and it
+    lets fusable leaves inside a nested model join an adjacent run)."""
+    out: list[Any] = []
+    for s in stages:
+        if isinstance(s, PipelineModel):
+            out.extend(_flatten(s.get("stages") or []))
+        else:
+            out.append(s)
+    return out
+
+
+def plan_fusion(stages: Sequence[Any]) -> FusionPlan:
+    """Partition a stage list into maximal fused runs / host runs."""
+    segments: list[SegmentPlan] = []
+    for stage in _flatten(stages):
+        kernel, reason = kernel_of(stage)
+        sp = StagePlan(stage, kernel, reason)
+        if segments and segments[-1].fused == sp.fused:
+            segments[-1].stages.append(sp)
+        else:
+            segments.append(SegmentPlan(sp.fused, [sp]))
+    return FusionPlan(segments)
+
+
+# --------------------------------------------------------------------- #
+# fused segment runtime                                                 #
+# --------------------------------------------------------------------- #
+
+
+class _FusedSegment:
+    """One maximal run of device-capable stages compiled as a single jitted
+    composition over device-resident columns."""
+
+    def __init__(self, index: int, plans: list[StagePlan]):
+        self.index = index
+        self.plans = plans
+        self.kernels = [p.kernel for p in plans]
+        self.stage_names = [type(p.stage).__name__ for p in plans]
+        # upload set: inputs not produced by an earlier kernel in the run;
+        # download set: the FINAL value of every column any kernel produces
+        produced: dict[str, DeviceKernel] = {}
+        uploads: list[str] = []
+        for k in self.kernels:
+            for c in k.input_cols:
+                if c not in produced and c not in uploads:
+                    uploads.append(c)
+            for c in k.output_cols:
+                produced[c] = k  # last producer wins
+        self.upload_cols = tuple(uploads)
+        self.download_cols = tuple(produced)
+        self._last_producer = produced
+        self._exec_cache = ExecutableCache()
+        self._jitted = None
+        self._device_params = None
+
+    # -- compilation ---------------------------------------------------- #
+
+    def _build(self):
+        import jax
+
+        if self._device_params is None:
+            # the device-resident tables: model variables, tree SoAs, bin
+            # boundaries — uploaded once, reused by every batch (never
+            # captured as jit constants, so they are not re-staged per
+            # compiled shape)
+            self._device_params = tuple(
+                jax.tree.map(jax.device_put, k.params) if k.params is not None
+                else None
+                for k in self.kernels
+            )
+        if self._jitted is None:
+            kernels = self.kernels
+            upload_cols = self.upload_cols
+            download_cols = self.download_cols
+
+            def composed(params_tuple, in_arrays):
+                cols = dict(zip(upload_cols, in_arrays))
+                for k, p in zip(kernels, params_tuple):
+                    cols.update(k.fn(p, cols))
+                return tuple(cols[c] for c in download_cols)
+
+            self._jitted = jax.jit(composed)
+        return self._jitted, self._device_params
+
+    # -- execution ------------------------------------------------------ #
+
+    def check_ready(self, table: Table) -> str:
+        """'' when this table can run fused, else the blocking reason."""
+        if table.num_rows == 0:
+            return "empty batch (padding has no row to repeat)"
+        for c in self.upload_cols:
+            if c not in table:
+                return f"input column {c!r} missing"
+            col = table[c]
+            if not isinstance(col, np.ndarray) or col.dtype == object:
+                return f"input column {c!r} is not a dense ndarray"
+        produced: set[str] = set()
+        for k in self.kernels:
+            # a `ready` precondition is a check on HOST inputs; once any of
+            # the kernel's inputs is a device intermediate produced earlier
+            # in the segment, its dtype/layout is fixed by the upstream
+            # kernel's contract and there is no host column to inspect
+            if k.ready is not None and produced.isdisjoint(k.input_cols):
+                ok = k.ready(table)
+                if ok is not True and ok is not None:
+                    return str(ok)
+            produced.update(k.output_cols)
+        return ""
+
+    def run_host(self, table: Table) -> Table:
+        for p in self.plans:
+            table = p.stage.transform(table)
+        return table
+
+    def run(self, table: Table, *, mini_batch_size: int, prefetch_depth: int,
+            shape_buckets: bool, tracer: Any) -> tuple[Table, dict]:
+        n = table.num_rows
+        jitted, params = self._build()
+        bs = max(int(mini_batch_size), 1)
+        # The ladder must depend only on mini_batch_size, never on the row
+        # count of THIS table: an n-derived max would mint n-specific bucket
+        # shapes for small tables and recompile in steady state.
+        bucketer = ShapeBucketer(bs) if shape_buckets else None
+        ins = {c: np.asarray(table[c]) for c in self.upload_cols}
+        family = (id(self), tuple(
+            (c, str(ins[c].dtype), ins[c].shape[1:]) for c in self.upload_cols))
+        stats = {
+            "kind": "fused", "segment": self.index,
+            "stages": list(self.stage_names), "rows": n,
+            "uploads": 0, "downloads": 0,
+            "prepare_seconds": 0.0, "fetch_seconds": 0.0,
+        }
+
+        def prepare(start: int):
+            stop = min(start + bs, n)
+            m = stop - start
+            target = bucketer.bucket_for(m) if bucketer is not None else bs
+            cols = {}
+            for c in self.upload_cols:
+                chunk = ins[c][start:stop]
+                if target > m:
+                    chunk = np.concatenate(
+                        [chunk, np.repeat(chunk[-1:], target - m, axis=0)])
+                cols[c] = chunk
+            dt = DeviceTable.from_host(cols)  # one upload per input column
+            stats["uploads"] += len(self.upload_cols)
+            return dt, m, target
+
+        def fetch(item):
+            outs, m = item
+            t0 = time.perf_counter()
+            host = tuple(np.asarray(o)[:m] for o in outs)
+            stats["fetch_seconds"] += time.perf_counter() - t0
+            stats["downloads"] += len(host)
+            return host
+
+        prefetch = Prefetcher(range(0, n, bs), prepare,
+                              depth=max(int(prefetch_depth), 0),
+                              name=f"fused-seg{self.index}")
+        readback = AsyncReadback(fetch, lag=1)
+        chunks: list[tuple[np.ndarray, ...]] = []
+        with tracer.start_span("pipeline.fused_segment", segment=self.index,
+                               stages=",".join(self.stage_names), rows=n):
+            for dt, m, target in prefetch:
+                shape_key = (target, tuple(
+                    (str(dt[c].dtype), tuple(dt[c].shape[1:]))
+                    for c in self.upload_cols))
+                # jax.jit does the real per-shape caching; the
+                # ExecutableCache entry makes hits/misses/RECOMPILES
+                # observable (steady-state recompiles == 0 is the bar)
+                fn = self._exec_cache.get_or_build(
+                    family, shape_key, lambda: jitted)
+                outs = fn(params, tuple(dt[c] for c in self.upload_cols))
+                chunks.extend(readback.push((outs, m)))
+            chunks.extend(readback.drain())
+        stats["prepare_seconds"] = prefetch.stats["prepare_seconds"]
+        stats["overlap_fraction"] = prefetch.overlap_fraction()
+        stats.update(self._exec_cache.stats())
+
+        out = table
+        for j, c in enumerate(self.download_cols):
+            arr = (np.concatenate([ch[j] for ch in chunks])
+                   if len(chunks) > 1 else chunks[0][j])
+            kern = self._last_producer[c]
+            want = kern.out_dtypes.get(c)
+            if want is not None and arr.dtype != np.dtype(want):
+                arr = arr.astype(want)
+            meta = kern.out_meta.get(c)
+            if callable(meta):
+                meta = meta(arr)
+            out = out.with_column(c, arr, meta=meta)
+        return out, stats
+
+
+# --------------------------------------------------------------------- #
+# FusedPipelineModel                                                    #
+# --------------------------------------------------------------------- #
+
+
+@register_stage
+class FusedPipelineModel(PipelineModel):
+    """A PipelineModel whose device-capable stage runs execute as single
+    fused XLA programs.  Behaves exactly like the staged model (same
+    columns, dtypes, metadata, values); non-fusable stages run on the host
+    path unchanged.  Build with `fuse(model)`."""
+
+    mini_batch_size = Param(
+        4096, "rows per fused device dispatch (large tables stream through "
+              "the segment in chunks of this size)", ptype=int)
+    prefetch_depth = Param(
+        2, "chunks prepared/uploaded ahead of device compute (0 = "
+           "sequential)", ptype=int)
+    shape_buckets = Param(
+        True, "pad ragged chunk tails to a pow-2 bucket ladder so the "
+              "compiled-shape set stays closed", ptype=bool)
+    fused_label = Param(
+        "pipeline", "label for the fusion-ratio gauge", ptype=str)
+
+    #: stats from the most recent transform: per-segment timings, transfer
+    #: counts, executable-cache counters, fusion ratio
+    last_stats: "dict | None" = None
+    _segments: "list | None" = None
+    _segments_key: "tuple | None" = None
+    _plan: "FusionPlan | None" = None
+
+    def plan(self) -> FusionPlan:
+        self._ensure_segments()
+        return self._plan
+
+    def _ensure_segments(self):
+        stages = list(self.get("stages") or [])
+        key = tuple(id(s) for s in stages)
+        if self._segments is None or self._segments_key != key:
+            self._plan = plan_fusion(stages)
+            segs = []
+            for i, sp in enumerate(self._plan.segments):
+                segs.append(_FusedSegment(i, sp.stages) if sp.fused else sp)
+            self._segments = segs
+            self._segments_key = key
+        return self._segments
+
+    def _transform(self, table: Table) -> Table:
+        segments = self._ensure_segments()
+        tracer = _get_tracer()
+        stats: dict[str, Any] = {
+            "segments": [], "uploads": 0, "downloads": 0,
+            "fusion_ratio": self._plan.fusion_ratio,
+            "n_stages": self._plan.n_stages,
+            "n_fused_stages": self._plan.n_fused_stages,
+        }
+        current = table
+        for seg in segments:
+            t0 = time.perf_counter()
+            if isinstance(seg, _FusedSegment):
+                why_not = seg.check_ready(current)
+                if why_not:
+                    current = seg.run_host(current)
+                    seg_stats = {
+                        "kind": "host_fallback", "segment": seg.index,
+                        "stages": list(seg.stage_names), "reason": why_not,
+                    }
+                else:
+                    current, seg_stats = seg.run(
+                        current,
+                        mini_batch_size=self.get("mini_batch_size"),
+                        prefetch_depth=self.get("prefetch_depth"),
+                        shape_buckets=self.get("shape_buckets"),
+                        tracer=tracer)
+                    stats["uploads"] += seg_stats["uploads"]
+                    stats["downloads"] += seg_stats["downloads"]
+            else:
+                for sp in seg.stages:
+                    current = sp.stage.transform(current)
+                seg_stats = {
+                    "kind": "host",
+                    "stages": [type(sp.stage).__name__ for sp in seg.stages],
+                }
+            seg_stats["seconds"] = time.perf_counter() - t0
+            stats["segments"].append(seg_stats)
+        self.last_stats = stats
+        _set_fusion_gauge(self.get("fused_label"), stats["fusion_ratio"])
+        return current
+
+    def _load_state(self, state: dict[str, Any]) -> None:
+        super()._load_state(state)
+        self._segments = None  # rebuild against the loaded stages
+
+
+def fuse(model: Any, **params: Any) -> FusedPipelineModel:
+    """Compile a PipelineModel (or any Transformer) for whole-pipeline
+    fusion.  Idempotent; non-fusable stages keep their staged path, so
+    `fuse` never changes results — only where the work runs."""
+    if isinstance(model, FusedPipelineModel):
+        return model
+    if isinstance(model, PipelineModel):
+        stages = list(model.get("stages") or [])
+    elif isinstance(model, Transformer):
+        stages = [model]
+    else:
+        raise TypeError(f"fuse() needs a Transformer, got {type(model).__name__}")
+    return FusedPipelineModel(stages, **params)
+
+
+# --------------------------------------------------------------------- #
+# observability shims (lazy: observability imports core.pipeline)       #
+# --------------------------------------------------------------------- #
+
+
+class _NullSpan:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+    def set(self, **kw):
+        pass
+
+
+class _NullTracer:
+    def start_span(self, *a, **kw):
+        return _NullSpan()
+
+
+def _get_tracer():
+    try:
+        from ..observability.tracing import get_tracer
+
+        return get_tracer()
+    except Exception:
+        return _NullTracer()
+
+
+def _set_fusion_gauge(label: str, ratio: float) -> None:
+    try:
+        from ..observability.metrics import get_registry
+
+        get_registry().gauge(
+            "mmlspark_tpu_pipeline_fusion_ratio",
+            "fraction of pipeline stages executing inside fused segments",
+            labels=("pipeline",)).labels(pipeline=label).set(ratio)
+    except Exception:
+        pass

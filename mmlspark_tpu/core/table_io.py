@@ -34,7 +34,56 @@ __all__ = [
     "write_parquet",
     "from_pandas",
     "to_pandas",
+    "DeviceTable",
 ]
+
+
+class DeviceTable:
+    """A lightweight dict of DEVICE-resident columns.
+
+    The device-side counterpart of `Table` used by the pipeline fusion
+    engine (`core/fusion.py`): columns live as jax arrays between fused
+    stage boundaries, so a fused run pays one upload at entry and one
+    read-back at exit instead of a host round-trip per stage.  Only the
+    pieces fusion needs — no metadata, no list columns, no mutation:
+    derive new tables with `with_columns`.
+    """
+
+    __slots__ = ("_cols",)
+
+    def __init__(self, cols: dict):
+        self._cols = dict(cols)
+
+    @classmethod
+    def from_host(cls, cols: dict) -> "DeviceTable":
+        """Upload host ndarrays (one `device_put` per column).  Note jax's
+        x64 default: float64 uploads as float32, int64 as int32."""
+        import jax.numpy as jnp
+
+        return cls({name: jnp.asarray(arr) for name, arr in cols.items()})
+
+    @property
+    def columns(self) -> list:
+        return list(self._cols)
+
+    def __getitem__(self, name: str):
+        return self._cols[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._cols
+
+    def __len__(self) -> int:
+        return len(self._cols)
+
+    def with_columns(self, cols: dict) -> "DeviceTable":
+        merged = dict(self._cols)
+        merged.update(cols)
+        return DeviceTable(merged)
+
+    def to_host(self) -> dict:
+        """Materialize every column back to host ndarrays (one read-back
+        per column)."""
+        return {name: np.asarray(arr) for name, arr in self._cols.items()}
 
 
 def read_csv(

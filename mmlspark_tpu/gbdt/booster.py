@@ -836,6 +836,122 @@ class Booster:
         return self.transform_score(
             self.predict_raw(x, device=device, num_iteration=num_iteration))
 
+    # objectives whose transform_score is the identity — the fused device
+    # path can return raw margins directly for these
+    IDENTITY_OBJECTIVES = (
+        "regression", "l1", "l2", "huber", "fair", "quantile", "mape")
+
+    def device_predict_fn(self):
+        """(params, fn) for the pipeline fusion engine (core/fusion.py):
+        `fn(params, x_f32) -> raw margins`, with the tree table and bin
+        boundaries passed as DEVICE-RESIDENT params rather than baked into
+        the executable as constants (so they upload once per segment, not
+        once per compiled shape).
+
+        Bit-identity with the staged path: the traversal mirrors
+        `_traverse_fn` exactly (same blocking, same tree-order float32
+        accumulation), and binning replays the host's float64
+        `searchsorted(ub, x, 'left')` == count(ub < x) with a tie
+        adjustment: for float32-representable x, `ub < x` differs from
+        `f32(ub) < x` only when f32(ub) rounded UP to exactly x, so
+        `(f32(ub) < x) | ((f32(ub) == x) & rounded_up)` reproduces the
+        float64 comparison bit-for-bit. Callers must guarantee x is
+        f32-representable (the estimator's `ready` check)."""
+        from .binning import MISSING_BIN
+
+        mapper = self.bin_mapper
+        if mapper.category_maps:
+            raise ValueError(
+                "device predict does not support categorical features")
+        nb_max = mapper.total_bins
+        ub64 = np.asarray(mapper.upper_bounds[:, 1:max(nb_max, 2)], np.float64)
+        ub32 = ub64.astype(np.float32)
+        rounded_up = ub32.astype(np.float64) > ub64
+
+        max_steps = int(self.feature.shape[1] // 2 + 1)
+        k = self.num_class
+        t_total = self.feature.shape[0]
+        block = min(64, max(t_total, 1))
+        pad = (-t_total) % block
+
+        def padded(a, fill=0):
+            a = np.asarray(a)
+            if not pad:
+                return a
+            shape = (pad,) + a.shape[1:]
+            return np.concatenate([a, np.full(shape, fill, a.dtype)])
+
+        def blocked(a):
+            return np.ascontiguousarray(a).reshape((-1, block) + a.shape[1:])
+
+        params = dict(
+            ub=ub32, rounded_up=rounded_up,
+            nb=np.asarray(mapper.num_bins, np.int32),
+            trees=dict(
+                feature=blocked(padded(self.feature, -1)),
+                thr=blocked(padded(self.threshold_bin)),
+                cat=blocked(padded(self.is_categorical)),
+                bitset=blocked(padded(self.cat_bitset)),
+                left=blocked(padded(self.left, -1)),
+                right=blocked(padded(self.right, -1)),
+                value=blocked(padded(self.value)),
+                cls=blocked(padded(self.tree_class)),
+            ),
+        )
+        bc = int(self.cat_bitset.shape[-1])
+        init = float(self.init_score)
+
+        def fn(params, x):
+            x = x.astype(jnp.float32)
+            ub, adj, nb = params["ub"], params["rounded_up"], params["nb"]
+            xv = x[:, :, None]
+            cnt = ((ub[None] < xv) | ((ub[None] == xv) & adj[None])).sum(
+                -1).astype(jnp.int32)
+            b = jnp.clip(cnt + 1, 1, jnp.maximum(nb[None] - 1, 1))
+            b = jnp.where(jnp.isnan(x), MISSING_BIN, b)
+            # host transform skips nb<=1 columns entirely (even NaN stays 0)
+            bins = jnp.where(nb[None] <= 1, 0, b).astype(jnp.int32)
+
+            n = bins.shape[0]
+            out0 = (jnp.zeros((n, k), jnp.float32) if k > 1
+                    else jnp.full((n,), init, jnp.float32))
+
+            def walk_one(tr):
+                node = jnp.zeros((n,), jnp.int32)
+
+                def body(_, node):
+                    f = jnp.maximum(tr["feature"][node], 0)
+                    col = bins[jnp.arange(n), f]
+                    go_left = jnp.where(
+                        tr["cat"][node],
+                        tr["bitset"][node, jnp.minimum(col, bc - 1)],
+                        col <= tr["thr"][node],
+                    )
+                    leaf = tr["feature"][node] < 0
+                    return jnp.where(
+                        leaf, node,
+                        jnp.where(go_left, tr["left"][node], tr["right"][node]),
+                    )
+
+                node = jax.lax.fori_loop(0, max_steps, body, node)
+                return tr["value"][node]
+
+            def add_one(acc, tv):
+                val, cls = tv
+                if k > 1:
+                    return acc.at[:, cls].add(val), None
+                return acc + val, None
+
+            def do_block(acc, blk):
+                vals = jax.vmap(walk_one)(blk)
+                acc, _ = jax.lax.scan(add_one, acc, (vals, blk["cls"]))
+                return acc, None
+
+            acc, _ = jax.lax.scan(do_block, out0, params["trees"])
+            return acc
+
+        return params, fn
+
     # ------------------------------------------------------------------ #
     # importances / persistence                                          #
     # ------------------------------------------------------------------ #

@@ -11,8 +11,7 @@ registry" is just jnp (SURVEY.md §2.1 NativeLoader row).
 
 from __future__ import annotations
 
-import functools
-from typing import Any, Callable
+from typing import Callable
 
 import jax
 import jax.numpy as jnp
@@ -93,20 +92,39 @@ class ImageTransformer(HasInputCol, HasOutputCol, Transformer):
 
     # -------------------------------------------------------------------- #
 
-    def _chain(self):
-        stage_list = tuple(
+    compile_count = 0  # op-chain compilations (class default for loaded stages)
+
+    def _stage_key(self) -> tuple:
+        return tuple(
             (s["op"], tuple(sorted((k, v) for k, v in s.items() if k != "op")))
             for s in self.get("stages")
         )
 
-        @functools.lru_cache(maxsize=32)
-        def compiled_for(shape):
-            def one(img):
-                for op, items in stage_list:
-                    img = _OP_FNS[op](img, dict(items))
-                return img
+    def _one_fn(self, stage_list: tuple) -> Callable:
+        def one(img):
+            for op, items in stage_list:
+                img = _OP_FNS[op](img, dict(items))
+            return img
 
-            return jax.jit(jax.vmap(one))
+        return one
+
+    def _chain(self):
+        """compiled_for(shape): the whole op chain as ONE jitted vmapped
+        program, cached on the INSTANCE keyed by (op chain, image shape) —
+        previously the jit object was rebuilt per `_transform` call, so jax
+        re-traced the chain on every batch."""
+        stage_list = self._stage_key()
+        cache = getattr(self, "_chain_cache", None)
+        if cache is None:
+            cache = self._chain_cache = {}
+
+        def compiled_for(shape):
+            key = (stage_list, shape)
+            fn = cache.get(key)
+            if fn is None:
+                fn = cache[key] = jax.jit(jax.vmap(self._one_fn(stage_list)))
+                self.compile_count += 1
+            return fn
 
         return compiled_for
 
@@ -137,6 +155,38 @@ class ImageTransformer(HasInputCol, HasOutputCol, Transformer):
             }
         return table.with_column(self.get("output_col"), out, meta=meta)
 
+    def device_kernel(self):
+        """Fusion kernel (core/fusion.py): the op chain vmapped over a
+        uniform NHWC batch — pixel math is float32 on both paths, so fused
+        output matches the staged bytes. Ragged image lists fall back to
+        the per-shape host path."""
+        from ..core.fusion import DeviceKernel
+
+        stage_list = self._stage_key()
+        in_col, out_col = self.get("input_col"), self.get("output_col")
+        one = self._one_fn(stage_list)
+
+        def fn(params, cols):
+            x = cols[in_col].astype(jnp.float32)
+            return {out_col: jax.vmap(one)(x)}
+
+        def ready(table: Table):
+            col = table[in_col]
+            if not (isinstance(col, np.ndarray) and col.ndim == 4):
+                return "ragged image column (grouped per-shape on host)"
+            return True
+
+        def image_meta(arr: np.ndarray) -> dict:
+            return {IMAGE_SPEC: {
+                "height": int(arr.shape[1]), "width": int(arr.shape[2]),
+                "channels": int(arr.shape[3]),
+            }}
+
+        return DeviceKernel(
+            fn=fn, input_cols=(in_col,), output_cols=(out_col,),
+            name="ImageTransformer", out_dtypes={out_col: np.float32},
+            out_meta={out_col: image_meta}, ready=ready)
+
 
 @register_stage
 class ResizeImageTransformer(HasInputCol, HasOutputCol, Transformer):
@@ -147,8 +197,13 @@ class ResizeImageTransformer(HasInputCol, HasOutputCol, Transformer):
     height = Param(None, "target height", ptype=int, required=True)
     width = Param(None, "target width", ptype=int, required=True)
 
-    def _transform(self, table: Table) -> Table:
-        t = ImageTransformer(
+    def _inner(self) -> ImageTransformer:
+        return ImageTransformer(
             input_col=self.get("input_col"), output_col=self.get("output_col"),
         ).resize(self.get("height"), self.get("width"))
-        return t.transform(table)
+
+    def _transform(self, table: Table) -> Table:
+        return self._inner().transform(table)
+
+    def device_kernel(self):
+        return self._inner().device_kernel()

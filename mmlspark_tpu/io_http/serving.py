@@ -698,11 +698,25 @@ def serve_model(
     output_col: str = "prediction",
     host: str = "127.0.0.1",
     port: int = 0,
+    fuse_pipeline: bool = True,
     **server_kw,
 ) -> ServingServer:
     """Deploy a fitted Transformer: JSON body {col: value, ...} in,
     {output_col: value} out (the `SparkServing - Deploying a Classifier`
-    notebook flow)."""
+    notebook flow).
+
+    PipelineModel handlers score through the whole-pipeline fusion path
+    (core/fusion.py) automatically: adjacent device-capable stages compile
+    into one XLA program per request batch. `fuse_pipeline=False` keeps
+    the stage-by-stage path."""
+    from ..core.fusion import FusedPipelineModel
+    from ..core.pipeline import PipelineModel
+
+    if (fuse_pipeline and isinstance(model, PipelineModel)
+            and not isinstance(model, FusedPipelineModel)):
+        from ..core.fusion import fuse
+
+        model = fuse(model)
 
     def handler(table: Table) -> Table:
         t = parse_request(table)

@@ -81,3 +81,42 @@ class ImageFeaturizer(DeepModelTransformer):
             .with_column(self.get("output_col"), feats.astype(np.float64))
             .with_meta(self.get("output_col"), {SCORE_KIND: "features"})
         )
+
+    def device_kernel(self):
+        """Fusion kernel: resize -> truncated forward -> flatten as ONE
+        device program (the staged path already computes the resize and
+        forward in float32, so the float64 output cast after read-back is
+        an exact widening — fused and staged bytes match)."""
+        from ..core.fusion import DeviceKernel
+
+        if self.bundle is None:
+            return "no model bundle attached (call set_model())"
+        if self.get("use_mesh"):
+            return "mesh-sharded apply manages its own device placement"
+        in_col = self.get("input_col")
+        out_col = self.get("output_col")
+        forward = self._forward_fn((self._fetch_name(),))
+        target = self.get("resize_to") or self.bundle.input_shape[:2]
+
+        def fn(params, cols):
+            x = cols[in_col].astype(jnp.float32)
+            if target and tuple(x.shape[1:3]) != tuple(target):
+                th, tw = int(target[0]), int(target[1])
+                x = jax.image.resize(
+                    x, (x.shape[0], th, tw, x.shape[3]), method="bilinear")
+            (feats,) = forward(params, x)
+            if feats.ndim > 2:
+                feats = feats.reshape(feats.shape[0], -1)
+            return {out_col: feats}
+
+        def ready(table: Table):
+            col = table[in_col]
+            if not (isinstance(col, np.ndarray) and col.ndim == 4):
+                return f"column {in_col!r} is not a uniform NHWC batch"
+            return True
+
+        return DeviceKernel(
+            fn=fn, input_cols=(in_col,), output_cols=(out_col,),
+            params=self._device_variables(), name="ImageFeaturizer",
+            out_dtypes={out_col: np.float64},
+            out_meta={out_col: {SCORE_KIND: "features"}}, ready=ready)

@@ -306,6 +306,54 @@ class DeepModelTransformer(Model):
         return [np.concatenate([c[j] for c in chunks])
                 for j in range(len(fetches))]
 
+    # -- fusion --------------------------------------------------------- #
+
+    def _device_variables(self):
+        """The bundle's variables as the fusion kernel's device-resident
+        params (bfloat16-cast once here, mirroring _apply_cache)."""
+        variables = self.bundle.variables
+        if self.get("bfloat16"):
+            variables = jax.tree.map(
+                lambda a: a.astype(jnp.bfloat16)
+                if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a,
+                variables,
+            )
+        return variables
+
+    def device_kernel(self):
+        """Fusion kernel (core/fusion.py): the same `_forward_fn` the staged
+        path jits, with the variables passed as device-resident params.
+        The forward is row-independent (eval mode — no batch statistics),
+        so the engine's chunking/padding cannot change any row's value."""
+        from ..core.fusion import DeviceKernel
+
+        if self.bundle is None:
+            return "no model bundle attached (call set_model())"
+        if self.get("use_mesh"):
+            return "mesh-sharded apply manages its own device placement"
+        fetch = dict(self.get("fetch_dict"))
+        fetches = tuple(fetch.values())
+        out_cols = tuple(fetch.keys())
+        in_col = self.get("input_col")
+        forward = self._forward_fn(fetches)
+
+        def fn(params, cols):
+            outs = forward(params, cols[in_col])
+            return dict(zip(out_cols, outs))
+
+        def ready(table: Table):
+            if isinstance(table[in_col], list):
+                return f"column {in_col!r} is a ragged list (host stacks it)"
+            return True
+
+        meta = {c: {SCORE_KIND: "probability" if f == "probability"
+                    else "raw_prediction"} for c, f in fetch.items()}
+        return DeviceKernel(
+            fn=fn, input_cols=(in_col,), output_cols=out_cols,
+            params=self._device_variables(), name="DeepModelTransformer",
+            out_dtypes={c: np.float32 for c in out_cols},
+            out_meta=meta, ready=ready)
+
     # -- persistence ---------------------------------------------------- #
 
     def _save_state(self) -> dict[str, Any]:

@@ -67,4 +67,12 @@ class EnsembleByKey(Transformer):
             out = out.with_column(name, vals)
         return out
 
+    def device_kernel(self):
+        """Non-fusable (core/fusion.py): groupby over python key tuples with
+        a DATA-DEPENDENT output row count — neither expressible as a
+        fixed-shape row-independent XLA program. The planner surfaces this
+        reason in fusion_report."""
+        return ("groupby with data-dependent output shape "
+                "(row count depends on key values)")
+
 

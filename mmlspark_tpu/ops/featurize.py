@@ -176,6 +176,69 @@ class AssembleFeaturesModel(Model):
             self.get("features_col"), features, meta={"feature_names": names}
         )
 
+    def device_kernel(self):
+        """Fusion kernel (core/fusion.py): numeric/vector/onehot assembly is
+        pure gather/compare/concat, byte-identical to the staged path
+        (float->float32 rounds to nearest in both numpy and XLA; float->int
+        category indices truncate toward zero in both). String kinds
+        (levels/hash) need host string processing, so any such spec keeps
+        the whole stage on the host path."""
+        from ..core.fusion import DeviceKernel
+
+        specs = list(self.specs)
+        if not specs:
+            return "no feature specs (empty assembly)"
+        for s in specs:
+            if s["kind"] not in ("numeric", "vector", "onehot"):
+                return f"spec kind {s['kind']!r} needs host string processing"
+        out_col = self.get("features_col")
+        in_cols = tuple(dict.fromkeys(s["col"] for s in specs))
+        names: list[str] = []
+        for s in specs:
+            if s["kind"] == "numeric":
+                names.append(s["col"])
+            elif s["kind"] == "vector":
+                names.extend(f"{s['col']}_{i}" for i in range(s["dim"]))
+            else:
+                names.extend(f"{s['col']}={i}" for i in range(s["dim"]))
+
+        def fn(params, cols):
+            import jax.numpy as jnp
+
+            n = cols[specs[0]["col"]].shape[0]
+            parts = []
+            for s in specs:
+                x = cols[s["col"]]
+                if s["kind"] == "numeric":
+                    parts.append(x.astype(jnp.float32).reshape(n, 1))
+                elif s["kind"] == "vector":
+                    parts.append(x.astype(jnp.float32).reshape(n, s["dim"]))
+                else:  # onehot
+                    idx = x.astype(jnp.int32)
+                    valid = (idx >= 0) & (idx < s["dim"])
+                    oh = (idx[:, None] == jnp.arange(s["dim"])[None, :])
+                    parts.append((oh & valid[:, None]).astype(jnp.float32))
+            return {out_col: jnp.concatenate(parts, axis=1)}
+
+        def ready(table: Table):
+            for s in specs:
+                col = table[s["col"]]
+                if (s["kind"] == "onehot"
+                        and np.issubdtype(col.dtype, np.floating)
+                        and not np.isfinite(col).all()):
+                    # host int64-cast of NaN/inf is a huge sentinel (-> zero
+                    # row); XLA's float->int is implementation-defined
+                    return f"non-finite category indices in {s['col']!r}"
+                if (np.issubdtype(col.dtype, np.integer) and col.size
+                        and (col.min() < -(2 ** 31) or col.max() >= 2 ** 31)):
+                    return f"values in {s['col']!r} exceed device int32"
+            return True
+
+        return DeviceKernel(
+            fn=fn, input_cols=in_cols, output_cols=(out_col,),
+            name="AssembleFeatures", out_dtypes={out_col: np.float32},
+            out_meta={out_col: {"feature_names": names}}, ready=ready)
+
     def _save_state(self) -> dict[str, Any]:
         return {"specs": self.specs}
 

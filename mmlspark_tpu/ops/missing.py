@@ -69,10 +69,50 @@ class CleanMissingDataModel(Model):
         for c, o, fill in zip(
             self.get("input_cols"), self.get("output_cols"), self.fill_values
         ):
-            col = np.asarray(table[c], dtype=np.float64)
-            filled = np.where(np.isnan(col), fill, col)
+            col = np.asarray(table[c])
+            if col.dtype == np.float32:
+                # keep float32 columns float32 (fill rounds to the column
+                # dtype) — the layout the device path uses, so fused and
+                # staged runs produce the same bytes
+                filled = np.where(np.isnan(col), np.float32(fill), col)
+            else:
+                col = col.astype(np.float64)
+                filled = np.where(np.isnan(col), fill, col)
             out = out.with_column(o, filled)
         return out
+
+    def device_kernel(self):
+        """Fusion kernel: `where(isnan(x), fill, x)` elementwise. Only
+        float32 columns fuse — the staged path computes float64 columns in
+        float64, and the fill value is generally not representable in
+        float32, so a device (f32) run could not be byte-identical."""
+        from ..core.fusion import DeviceKernel
+
+        ins = tuple(self.get("input_cols"))
+        outs = tuple(self.get("output_cols"))
+        fills = [np.float32(f) for f in self.fill_values]
+
+        def fn(params, cols):
+            import jax.numpy as jnp
+
+            result = {}
+            for c, o, fill in zip(ins, outs, fills):
+                x = cols[c]
+                result[o] = jnp.where(jnp.isnan(x), fill, x)
+            return result
+
+        def ready(table: Table):
+            for c in ins:
+                col = table[c]
+                if col.dtype != np.float32:
+                    return (f"column {c!r} is {col.dtype} (float64 fill "
+                            "values are not representable on device)")
+            return True
+
+        return DeviceKernel(
+            fn=fn, input_cols=ins, output_cols=outs,
+            name="CleanMissingData",
+            out_dtypes={o: np.float32 for o in outs}, ready=ready)
 
     def _save_state(self) -> dict[str, Any]:
         return {"fill_values": list(self.fill_values)}

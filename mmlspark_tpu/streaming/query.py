@@ -80,8 +80,23 @@ class StreamingQuery:
                  source_lookahead: int = 1,
                  name: str = "query",
                  metrics: Any = None,
-                 tracer: Any = None) -> None:
+                 tracer: Any = None,
+                 fuse_pipeline: bool = True) -> None:
         self.source = source
+        # PipelineModel transforms score through the whole-pipeline fusion
+        # path (core/fusion.py): adjacent device-capable stages compile
+        # into one XLA program per micro-batch. FusedPipelineModel still
+        # exposes the `stages` param, so stateful-operator discovery below
+        # walks the same leaves either way.
+        if fuse_pipeline and transform is not None:
+            from ..core.fusion import FusedPipelineModel
+            from ..core.pipeline import PipelineModel
+
+            if (isinstance(transform, PipelineModel)
+                    and not isinstance(transform, FusedPipelineModel)):
+                from ..core.fusion import fuse
+
+                transform = fuse(transform)
         self.transform = transform
         self.sink = sink if sink is not None else MemorySink()
         self.name = name

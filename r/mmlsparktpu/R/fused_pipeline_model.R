@@ -1,0 +1,21 @@
+#' FusedPipelineModel (Model)
+#'
+#' A PipelineModel whose device-capable stage runs execute as single fused XLA programs.  Behaves exactly like the staged model (same columns, dtypes, metadata, values); non-fusable stages run on the host path unchanged.  Build with `fuse(model)`.
+#'
+#' @param x a data.frame or tpu_table
+#' @param stages list of fitted transformer stages
+#' @param mini_batch_size rows per fused device dispatch (large tables stream through the segment in chunks of this size)
+#' @param prefetch_depth chunks prepared/uploaded ahead of device compute (0 = sequential)
+#' @param shape_buckets pad ragged chunk tails to a pow-2 bucket ladder so the compiled-shape set stays closed
+#' @param fused_label label for the fusion-ratio gauge
+#' @export
+ml_fused_pipeline_model <- function(x, stages = NULL, mini_batch_size = 4096L, prefetch_depth = 2L, shape_buckets = TRUE, fused_label = "pipeline")
+{
+  params <- list()
+  if (!is.null(stages)) params$stages <- as.list(stages)
+  if (!is.null(mini_batch_size)) params$mini_batch_size <- as.integer(mini_batch_size)
+  if (!is.null(prefetch_depth)) params$prefetch_depth <- as.integer(prefetch_depth)
+  if (!is.null(shape_buckets)) params$shape_buckets <- as.logical(shape_buckets)
+  if (!is.null(fused_label)) params$fused_label <- as.character(fused_label)
+  .tpu_apply_stage("mmlspark_tpu.core.fusion.FusedPipelineModel", params, x, is_estimator = FALSE)
+}

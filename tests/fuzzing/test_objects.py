@@ -84,11 +84,17 @@ def _mlp_bundle(f=8, outputs=2):
 
 
 def _core_objects(ctx) -> dict[str, list[TestObject]]:
+    from mmlspark_tpu.core.fusion import FusedPipelineModel
     from mmlspark_tpu.core.pipeline import Pipeline, Timer
+    from mmlspark_tpu.nn import DeepModelTransformer
+    from mmlspark_tpu.ops.conversion import DataConversion
     from mmlspark_tpu.ops.indexer import ValueIndexer
     from mmlspark_tpu.ops.stages import DropColumns
 
     cat = Table({"c": ["a", "b", "a", "c"], "x": np.arange(4.0)})
+    f_table = Table({
+        "features": np.random.default_rng(1).normal(size=(12, 8)).astype(np.float32)
+    })
     return {
         "mmlspark_tpu.core.pipeline.Pipeline": [TestObject(
             Pipeline([ValueIndexer(input_col="c", output_col="i")]),
@@ -97,6 +103,24 @@ def _core_objects(ctx) -> dict[str, list[TestObject]]:
         )],
         "mmlspark_tpu.core.pipeline.Timer": [TestObject(
             Timer(DropColumns(cols=["x"])),
+            transform_table=cat,
+        )],
+        "mmlspark_tpu.core.fusion.FusedPipelineModel": [TestObject(
+            # fully fusable model+postprocess run with the fusion knobs
+            # exercised: bucketed ragged tail (12 rows, bs 8 -> 8 + 4)
+            FusedPipelineModel(
+                [DeepModelTransformer(input_col="features").set_model(
+                    _mlp_bundle(8, 3)),
+                 DataConversion(cols=["output"], convert_to="float")],
+                mini_batch_size=8, prefetch_depth=1, shape_buckets=True,
+                fused_label="fuzz",
+            ),
+            transform_table=f_table,
+        ), TestObject(
+            # host-fallback path: a string-column stage that declares no
+            # device kernel keeps the per-stage semantics unchanged
+            FusedPipelineModel([DropColumns(cols=["x"])],
+                               shape_buckets=False),
             transform_table=cat,
         )],
     }

@@ -1,12 +1,14 @@
 #!/usr/bin/env bash
 # CI quality gate (the reference's `runme` analogue, L8 tooling):
 #   1. metric-name lint (static: catches bad names on rarely-taken paths)
-#   2. full test suite on the 8-virtual-device CPU mesh
-#   3. multi-chip dryrun (sharding compiles + replicated-model check)
-#   4. benchmark smoke on CPU (fail-soft backend selection)
+#   2. pipeline-fusion segment report (fails if an exemplar stops fusing)
+#   3. full test suite on the 8-virtual-device CPU mesh
+#   4. multi-chip dryrun (sharding compiles + replicated-model check)
+#   5. benchmark smoke on CPU (fail-soft backend selection)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 python tools/metric_lint.py
+python tools/fusion_report.py
 python -m pytest tests/ -q
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
 MMLSPARK_TPU_BENCH_FORCE_CPU=1 python bench.py

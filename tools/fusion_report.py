@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Print the pipeline-fusion segment plan for exemplar pipelines.
+
+`fuse()` (core/fusion.py) partitions a PipelineModel into maximal
+device-capable runs; each run compiles into ONE jitted composition.
+Whether a given stage fuses is a static property of its configuration
+(its `device_kernel()` declaration), so the plan can drift silently when
+a stage gains a parameter its kernel doesn't support. This report makes
+the plan a CI-visible artifact: it builds one exemplar pipeline per
+wired stage family, prints `FusionPlan.describe()` for each, and FAILS
+if a pipeline that is expected to fuse fully no longer does.
+
+Usage: python tools/fusion_report.py    # exit 1 if an expectation breaks
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def build_pipelines():
+    """-> list of (title, PipelineModel, expected_fusion_ratio)."""
+    from mmlspark_tpu.core.pipeline import pipeline_model
+    from mmlspark_tpu.core.schema import Table
+    from mmlspark_tpu.gbdt.estimators import GBDTRegressor
+    from mmlspark_tpu.image.transformer import ImageTransformer
+    from mmlspark_tpu.nn.models import ModelBundle
+    from mmlspark_tpu.nn.runner import DeepModelTransformer
+    from mmlspark_tpu.ops.conversion import DataConversion
+    from mmlspark_tpu.ops.ensemble import EnsembleByKey
+    from mmlspark_tpu.ops.featurize import AssembleFeatures
+    from mmlspark_tpu.ops.missing import CleanMissingData
+
+    rng = np.random.default_rng(0)
+    tab = Table({c: rng.normal(size=32) for c in "abcd"})
+    asm = AssembleFeatures(columns_to_featurize=list("abcd")).fit(tab)
+    clean = CleanMissingData(
+        input_cols=["a"], output_cols=["a"], cleaning_mode="Mean",
+    ).fit(Table({"a": tab["a"].astype(np.float32)}))
+    mlp = DeepModelTransformer(input_col="features").set_model(
+        ModelBundle.init("mlp", (4,), seed=0, num_outputs=2))
+    conv = DataConversion(cols=["output"], convert_to="float")
+    image = (ImageTransformer(input_col="image", output_col="image")
+             .resize(8, 8).gray(keep_channels=True))
+    gbdt = GBDTRegressor(
+        features_col="features", label_col="label", num_iterations=4,
+        num_leaves=7,
+    ).fit(Table({"features": rng.normal(size=(64, 3)),
+                 "label": rng.normal(size=64)}))
+    ens = EnsembleByKey(keys=["k"], cols=["output"])
+
+    return [
+        ("tabular scoring (assemble -> clean -> mlp -> convert)",
+         pipeline_model(clean, asm, mlp, conv), 1.0),
+        ("image scoring (op chain -> mlp)",
+         pipeline_model(image, mlp), 1.0),
+        ("gbdt regression", pipeline_model(asm, gbdt), 1.0),
+        ("host sandwich (ensemble groupby splits the run)",
+         pipeline_model(asm, mlp, ens, conv), 0.75),
+    ]
+
+
+def main() -> int:
+    from mmlspark_tpu.core.fusion import plan_fusion
+
+    failures = []
+    for title, model, expected_ratio in build_pipelines():
+        plan = plan_fusion(model.get("stages"))
+        fused_t, staged_t = plan.transfers_per_batch()
+        print(f"== {title} ==")
+        print(plan.describe())
+        print(f"   transfers/batch: fused={fused_t} staged={staged_t}")
+        if plan.fusion_ratio < expected_ratio:
+            failures.append(
+                f"{title}: fusion ratio {plan.fusion_ratio:.2f} < "
+                f"expected {expected_ratio:.2f}")
+        print()
+    if failures:
+        print("FUSION REPORT FAILURES:")
+        for f in failures:
+            print(f"  {f}")
+        return 1
+    print("fusion report ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
