@@ -1164,6 +1164,76 @@ def bench_instrumentation() -> dict:
     }
 
 
+def bench_fleet_scrape() -> dict:
+    """Cost of the fleet-observability aggregation path: scrape every
+    replica's /metrics over real HTTP, parse, merge, and re-render the
+    fleet exposition — at n_hosts = 1, 2, 4 in-process ServingServers
+    (each with a PRIVATE registry, so the series sets are disjoint and
+    realistic). Reported: per-n aggregate latency floor, plus the
+    overhead ratio of the n=4 aggregate over a single-replica scrape —
+    how much the federation layer adds on top of just fetching one
+    exposition."""
+    import json as _json
+    import urllib.request
+
+    from mmlspark_tpu.core.schema import Table
+    from mmlspark_tpu.io_http.schema import make_reply, parse_request
+    from mmlspark_tpu.io_http.serving import ServingServer
+    from mmlspark_tpu.observability import MetricsRegistry
+    from mmlspark_tpu.observability.fleet import MetricsAggregator
+
+    def handler(table: Table) -> Table:
+        t = parse_request(table)
+        return make_reply(
+            t.with_column("y", np.asarray(t["x"], dtype=float) * 2), "y")
+
+    servers = []
+    try:
+        for _ in range(4):
+            srv = ServingServer(handler, metrics=MetricsRegistry()).start()
+            servers.append(srv)
+            for i in range(4):  # populate counters + latency histogram
+                req = urllib.request.Request(
+                    srv.url, data=_json.dumps({"x": float(i)}).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST")
+                urllib.request.urlopen(req, timeout=10).read()
+
+        def aggregate_floor(n: int, passes: int = 7) -> float:
+            agg = MetricsAggregator(
+                urls={str(i): f"{s.url.rstrip('/')}/metrics"
+                      for i, s in enumerate(servers[:n])})
+            best = float("inf")
+            for _ in range(passes):
+                t0 = time.perf_counter()
+                agg.scrape()
+                text = agg.render()
+                best = min(best, time.perf_counter() - t0)
+            assert text  # the exposition actually rendered
+            return best
+
+        def single_scrape_floor(passes: int = 7) -> float:
+            url = f"{servers[0].url.rstrip('/')}/metrics"
+            best = float("inf")
+            for _ in range(passes):
+                t0 = time.perf_counter()
+                with urllib.request.urlopen(url, timeout=10) as r:
+                    r.read()
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        single = single_scrape_floor()
+        by_n = {n: aggregate_floor(n) for n in (1, 2, 4)}
+    finally:
+        for srv in servers:
+            srv.stop()
+    return {
+        "aggregate_ms_by_n": {n: v * 1e3 for n, v in by_n.items()},
+        "single_scrape_ms": single * 1e3,
+        "overhead_vs_single_scrape": by_n[4] / max(single, 1e-9),
+    }
+
+
 def _write_metrics_snapshot() -> None:
     """Dump the process-default registry next to the bench output so the
     run's counters (executable-cache hits, serving counts, streaming rows)
@@ -1365,6 +1435,11 @@ def _run_suite(platform: str) -> dict:
     except Exception as e:  # noqa: BLE001 — overhead row is auxiliary
         print(f"bench: instrumentation bench failed ({e!r})", file=sys.stderr)
         instrumentation = None
+    try:
+        fleet_scrape = bench_fleet_scrape()
+    except Exception as e:  # noqa: BLE001 — aggregation row is auxiliary
+        print(f"bench: fleet scrape bench failed ({e!r})", file=sys.stderr)
+        fleet_scrape = None
     _write_metrics_snapshot()
 
     resident = runner.get("resident_images_per_sec", 0.0)
@@ -1454,6 +1529,16 @@ def _run_suite(platform: str) -> dict:
             "instrumentation_overhead_disabled": round(
                 instrumentation["ratio_disabled"], 3)
                 if instrumentation else None,
+            "fleet_scrape_aggregate_ms": {
+                str(n): round(v, 3) for n, v in
+                fleet_scrape["aggregate_ms_by_n"].items()}
+                if fleet_scrape else None,
+            "fleet_scrape_single_ms": round(
+                fleet_scrape["single_scrape_ms"], 3)
+                if fleet_scrape else None,
+            "fleet_scrape_overhead_vs_single": round(
+                fleet_scrape["overhead_vs_single_scrape"], 3)
+                if fleet_scrape else None,
             "headroom_note": (
                 "gbdt fit is HBM-bound (see gbdt_modeled_hbm_* vs chip peak); "
                 "end-to-end runner throughput is host->device transfer bound: "

@@ -19,6 +19,7 @@ import urllib.error
 import urllib.request
 from typing import Iterable, Sequence
 
+from ..observability.tracing import current_traceparent
 from ..resilience.breaker import CircuitBreaker
 from ..resilience.policy import (RetryPolicy, is_retryable_exception,
                                  is_retryable_status)
@@ -62,12 +63,22 @@ def http_send(
         policy = _legacy_policy(retries, backoff_ms)
     if breaker is not None and not breaker.allow():
         return _breaker_open_response(breaker)
+    # W3C trace propagation: when a span is active, stamp (or REPLACE —
+    # per-hop parent-id semantics) the traceparent header so the server
+    # side binds its request span into this trace. No active span leaves
+    # the caller's own headers untouched.
+    headers = dict(req.headers or {})
+    traceparent = current_traceparent()
+    if traceparent is not None:
+        headers = {k: v for k, v in headers.items()
+                   if k.lower() != "traceparent"}
+        headers["traceparent"] = traceparent
     sess = policy.session()
     last_exc: Exception | None = None
     while True:
         try:
             r = urllib.request.Request(
-                req.url, data=req.entity, headers=req.headers,
+                req.url, data=req.entity, headers=headers,
                 method=req.method,
             )
             with urllib.request.urlopen(r, timeout=timeout) as resp:

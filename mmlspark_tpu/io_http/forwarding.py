@@ -121,6 +121,14 @@ class PortForward:
     def alive(self) -> bool:
         return self._proc is not None and self._proc.poll() is None
 
+    def status(self) -> dict:
+        """Health-probe payload: tunnel liveness + coordinates (surfaced
+        under the serving `/healthz` extras so a dead ssh shows up in the
+        fleet health view instead of silently blackholing traffic)."""
+        return {"alive": self.alive(), "remote_host": self.remote_host,
+                "remote_port": self.remote_port,
+                "local_port": self.local_port}
+
     def close(self) -> None:
         if self._proc is not None and self._proc.poll() is None:
             self._proc.terminate()

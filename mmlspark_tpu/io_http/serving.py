@@ -64,6 +64,9 @@ class _Exchange:
     enqueued_at: float = 0.0
     # absolute perf_counter deadline (request_deadline_s); None = no deadline
     deadline: float | None = None
+    # the serving.request span (handler thread) — the batcher parents its
+    # serving.score span on it so one trace covers park -> score -> reply
+    span: Any = None
 
 
 class SingleSegmentHandler(BaseHTTPRequestHandler):
@@ -106,6 +109,8 @@ class ServingServer:
         drain_timeout_s: float = 5.0,
         bucket_batches: bool = False,
         metrics: Any = None,
+        warmup_request: "HTTPRequestData | None" = None,
+        tracer: Any = None,
     ):
         if mode not in ("continuous", "batch"):
             raise ValueError(f"mode must be 'continuous' or 'batch', got {mode!r}")
@@ -199,6 +204,13 @@ class ServingServer:
                             "requests refused 503 (overload / draining)")
         self._c_expired = _own("mmlspark_tpu_serving_requests_expired_total",
                                "requests answered 504 past their deadline")
+        self._c_failed = _own("mmlspark_tpu_serving_requests_failed_total",
+                              "requests answered 500 from a failed "
+                              "scoring batch")
+        self._g_queue = self.metrics.gauge(
+            "mmlspark_tpu_serving_queue_depth",
+            "requests parked awaiting scoring",
+            labels=("server",)).labels(server=self.server_label)
         self._h_latency = self.metrics.histogram(
             "mmlspark_tpu_serving_latency_seconds",
             "service latency, enqueue to reply written",
@@ -215,6 +227,19 @@ class ServingServer:
         self._counter_lock = threading.Lock()
         # rolling service latencies (seconds, enqueue -> reply written)
         self._latencies: collections.deque[float] = collections.deque(maxlen=8192)
+        # distributed tracing: None resolves the process-default tracer
+        # PER REQUEST so tests can swap it after the server started
+        self._tracer = tracer
+        # readiness (the /readyz contract): with a warmup request the
+        # server reports ready only after warmup() has scored every
+        # bucket-ladder rung — the executable cache holds every shape the
+        # batcher can produce, so steady state is zero-recompile. Extra
+        # liveness probes (e.g. the reverse tunnel) hook in via
+        # health_probes and surface under /healthz.
+        self.warmup_request = warmup_request
+        self._warm_rungs: set[int] = set()
+        self._warmed = threading.Event()
+        self.health_probes: dict[str, Callable[[], Any]] = {}
 
     # read-only views over the registry children — the historical int
     # attributes, same exact per-server values
@@ -237,6 +262,76 @@ class ServingServer:
     @property
     def requests_expired(self) -> int:
         return int(self._c_expired.value)
+
+    @property
+    def requests_failed(self) -> int:
+        return int(self._c_failed.value)
+
+    # -- health / readiness --------------------------------------------- #
+
+    def tracer(self):
+        if self._tracer is not None:
+            return self._tracer
+        from ..observability.tracing import get_tracer
+
+        return get_tracer()
+
+    @property
+    def ready(self) -> bool:
+        """Liveness is /healthz; THIS is /readyz: started, not draining,
+        and (when a warmup request is configured) every bucket-ladder rung
+        scored once so the executable cache is fully populated."""
+        if self._server is None or self._draining:
+            return False
+        if self.warmup_request is None:
+            return True
+        if self.bucketer is not None:
+            return set(self.bucketer.ladder) <= self._warm_rungs
+        return self._warmed.is_set()
+
+    def warmup(self, request: "HTTPRequestData | None" = None) -> int:
+        """Score `request` once per bucket-ladder rung (one batch without
+        a ladder), populating the executable cache so live traffic never
+        pays a compile. Runs in a background thread at start() when
+        `warmup_request` is set; callable directly for explicit warmup
+        (e.g. before a rolling cutover). Returns rungs warmed."""
+        req = request if request is not None else self.warmup_request
+        if req is None:
+            raise ValueError("no warmup request configured or given")
+        if self.handler is None:
+            raise RuntimeError("warmup scores through the continuous-mode "
+                               "handler; batch mode warms via its query")
+        rungs = (list(self.bucketer.ladder) if self.bucketer is not None
+                 else [1])
+        for rung in rungs:
+            out = self.handler(Table({"request": [req] * rung}))
+            if len(out["reply"]) != rung:
+                raise ValueError(
+                    f"warmup handler returned {len(out['reply'])} replies "
+                    f"for a batch of {rung}")
+            self._warm_rungs.add(rung)
+        self._warmed.set()
+        return len(rungs)
+
+    def _warmup_async(self) -> None:
+        try:
+            self.warmup()
+        except Exception:  # noqa: BLE001 — a failed warmup keeps /readyz 503
+            pass
+
+    def health(self) -> dict:
+        """The /healthz payload: process-alive facts + extra probe
+        results (a failing probe reports its error, never raises)."""
+        probes = {}
+        for name, fn in list(self.health_probes.items()):
+            try:
+                probes[name] = fn()
+            except Exception as e:  # noqa: BLE001 — probe failure is data
+                probes[name] = {"error": str(e)}
+        return {"status": "ok", "draining": self._draining,
+                "ready": self.ready, "pending": self._load(),
+                "warm_rungs": sorted(self._warm_rungs),
+                "probes": probes}
 
     # ------------------------------------------------------------------ #
 
@@ -269,6 +364,18 @@ class ServingServer:
                     self.connection.settimeout(self.timeout)
 
             def _handle_post(self):
+                # bind this request into the caller's trace: a client-
+                # injected W3C traceparent becomes the parent of the
+                # serving.request span, so the merged fleet trace shows
+                # client -> gateway -> replica as one tree
+                tracer = outer.tracer()
+                remote = tracer.extract(self.headers.get("traceparent"))
+                with tracer.start_span("serving.request", parent=remote,
+                                       path=self.path,
+                                       server=outer.server_label) as span:
+                    self._serve_post(span)
+
+            def _serve_post(self, span):
                 outer._c_seen.inc()
                 if self.headers.get("Transfer-Encoding"):
                     # chunked bodies aren't framed by Content-Length; reading
@@ -291,6 +398,7 @@ class ServingServer:
                         outer.max_pending and
                         outer._load() >= outer.max_pending):
                     outer._c_shed.inc()
+                    span.set(status=503)
                     self.send_response(503)
                     self.send_header("Retry-After", "1")
                     self.send_header("Content-Length", "0")
@@ -304,7 +412,8 @@ class ServingServer:
                 ), enqueued_at=now,
                     deadline=(now + outer.request_deadline_s
                               if outer.request_deadline_s is not None
-                              else None))
+                              else None),
+                    span=span)
                 ex_id = None
                 if outer.mode == "batch":
                     ex_id = str(next(outer._id_counter))
@@ -316,6 +425,7 @@ class ServingServer:
                         outer._pending[ex_id] = ex
                 else:
                     outer._queue.put(ex)
+                    outer._g_queue.set(outer._load())
                 wait_s = outer.reply_timeout_s
                 if outer.request_deadline_s is not None:
                     wait_s = min(wait_s, outer.request_deadline_s)
@@ -329,11 +439,13 @@ class ServingServer:
                         with outer._counter_lock:
                             outer._pending.pop(ex_id, None)
                     outer._c_expired.inc()
+                    span.set(status=504)
                     self.send_response(504)
                     self.send_header("Content-Length", "0")
                     self.end_headers()
                     return
                 resp = ex.response or HTTPResponseData(500, "no response")
+                span.set(status=resp.status_code or 500)
                 self.send_response(resp.status_code or 500)
                 entity = resp.entity or b""
                 for k, v in resp.headers.items():
@@ -354,10 +466,19 @@ class ServingServer:
                 with outer._counter_lock:
                     outer._latencies.append(elapsed)
 
+            def _reply_json(self, status: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):  # noqa: N802 — health/info + /metrics
                 # Prometheus scrape surface; every other path keeps the
                 # info JSON (FleetRendezvous polls GET / per replica)
-                if self.path.split("?", 1)[0] == "/metrics":
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
                     body = outer.metrics.render_prometheus().encode()
                     self.send_response(200)
                     self.send_header(
@@ -366,6 +487,22 @@ class ServingServer:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                    return
+                if path == "/healthz":
+                    # liveness: answering at all IS the signal — 200 even
+                    # while draining (restarting a draining server would
+                    # drop the very requests the drain protects)
+                    self._reply_json(200, outer.health())
+                    return
+                if path == "/readyz":
+                    # readiness: load balancers route only to 200
+                    ready = outer.ready
+                    self._reply_json(200 if ready else 503, {
+                        "ready": ready, "draining": outer._draining,
+                        "warm_rungs": sorted(outer._warm_rungs),
+                        "ladder": (list(outer.bucketer.ladder)
+                                   if outer.bucketer is not None else None),
+                    })
                     return
                 # process-wide executable-cache counters: steady-state
                 # recompiles staying flat is the bucket ladder working
@@ -378,6 +515,8 @@ class ServingServer:
                     "answered": outer.requests_answered,
                     "shed": outer.requests_shed,
                     "expired": outer.requests_expired,
+                    "failed": outer.requests_failed,
+                    "ready": outer.ready,
                     "executable_cache_hits": exe["hits"],
                     "executable_cache_misses": exe["misses"],
                     "executable_cache_recompiles": exe["recompiles"],
@@ -404,6 +543,10 @@ class ServingServer:
             bt = threading.Thread(target=self._batch_loop, daemon=True)
             bt.start()
             self._threads.append(bt)
+            if self.warmup_request is not None:
+                wt = threading.Thread(target=self._warmup_async, daemon=True)
+                wt.start()
+                self._threads.append(wt)
         return self
 
     def _load(self) -> int:
@@ -565,26 +708,45 @@ class ServingServer:
                          if ex.deadline is None or now <= ex.deadline]
                 if not batch:
                     continue
-            try:
-                requests = [ex.request for ex in batch]
-                if self.bucketer is not None:
-                    target = self.bucketer.bucket_for(len(requests))
-                    self._c_bucket.labels(
-                        server=self.server_label, bucket=str(target)).inc()
-                    requests = requests + \
-                        [requests[-1]] * (target - len(requests))
-                table = Table({"request": requests})
-                out = self.handler(table)
-                replies = out["reply"]
-                if len(replies) != len(requests):
-                    raise ValueError(
-                        f"handler returned {len(replies)} replies for a "
-                        f"batch of {len(requests)} requests — handlers must "
-                        "preserve row count and order"
-                    )
-                replies = list(replies)[:len(batch)]
-            except Exception as e:  # noqa: BLE001 — per-batch failure -> 500s
-                replies = [_handler_error_response(e)] * len(batch)
+            self._g_queue.set(self._load())
+            # a single-exchange batch scores INSIDE that request's span,
+            # so a proxying handler's outbound http_send propagates the
+            # same trace downstream (client -> gateway -> replica); multi-
+            # request batches fan in, so serving.score stands alone
+            tracer = self.tracer()
+            parent = batch[0].span if len(batch) == 1 else None
+            if parent is not None and not getattr(parent, "span_id", 0):
+                parent = None
+            with tracer.start_span("serving.score", parent=parent,
+                                   batch_rows=len(batch)) as sspan:
+                target = None
+                try:
+                    requests = [ex.request for ex in batch]
+                    if self.bucketer is not None:
+                        target = self.bucketer.bucket_for(len(requests))
+                        self._c_bucket.labels(
+                            server=self.server_label,
+                            bucket=str(target)).inc()
+                        requests = requests + \
+                            [requests[-1]] * (target - len(requests))
+                    table = Table({"request": requests})
+                    out = self.handler(table)
+                    replies = out["reply"]
+                    if len(replies) != len(requests):
+                        raise ValueError(
+                            f"handler returned {len(replies)} replies for a "
+                            f"batch of {len(requests)} requests — handlers "
+                            "must preserve row count and order"
+                        )
+                    replies = list(replies)[:len(batch)]
+                    if target is not None:
+                        # this rung's executable is compiled now — the
+                        # readiness signal warmup() drives deliberately
+                        self._warm_rungs.add(target)
+                except Exception as e:  # noqa: BLE001 — batch failure -> 500s
+                    self._c_failed.inc(len(batch))
+                    sspan.set(error=str(e))
+                    replies = [_handler_error_response(e)] * len(batch)
             for ex, resp in zip(batch, replies):
                 ex.response = resp
                 ex.event.set()
@@ -648,6 +810,7 @@ class MicroBatchQuery:
                 self.server.reply(out_ids, list(out["reply"]))
             except Exception as e:  # noqa: BLE001 — batch fails, query lives
                 self.exception = e
+                self.server._c_failed.inc(len(ids))
                 # record=False: live clients get the 500, but the journal
                 # keeps these requests UNANSWERED so a restart replays them
                 # (transient failures must not commit as final answers)
@@ -775,6 +938,12 @@ class ServiceInfo:
                                         if pub_port is not None else None))
 
 
+# the serving counter families the rendezvous reads out of scrapes
+_SEEN = "mmlspark_tpu_serving_requests_seen_total"
+_ANSWERED = "mmlspark_tpu_serving_requests_answered_total"
+_LATENCY = "mmlspark_tpu_serving_latency_seconds"
+
+
 class FleetRendezvous:
     """Driver-side rendezvous + fleet-state aggregator.
 
@@ -782,21 +951,48 @@ class FleetRendezvous:
     collects each partition reader's ServiceInfo and exposes the routing
     table (HTTPSourceV2.scala:118-165). Here:
 
-      POST /register  — a replica announces its ServiceInfo at startup
-      GET  /services  — the raw registry
-      GET  /info      — LIVE aggregate: polls every registered replica's
-                        own info endpoint and merges counters/latency into
-                        fleet totals (replicas that fail to answer are
-                        reported as unreachable, not dropped silently)
+      POST /register      — a replica announces its ServiceInfo at startup
+      POST /metrics/push  — a draining replica flushes its final counters
+      GET  /services      — the raw registry
+      GET  /info          — LIVE aggregate: scrapes every replica's
+                            /metrics through the MetricsAggregator and
+                            reads counters/latency out of it (replicas
+                            that fail to answer are reported unreachable,
+                            not dropped silently)
+      GET  /metrics       — the fleet-wide exposition: per-replica samples
+                            under a `replica` label + merged samples under
+                            replica="fleet" (+ SLO series when an engine
+                            is attached via attach_slo)
+      GET  /healthz       — fleet health: per-replica alive/ready
+
+    `info()` and `/metrics` read the SAME aggregator state, so the JSON
+    totals and the exposition's fleet-merged counters cannot disagree.
     """
 
     def __init__(self, name: str = "fleet", host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, clock: Any = None,
+                 stale_after_s: float = 10.0):
+        from ..observability.fleet import MetricsAggregator
+
         self.name = name
         self.host, self.port = host, port
         self._services: dict[int, ServiceInfo] = {}
         self._lock = threading.Lock()
         self._server: ThreadingHTTPServer | None = None
+        self.aggregator = MetricsAggregator(
+            urls=self._metric_urls, clock=clock,
+            stale_after_s=stale_after_s)
+        self.slo_engine = None
+
+    def _metric_urls(self) -> dict[str, str]:
+        return {str(s.partition_id): f"http://{s.host}:{s.port}/metrics"
+                for s in self.services()}
+
+    def attach_slo(self, engine) -> None:
+        """Serve an SLOEngine's series from `/metrics` (it is evaluated on
+        every scrape). Point the engine's `source` at `self.aggregator` so
+        SLO math reads the same merged series the exposition shows."""
+        self.slo_engine = engine
 
     # -- aggregate ------------------------------------------------------ #
 
@@ -808,38 +1004,84 @@ class FleetRendezvous:
         with self._lock:
             self._services[info.partition_id] = info
 
-    def info(self) -> dict:
-        """Poll every replica's per-replica GET endpoint, merge fleet state."""
-        import http.client
+    def _replica_latency(self, rid: str) -> dict:
+        """p50/p99 (ms) estimated from the replica's scraped latency
+        histogram — shaped like ServingServer.latency_stats()."""
+        from ..observability.slo import SeriesReader
 
+        reader = SeriesReader(self.aggregator.replica_snapshot(rid))
+        h = reader.histogram(_LATENCY)
+        n = int(h["count"])
+        if n == 0:
+            return {"n": 0, "p50_ms": float("nan"), "p99_ms": float("nan")}
+        return {"n": n,
+                "p50_ms": reader.histogram_quantile(_LATENCY, 0.5) * 1e3,
+                "p99_ms": reader.histogram_quantile(_LATENCY, 0.99) * 1e3}
+
+    def info(self) -> dict:
+        """Scrape every replica's /metrics and merge fleet state. Totals
+        come from the aggregator's retained counter families, so a
+        gracefully-stopped replica's final flush stays counted."""
+        ok = self.aggregator.scrape()
         replicas = []
-        totals = {"seen": 0, "answered": 0}
         for svc in self.services():
+            rid = str(svc.partition_id)
             entry: dict[str, Any] = svc.to_dict()
-            conn = None
-            try:
-                conn = http.client.HTTPConnection(svc.host, svc.port, timeout=2)
-                conn.request("GET", "/")
-                r = conn.getresponse()
-                stats = json.loads(r.read())
-                entry.update(seen=stats.get("seen", 0),
-                             answered=stats.get("answered", 0),
-                             latency=stats.get("latency"),
-                             reachable=True)
-                totals["seen"] += int(stats.get("seen", 0))
-                totals["answered"] += int(stats.get("answered", 0))
-            except (OSError, http.client.HTTPException, ValueError):
-                # half-dead replicas fail in more ways than refused
-                # connections: truncated replies (BadStatusLine) and
-                # non-JSON bodies must also degrade to unreachable, never
-                # crash the whole aggregation
+            if ok.get(rid):
+                entry.update(
+                    seen=int(self.aggregator.total(_SEEN, replica=rid)),
+                    answered=int(self.aggregator.total(_ANSWERED,
+                                                       replica=rid)),
+                    latency=self._replica_latency(rid),
+                    reachable=True)
+            else:
                 entry.update(reachable=False)
-            finally:
-                if conn is not None:
-                    conn.close()
             replicas.append(entry)
+        totals = {"seen": int(self.aggregator.total(_SEEN)),
+                  "answered": int(self.aggregator.total(_ANSWERED))}
         return {"name": self.name, "replicas": replicas, "totals": totals,
                 "n_replicas": len(replicas)}
+
+    def fleet_health(self) -> dict:
+        """Per-replica liveness/readiness polled from /healthz + /readyz."""
+        import http.client
+
+        replicas = {}
+        for svc in self.services():
+            rid = str(svc.partition_id)
+            entry = {"alive": False, "ready": False}
+            for path, key in (("/healthz", "alive"), ("/readyz", "ready")):
+                conn = None
+                try:
+                    conn = http.client.HTTPConnection(svc.host, svc.port,
+                                                      timeout=2)
+                    conn.request("GET", path)
+                    r = conn.getresponse()
+                    r.read()
+                    entry[key] = r.status == 200
+                except (OSError, http.client.HTTPException):
+                    pass
+                finally:
+                    if conn is not None:
+                        conn.close()
+            replicas[rid] = entry
+        n_ready = sum(e["ready"] for e in replicas.values())
+        return {"replicas": replicas, "n_replicas": len(replicas),
+                "alive": sum(e["alive"] for e in replicas.values()),
+                "ready": n_ready,
+                "all_ready": bool(replicas) and n_ready == len(replicas)}
+
+    def render_metrics(self) -> str:
+        """The fleet exposition (+ SLO series when an engine is attached)."""
+        self.aggregator.scrape()
+        text = self.aggregator.render()
+        if self.slo_engine is not None:
+            try:
+                self.slo_engine.evaluate()
+                text += self.slo_engine.render()
+            except Exception:  # noqa: BLE001 — SLO math must not kill scrape
+                pass
+        return text
 
     # -- HTTP surface --------------------------------------------------- #
 
@@ -855,27 +1097,58 @@ class FleetRendezvous:
                 self.wfile.write(payload)
 
             def do_POST(self):  # noqa: N802 — http.server API
-                if self.path != "/register":
-                    self._reply(404, b"{}")
-                    return
+                path, _, query = self.path.partition("?")
                 length = int(self.headers.get("Content-Length", 0))
-                try:
-                    info = ServiceInfo.from_dict(
-                        json.loads(self.rfile.read(length))
-                    )
-                except (ValueError, KeyError):
-                    self._reply(400, b'{"error": "bad ServiceInfo"}')
+                body = self.rfile.read(length)
+                if path == "/register":
+                    try:
+                        info = ServiceInfo.from_dict(json.loads(body))
+                    except (ValueError, KeyError):
+                        self._reply(400, b'{"error": "bad ServiceInfo"}')
+                        return
+                    outer.register(info)
+                    self._reply(200, b'{"registered": true}')
                     return
-                outer.register(info)
-                self._reply(200, b'{"registered": true}')
+                if path == "/metrics/push":
+                    # a draining replica's final flush: its counters stay
+                    # in the fleet totals after the process exits
+                    import urllib.parse
+
+                    params = urllib.parse.parse_qs(query)
+                    rid = params.get("replica", ["?"])[0]
+                    try:
+                        outer.aggregator.push(rid, body.decode("utf-8"),
+                                              final=True)
+                    except Exception:  # noqa: BLE001 — bad push, not a crash
+                        self._reply(400, b'{"error": "bad exposition"}')
+                        return
+                    self._reply(200, b'{"pushed": true}')
+                    return
+                self._reply(404, b"{}")
 
             def do_GET(self):  # noqa: N802
-                if self.path == "/services":
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    payload = outer.render_metrics().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
+                if path == "/services":
                     body = json.dumps(
                         [s.to_dict() for s in outer.services()]
                     ).encode()
                 elif self.path == "/info":
                     body = json.dumps(outer.info()).encode()
+                elif path == "/healthz":
+                    health = outer.fleet_health()
+                    payload = json.dumps(health).encode()
+                    self._reply(200 if health["all_ready"] else 503, payload)
+                    return
                 else:
                     self._reply(404, b"{}")
                     return
@@ -915,8 +1188,25 @@ def _register_with_rendezvous(rendezvous_url: str, info: ServiceInfo) -> None:
         raise IOError(f"rendezvous register failed: {r.status}")
 
 
+def _push_final_metrics(rendezvous_url: str, partition_id: int,
+                        text: str) -> None:
+    import http.client
+    import urllib.parse
+
+    u = urllib.parse.urlparse(rendezvous_url)
+    conn = http.client.HTTPConnection(u.hostname, u.port, timeout=10)
+    conn.request("POST", f"/metrics/push?replica={partition_id}",
+                 body=text.encode(), headers={"Content-Type": "text/plain"})
+    r = conn.getresponse()
+    r.read()
+    conn.close()
+    if r.status != 200:
+        raise IOError(f"metrics push failed: {r.status}")
+
+
 def _fleet_worker(handler_factory, conn, server_kw, partition_id=0,
-                  rendezvous_url=None, forwarding=None) -> None:
+                  rendezvous_url=None, forwarding=None,
+                  trace_dir=None) -> None:
     """Child-process entry: build the handler locally (models must not cross
     the process boundary — the reference re-creates per-JVM servers the same
     way, DistributedHTTPSource.scala:244-291), optionally open a reverse
@@ -929,13 +1219,19 @@ def _fleet_worker(handler_factory, conn, server_kw, partition_id=0,
     from .forwarding import establish_forward, get_local_ip
 
     srv = ServingServer(handler_factory(), **server_kw).start()
-    # SIGTERM (ServingFleet.stop) must unwind through the finally below —
-    # the default disposition would kill the process with the reverse
-    # tunnel still up, stranding a live ssh holding the remote listen port
-    signal.signal(signal.SIGTERM, lambda *_: srv._stop.set())
+    # SIGTERM (ServingFleet.stop) begins the GRACEFUL sequence below:
+    # shed new work, drain what was already admitted (srv.stop's default
+    # continuous-mode drain), flush final counters to the rendezvous, and
+    # export the replica's trace — so stopping the fleet loses neither
+    # in-flight requests nor their telemetry. The fleet's hard kill()
+    # stays as the timeout fallback for a worker stuck draining.
+    shutdown = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: shutdown.set())
     fwd = None
     if forwarding is not None:
         fwd = establish_forward(srv.port, forwarding, local_host=srv.host)
+        # a dead tunnel must surface in /healthz, not blackhole traffic
+        srv.health_probes["forwarding"] = fwd.status
     if rendezvous_url:
         _register_with_rendezvous(rendezvous_url, ServiceInfo(
             name="mmlspark_tpu.serving", host=srv.host, port=srv.port,
@@ -946,7 +1242,22 @@ def _fleet_worker(handler_factory, conn, server_kw, partition_id=0,
         ))
     conn.send((srv.host, srv.port))
     try:
-        srv._stop.wait()
+        shutdown.wait()
+        srv.stop()  # graceful: drains in-flight requests first
+        if rendezvous_url:
+            try:
+                _push_final_metrics(rendezvous_url, partition_id,
+                                    srv.metrics.render_prometheus())
+            except Exception:  # noqa: BLE001 — rendezvous may be gone
+                pass
+        if trace_dir:
+            try:
+                from ..observability.tracing import get_tracer
+
+                get_tracer().export_jsonl(os.path.join(
+                    trace_dir, f"replica-{partition_id}.jsonl"))
+            except Exception:  # noqa: BLE001 — tracing is best-effort
+                pass
     finally:
         if fwd is not None:
             fwd.close()
@@ -971,7 +1282,10 @@ class ServingFleet:
 
     def __init__(self, handler_factory: Callable[[], Callable[[Table], Table]],
                  n_hosts: int = 2, start_timeout_s: float = 60.0,
-                 rendezvous: bool = True, forwarding=None, **server_kw):
+                 rendezvous: bool = True, forwarding=None,
+                 trace_dir: "str | None" = None,
+                 stop_timeout_s: float = 15.0, clock: Any = None,
+                 stale_after_s: float = 10.0, **server_kw):
         self.handler_factory = handler_factory
         self.n_hosts = n_hosts
         self.start_timeout_s = start_timeout_s
@@ -980,10 +1294,21 @@ class ServingFleet:
         # reverse tunnel to the gateway and registers the public coords
         # (HTTPSourceV2's forwarding.enabled path)
         self.forwarding = forwarding
+        # when set, each gracefully-stopped replica exports its spans to
+        # trace_dir/replica-N.jsonl (merge with Tracer.merge_jsonl)
+        self.trace_dir = trace_dir
+        # how long stop() waits for the graceful drain-and-flush before
+        # falling back to a hard kill
+        self.stop_timeout_s = stop_timeout_s
         self._procs: list[multiprocessing.Process] = []
         self.urls: list[str] = []
+        # clock/stale_after_s feed the rendezvous aggregator's staleness
+        # logic — chaos tests pass a FakeClock so dead-replica detection
+        # needs zero real waiting
         self.rendezvous: FleetRendezvous | None = (
-            FleetRendezvous(name="mmlspark_tpu.fleet") if rendezvous else None
+            FleetRendezvous(name="mmlspark_tpu.fleet", clock=clock,
+                            stale_after_s=stale_after_s)
+            if rendezvous else None
         )
 
     def start(self) -> "ServingFleet":
@@ -997,7 +1322,7 @@ class ServingFleet:
                 target=_fleet_worker,
                 args=(self.handler_factory, child, self.server_kw, pid,
                       self.rendezvous.url if self.rendezvous else None,
-                      self.forwarding),
+                      self.forwarding, self.trace_dir),
                 daemon=True,
             )
             p.start()
@@ -1032,11 +1357,32 @@ class ServingFleet:
             raise ValueError("fleet started with rendezvous=False")
         return self.rendezvous.info()
 
+    def kill(self, index: int) -> None:
+        """Hard-kill one replica — the chaos path: no drain, no final
+        flush, its ServiceInfo left registered (the rendezvous reports it
+        unreachable/down, which is exactly what the fleet view must show
+        for a crashed process)."""
+        p = self._procs[index]
+        if p.is_alive():
+            p.kill()
+        p.join(timeout=10)
+
     def stop(self) -> None:
+        """Graceful first: SIGTERM puts every worker through its drain-
+        and-flush sequence (in-flight requests answered, final counters
+        pushed to the rendezvous, traces exported); workers that miss
+        `stop_timeout_s` get the historical hard kill. The rendezvous
+        stops LAST so the final flushes have somewhere to land."""
         for p in self._procs:
-            p.terminate()
+            if p.is_alive():
+                p.terminate()
+        deadline = time.monotonic() + self.stop_timeout_s
         for p in self._procs:
-            p.join(timeout=10)
+            p.join(timeout=max(deadline - time.monotonic(), 0.1))
+        for p in self._procs:
+            if p.is_alive():
+                p.kill()
+                p.join(timeout=10)
         self._procs = []
         self.urls = []
         if self.rendezvous is not None:

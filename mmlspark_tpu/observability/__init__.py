@@ -2,19 +2,33 @@
 
 Dependency-free (stdlib only at the metrics/tracing layer) so every hot
 module — serving, streaming, dataplane, resilience, nn — can emit into
-one process-default registry and tracer. See docs/observability.md.
+one process-default registry and tracer. The fleet layer (fleet/slo)
+aggregates across replicas: exposition parse/merge/re-render, W3C
+traceparent propagation, and SLO burn rates. See docs/observability.md.
 """
 
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       DEFAULT_BUCKETS, METRIC_NAME_RE, get_registry,
                       set_default_registry, set_enabled)
 from .tracing import (Span, Tracer, get_tracer, set_default_tracer,
-                      load_jsonl, CHROME_EVENT_KEYS)
+                      load_jsonl, merge_jsonl, format_traceparent,
+                      parse_traceparent, current_traceparent,
+                      CHROME_EVENT_KEYS)
 from .stage import InstrumentedTransformer
+from .fleet import (MetricFamily, MetricSample, MetricsAggregator,
+                    parse_prometheus, render_families, merge_policy_for,
+                    GAUGE_MERGE_POLICIES, FLEET_REPLICA, REPLICA_LABEL)
+from .slo import (SLO, SLOEngine, SeriesReader, availability_slo,
+                  latency_slo)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "DEFAULT_BUCKETS",
     "METRIC_NAME_RE", "get_registry", "set_default_registry", "set_enabled",
     "Span", "Tracer", "get_tracer", "set_default_tracer", "load_jsonl",
-    "CHROME_EVENT_KEYS", "InstrumentedTransformer",
+    "merge_jsonl", "format_traceparent", "parse_traceparent",
+    "current_traceparent", "CHROME_EVENT_KEYS", "InstrumentedTransformer",
+    "MetricFamily", "MetricSample", "MetricsAggregator", "parse_prometheus",
+    "render_families", "merge_policy_for", "GAUGE_MERGE_POLICIES",
+    "FLEET_REPLICA", "REPLICA_LABEL", "SLO", "SLOEngine", "SeriesReader",
+    "availability_slo", "latency_slo",
 ]

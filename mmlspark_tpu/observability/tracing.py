@@ -9,6 +9,14 @@ complete `"ph": "X"` duration event, so `chrome://tracing`, Perfetto's
 legacy-JSON importer, or a five-line script can load them
 (`export_jsonl` / `load_jsonl`).
 
+Distributed propagation: span ids are PROCESS-SEEDED (pid mixed into the
+high bits of the id counter), so per-replica JSONL exports merge into one
+fleet trace with no id collisions (`merge_jsonl`). `inject()` renders the
+active span as a W3C `traceparent` header (clients attach it via
+`current_traceparent()`); `extract()` parses an incoming header into a
+remote parent span, so a server-side span joins the caller's trace —
+the Dapper pattern end to end.
+
 Device correlation: when `MMLSPARK_TPU_TRACE_DIR` is set (the switch
 that makes utils/profiling.device_trace capture an XPlane trace), every
 host span ALSO enters a `jax.profiler.TraceAnnotation`, so the same
@@ -25,16 +33,43 @@ import contextvars
 import itertools
 import json
 import os
+import re
 import threading
 import time
 from collections import deque
 from typing import Any
 
 __all__ = ["Span", "Tracer", "get_tracer", "set_default_tracer",
-           "load_jsonl", "CHROME_EVENT_KEYS"]
+           "load_jsonl", "merge_jsonl", "CHROME_EVENT_KEYS",
+           "format_traceparent", "parse_traceparent",
+           "current_traceparent"]
 
 # the schema contract for exported events (load_jsonl verifies it)
 CHROME_EVENT_KEYS = ("name", "cat", "ph", "ts", "dur", "pid", "tid")
+
+# W3C Trace Context: version "00", 16-byte trace-id, 8-byte parent-id,
+# flags — all lowercase hex, all-zero ids invalid
+_TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")
+
+
+def format_traceparent(trace_id: int, span_id: int) -> str:
+    """Render ids as a W3C `traceparent` header value (sampled flag set)."""
+    return f"00-{trace_id % (1 << 128):032x}-{span_id % (1 << 64):016x}-01"
+
+
+def parse_traceparent(header: "str | None") -> "tuple[int, int] | None":
+    """(trace_id, span_id) from a `traceparent` header; None when absent
+    or malformed (a bad header must degrade to 'no trace', never error)."""
+    if not header:
+        return None
+    m = _TRACEPARENT_RE.match(header.strip().lower())
+    if m is None or m.group(1) == "ff":
+        return None
+    trace_id, span_id = int(m.group(2), 16), int(m.group(3), 16)
+    if not trace_id or not span_id:
+        return None
+    return trace_id, span_id
 
 
 class Span:
@@ -154,7 +189,8 @@ class Tracer:
 
     def __init__(self, clock: Any = None, enabled: bool = True,
                  max_spans: int = 65536,
-                 annotate_device: "bool | None" = None):
+                 annotate_device: "bool | None" = None,
+                 id_seed: "int | None" = None):
         self._clock = clock
         self.enabled = bool(enabled)
         self.annotate_device = (
@@ -162,7 +198,16 @@ class Tracer:
             if annotate_device is None else bool(annotate_device))
         self._spans: deque[Span] = deque(maxlen=int(max_spans))
         self._lock = threading.Lock()
-        self._ids = itertools.count(1)
+        # Ids are PROCESS-SEEDED: the pid owns the top bits and random
+        # bits scatter the counter base, so per-replica exports merge
+        # into one fleet trace with no span-id collisions. Stays < 2^62
+        # so span ids fit W3C traceparent's 8 bytes (and trace ids its
+        # 16). id_seed=1 restores the legacy deterministic 1,2,3,...
+        # numbering for tests that assert exact ids.
+        if id_seed is None:
+            rand = int.from_bytes(os.urandom(5), "big")  # 40 bits
+            id_seed = ((os.getpid() & 0x3FFFFF) << 40) | rand | 1
+        self._ids = itertools.count(int(id_seed))
         self._current: contextvars.ContextVar["Span | None"] = \
             contextvars.ContextVar(f"tracer_span_{id(self):x}",
                                    default=None)
@@ -207,6 +252,33 @@ class Tracer:
             return _NULL_CTX
         return _Bind(self, span)
 
+    # -- distributed propagation ---------------------------------------- #
+
+    def inject(self, span: "Span | None" = None) -> "str | None":
+        """The active (or given) span as a `traceparent` header value;
+        None when tracing is off or no span is active — callers skip the
+        header rather than sending a broken one."""
+        if not self.enabled:
+            return None
+        if span is None:
+            span = self._current.get()
+        if span is None or not getattr(span, "span_id", 0):
+            return None
+        return format_traceparent(span.trace_id, span.span_id)
+
+    def extract(self, header: "str | None") -> "Span | None":
+        """An incoming `traceparent` as a synthetic REMOTE parent span:
+        pass it to `start_span(parent=...)` and the local span joins the
+        caller's trace. The remote span is never recorded locally — the
+        caller's own process exports it."""
+        if not self.enabled:
+            return None
+        ids = parse_traceparent(header)
+        if ids is None:
+            return None
+        trace_id, span_id = ids
+        return Span("remote", trace_id, span_id, None, 0.0, {"remote": True})
+
     # -- export --------------------------------------------------------- #
 
     def spans(self) -> list[Span]:
@@ -238,6 +310,25 @@ class Tracer:
         events = self.chrome_events()
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         with open(path, "w", encoding="utf-8") as fh:
+            for ev in events:
+                fh.write(json.dumps(ev) + "\n")
+        return len(events)
+
+    @staticmethod
+    def merge_jsonl(paths: "list[str]", out_path: str) -> int:
+        """Merge per-replica JSONL exports into one fleet trace file:
+        each input is schema-validated (`load_jsonl`), events are sorted
+        by timestamp, and the result is written as JSONL. Process-seeded
+        ids keep cross-file span ids collision-free, so a client span in
+        one file parents a server span in another purely through the
+        propagated trace_id/parent_id args. Returns the event count."""
+        events: list[dict] = []
+        for p in paths:
+            events.extend(load_jsonl(p))
+        events.sort(key=lambda ev: ev["ts"])
+        out_dir = os.path.dirname(os.path.abspath(out_path))
+        os.makedirs(out_dir, exist_ok=True)
+        with open(out_path, "w", encoding="utf-8") as fh:
             for ev in events:
                 fh.write(json.dumps(ev) + "\n")
         return len(events)
@@ -282,6 +373,9 @@ def load_jsonl(path: str) -> list[dict]:
     return events
 
 
+merge_jsonl = Tracer.merge_jsonl
+
+
 # --------------------------------------------------------------------- #
 # process-default tracer                                                #
 # --------------------------------------------------------------------- #
@@ -307,3 +401,9 @@ def set_default_tracer(tracer: "Tracer | None") -> "Tracer | None":
     with _DEFAULT_LOCK:
         old, _DEFAULT = _DEFAULT, tracer
     return old
+
+
+def current_traceparent() -> "str | None":
+    """`traceparent` for the process-default tracer's active span — the
+    one-liner HTTP clients call to propagate the trace downstream."""
+    return get_tracer().inject()

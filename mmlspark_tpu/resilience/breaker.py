@@ -28,12 +28,17 @@ from .policy import Clock, SYSTEM_CLOCK
 R = TypeVar("R")
 
 __all__ = ["CircuitOpenError", "CircuitBreaker", "BreakerRegistry",
-           "CircuitBreakerTransformer", "ensure_metrics"]
+           "CircuitBreakerTransformer", "ensure_metrics", "STATE_VALUES"]
+
+
+# numeric encoding of the breaker state gauge (closed < half_open < open,
+# so the fleet "max" merge policy surfaces the worst replica's state)
+STATE_VALUES = {"closed": 0, "half_open": 1, "open": 2}
 
 
 def ensure_metrics(registry=None):
     """Declare the breaker telemetry families on `registry` (process
-    default when None) and return (transitions, shed). Idempotent;
+    default when None) and return (transitions, shed, state). Idempotent;
     ServingServer calls this at construction so the series render from
     `/metrics` before any breaker ever trips."""
     from ..observability.metrics import get_registry
@@ -47,7 +52,11 @@ def ensure_metrics(registry=None):
         "mmlspark_tpu_resilience_breaker_shed_total",
         "calls refused while the circuit was open or probing",
         labels=("breaker",))
-    return transitions, shed
+    state = reg.gauge(
+        "mmlspark_tpu_resilience_breaker_state_count",
+        "breaker state (0 closed, 1 half_open, 2 open)",
+        labels=("breaker",))
+    return transitions, shed, state
 
 
 class CircuitOpenError(RuntimeError):
@@ -100,20 +109,25 @@ class CircuitBreaker:
         self.calls_shed = 0
         # labeled counter children, resolved once; telemetry stays optional
         try:
-            transitions, shed = ensure_metrics(metrics)
+            transitions, shed, state = ensure_metrics(metrics)
             label = self.name or "breaker"
             self._m_to = {
                 to: transitions.labels(breaker=label, to=to)
                 for to in ("open", "half_open", "closed")}
             self._m_shed = shed.labels(breaker=label)
+            self._m_state = state.labels(breaker=label)
+            self._m_state.set(STATE_VALUES[self._state])
         except Exception:
             self._m_to = {}
             self._m_shed = None
+            self._m_state = None
 
     def _transitioned(self, to: str) -> None:
         child = self._m_to.get(to)
         if child is not None:
             child.inc()
+        if self._m_state is not None:
+            self._m_state.set(STATE_VALUES.get(to, 0))
 
     # -- state ---------------------------------------------------------- #
 

@@ -1,0 +1,234 @@
+#!/usr/bin/env python
+"""One-shot fleet diagnosis: scrape, aggregate, and print a snapshot table.
+
+Three entry modes:
+
+  python tools/diagnose.py --rendezvous http://HOST:PORT
+      Ask a running FleetRendezvous for /healthz + /metrics and print the
+      per-replica table from the fleet exposition.
+
+  python tools/diagnose.py --urls http://H1:P1/metrics http://H2:P2/metrics
+      No rendezvous: scrape the replica /metrics endpoints directly
+      through a local MetricsAggregator and print the same table.
+
+  python tools/diagnose.py --selftest
+      Spin up a real 2-replica ServingFleet in-process, push traffic
+      through it, diagnose it, and exit nonzero unless every check holds
+      — the CI smoke for the whole fleet-observability path (ci.sh).
+
+The table is built ONLY from the exposition (never from side channels),
+so what it prints is exactly what a Prometheus scrape would see.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.normpath(
+    os.path.join(os.path.dirname(__file__), "..")))
+
+from mmlspark_tpu.observability.fleet import (  # noqa: E402
+    FLEET_REPLICA, MetricsAggregator, REPLICA_LABEL, parse_prometheus)
+from mmlspark_tpu.observability.slo import SeriesReader  # noqa: E402
+
+_SEEN = "mmlspark_tpu_serving_requests_seen_total"
+_ANSWERED = "mmlspark_tpu_serving_requests_answered_total"
+_FAILED = "mmlspark_tpu_serving_requests_failed_total"
+_SHED = "mmlspark_tpu_serving_requests_shed_total"
+_LATENCY = "mmlspark_tpu_serving_latency_seconds"
+_UP = "mmlspark_tpu_fleet_replica_up_count"
+_BREAKER = "mmlspark_tpu_resilience_breaker_state_count"
+_BURN = "mmlspark_tpu_slo_burn_rate"
+_BUDGET = "mmlspark_tpu_slo_budget_remaining_ratio"
+_BREAKER_NAMES = {0: "closed", 1: "half_open", 2: "open"}
+
+
+def _fetch(url: str, timeout_s: float = 5.0) -> str:
+    with urllib.request.urlopen(url, timeout=timeout_s) as r:
+        return r.read().decode("utf-8")
+
+
+def _split_by_replica(families) -> dict[str, dict]:
+    """Regroup a fleet exposition into per-replica snapshot-shaped dicts
+    (the `replica` label partitions every sample)."""
+    per: dict[str, list] = {}
+    for fam in families:
+        for s in fam.samples:
+            rid = s.labels_dict().get(REPLICA_LABEL)
+            if rid is None:
+                rid = FLEET_REPLICA
+            per.setdefault(rid, []).append((fam, s))
+    out: dict[str, dict] = {}
+    for rid, pairs in per.items():
+        by_fam: dict[str, tuple] = {}
+        for fam, s in pairs:
+            by_fam.setdefault(fam.name, (fam, []))[1].append(s)
+        out[rid] = {
+            name: MetricsAggregator._snapshot_family(fam, samples)
+            for name, (fam, samples) in by_fam.items()}
+    return out
+
+
+def _fmt(v: float, digits: int = 1) -> str:
+    if v != v:  # nan
+        return "-"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return f"{v:.{digits}f}"
+
+
+def _render_table(rows: list[list[str]], header: list[str]) -> str:
+    widths = [max(len(str(r[i])) for r in [header] + rows)
+              for i in range(len(header))]
+    def line(r):
+        return "  ".join(str(c).ljust(w) for c, w in zip(r, widths)).rstrip()
+    sep = "  ".join("-" * w for w in widths)
+    return "\n".join([line(header), sep] + [line(r) for r in rows])
+
+
+def diagnose_text(text: str, health: "dict | None" = None) -> str:
+    """The full report from one fleet exposition (+ optional /healthz
+    payload for alive/ready columns)."""
+    families = parse_prometheus(text)
+    per = _split_by_replica(families)
+    fleet = per.pop(FLEET_REPLICA, {})
+    hrep = (health or {}).get("replicas", {})
+
+    header = ["replica", "up", "alive", "ready", "seen", "answered",
+              "failed", "shed", "p50_ms", "p99_ms"]
+    rows = []
+    for rid in sorted(per, key=lambda r: (len(r), r)):
+        reader = SeriesReader(per[rid])
+        h = hrep.get(rid, {})
+        p50 = reader.histogram_quantile(_LATENCY, 0.5) * 1e3
+        p99 = reader.histogram_quantile(_LATENCY, 0.99) * 1e3
+        rows.append([
+            rid,
+            _fmt(reader.gauge(_UP)),
+            {True: "y", False: "n"}.get(h.get("alive"), "?"),
+            {True: "y", False: "n"}.get(h.get("ready"), "?"),
+            _fmt(reader.counter(_SEEN)), _fmt(reader.counter(_ANSWERED)),
+            _fmt(reader.counter(_FAILED)), _fmt(reader.counter(_SHED)),
+            _fmt(p50, 2), _fmt(p99, 2),
+        ])
+    out = [_render_table(rows, header)] if rows else ["(no replica series)"]
+
+    freader = SeriesReader(fleet)
+    out.append("")
+    out.append(
+        f"fleet: seen={_fmt(freader.counter(_SEEN))} "
+        f"answered={_fmt(freader.counter(_ANSWERED))} "
+        f"failed={_fmt(freader.counter(_FAILED))} "
+        f"shed={_fmt(freader.counter(_SHED))} "
+        f"p99_ms={_fmt(freader.histogram_quantile(_LATENCY, 0.99) * 1e3, 2)}")
+
+    breakers = [(s["labels"].get("breaker", "?"), s["value"])
+                for s in fleet.get(_BREAKER, {}).get("samples", [])]
+    if breakers:
+        worst = ", ".join(
+            f"{n}={_BREAKER_NAMES.get(int(v), v)}" for n, v in breakers)
+        out.append(f"breakers (worst across fleet): {worst}")
+
+    slo_rows = []
+    for s in fleet.get(_BURN, {}).get("samples", []):
+        slo_rows.append([s["labels"].get("slo", "?"),
+                         s["labels"].get("window", "?"), _fmt(s["value"], 3)])
+    for s in fleet.get(_BUDGET, {}).get("samples", []):
+        slo_rows.append([s["labels"].get("slo", "?"), "budget",
+                         _fmt(s["value"], 3)])
+    if slo_rows:
+        out.append("")
+        out.append(_render_table(sorted(slo_rows),
+                                 ["slo", "window", "value"]))
+    return "\n".join(out)
+
+
+def diagnose_rendezvous(url: str) -> str:
+    url = url.rstrip("/")
+    text = _fetch(url + "/metrics")
+    try:
+        with urllib.request.urlopen(url + "/healthz", timeout=5) as r:
+            health = json.loads(r.read())
+    except urllib.error.HTTPError as e:  # 503 = not all ready, still JSON
+        health = json.loads(e.read() or b"{}")
+    except Exception:  # noqa: BLE001 — health is optional decoration
+        health = None
+    return diagnose_text(text, health)
+
+
+def diagnose_urls(urls: list[str]) -> str:
+    agg = MetricsAggregator(urls=list(urls))
+    agg.scrape()
+    return diagnose_text(agg.render())
+
+
+# -- selftest ----------------------------------------------------------- #
+
+def _selftest_handler(table):
+    import numpy as np
+
+    from mmlspark_tpu.io_http.schema import make_reply, parse_request
+
+    t = parse_request(table)
+    return make_reply(t.with_column(
+        "doubled", np.asarray(t["x"], dtype=float) * 2), "doubled")
+
+
+def _selftest_factory():
+    return _selftest_handler
+
+
+def selftest() -> int:
+    from mmlspark_tpu.io_http.serving import ServingFleet
+
+    fleet = ServingFleet(_selftest_factory, n_hosts=2).start()
+    try:
+        for i in range(8):
+            req = urllib.request.Request(
+                fleet.urls[i % 2],
+                data=json.dumps({"x": float(i)}).encode(),
+                headers={"Content-Type": "application/json"}, method="POST")
+            urllib.request.urlopen(req, timeout=10).read()
+        report = diagnose_rendezvous(fleet.rendezvous.url)
+        print(report)
+        info = fleet.info()
+        checks = {
+            "2 replicas registered": info["n_replicas"] == 2,
+            "8 requests counted": info["totals"]["seen"] == 8,
+            "totals match /metrics": int(fleet.rendezvous.aggregator.total(
+                _SEEN)) == info["totals"]["seen"],
+            "report mentions fleet": "fleet:" in report,
+        }
+    finally:
+        fleet.stop()
+    failed = [name for name, ok in checks.items() if not ok]
+    if failed:
+        print(f"selftest FAILED: {failed}", file=sys.stderr)
+        return 1
+    print(f"selftest OK ({len(checks)} checks)")
+    return 0
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    g = ap.add_mutually_exclusive_group(required=True)
+    g.add_argument("--rendezvous", help="FleetRendezvous base URL")
+    g.add_argument("--urls", nargs="+", help="replica /metrics URLs")
+    g.add_argument("--selftest", action="store_true",
+                   help="run a 2-replica fleet and diagnose it")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return selftest()
+    if args.rendezvous:
+        print(diagnose_rendezvous(args.rendezvous))
+    else:
+        print(diagnose_urls(args.urls))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -15,7 +15,11 @@ the static skeleton), and enforces:
   2. unit suffix: the name must end in one of UNIT_SUFFIXES, the
      Prometheus base-unit convention (counters ``_total``, timings
      ``_seconds``, sizes ``_bytes``, plus the dimensionless ``_ratio`` /
-     ``_depth`` / ``_count`` gauges this codebase uses).
+     ``_depth`` / ``_count`` / ``_rate`` gauges this codebase uses).
+  3. merge policy: every family name must resolve to a cross-replica
+     merge policy via ``observability.fleet.merge_policy_for`` — a gauge
+     that neither appears in GAUGE_MERGE_POLICIES nor matches a suffix
+     default would silently aggregate wrong in the fleet ``/metrics``.
 
 Usage: python tools/metric_lint.py    # exit 1 with a report if any fail
 """
@@ -31,12 +35,30 @@ SCAN = [os.path.join(ROOT, "mmlspark_tpu"), os.path.join(ROOT, "bench.py")]
 
 NAME_RE = re.compile(r"^mmlspark_tpu_[a-z0-9_]+$")
 UNIT_SUFFIXES = ("_total", "_seconds", "_bytes", "_ratio", "_depth",
-                 "_count")
+                 "_count", "_rate")
 # any single- or double-quoted literal (optionally an f-string) whose
 # contents begin with the namespace prefix
 LITERAL_RE = re.compile(
     r"""[fF]?("mmlspark_tpu_[^"\n]*"|'mmlspark_tpu_[^'\n]*')""")
 PLACEHOLDER_RE = re.compile(r"\{[^{}]*\}")
+
+# histogram sample suffixes: `X_bucket`/`X_sum`/`X_count` literals refer
+# to samples of family X, whose policy is checked under its own name
+_HISTOGRAM_SAMPLE_RE = re.compile(r"_seconds(_bucket|_sum|_count)$")
+
+
+def _merge_policy_for(name: str) -> "str | None":
+    sys.path.insert(0, ROOT)
+    try:
+        from mmlspark_tpu.observability.fleet import merge_policy_for
+    finally:
+        sys.path.pop(0)
+    # counters are always summable; everything else goes through the
+    # gauge resolution path (histogram families end in _seconds → "last"
+    # would be wrong, but histograms are identified by kind at merge
+    # time and always sum — the lint only needs SOME policy to resolve)
+    kind = "counter" if name.endswith("_total") else "gauge"
+    return merge_policy_for(name, kind)
 
 
 def iter_sources() -> list[str]:
@@ -62,10 +84,19 @@ def lint_file(path: str) -> list[str]:
                     problems.append(
                         f"{where}: {name!r} violates "
                         "^mmlspark_tpu_[a-z0-9_]+$")
-                elif not name.endswith(UNIT_SUFFIXES):
+                    continue
+                if not name.endswith(UNIT_SUFFIXES):
                     problems.append(
                         f"{where}: {name!r} lacks a unit suffix "
                         f"({', '.join(UNIT_SUFFIXES)})")
+                    continue
+                base = _HISTOGRAM_SAMPLE_RE.sub("_seconds", name)
+                if _merge_policy_for(base) is None:
+                    problems.append(
+                        f"{where}: {name!r} has no cross-replica merge "
+                        "policy (add it to observability.fleet."
+                        "GAUGE_MERGE_POLICIES or use a suffix with a "
+                        "default)")
     return problems
 
 
