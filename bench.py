@@ -81,6 +81,11 @@ _CORE_TIMEOUT_ENV = "MMLSPARK_TPU_BENCH_CORE_TIMEOUT"
 _TRAINER_TIMEOUT_ENV = "MMLSPARK_TPU_BENCH_TRAINER_TIMEOUT"
 _TRANSFORMER_TIMEOUT_ENV = "MMLSPARK_TPU_BENCH_TRANSFORMER_TIMEOUT"
 _LARGE_TIMEOUT_ENV = "MMLSPARK_TPU_BENCH_GBDT_LARGE_TIMEOUT"
+_MULTICHIP_TIMEOUT_ENV = "MMLSPARK_TPU_BENCH_MULTICHIP_TIMEOUT"
+# forced host-platform device count for the multichip family; the artifact
+# records ladder rows at 1/2/4/8 of these
+_MULTICHIP_DEVICES = 8
+_MULTICHIP_ARTIFACT = "MULTICHIP_r07.json"
 
 
 # --------------------------------------------------------------------- #
@@ -1091,6 +1096,108 @@ def bench_pipeline_fusion() -> dict:
     }
 
 
+def bench_fused_sharded() -> dict:
+    """Sharded fused execution (core/fusion.py under a parallel/mesh.py
+    mesh): the SAME two-stage scoring pipeline (MLP -> DataConversion)
+    fused on one device vs fused on an n-device data-parallel mesh, at
+    n = 1/2/4/8 of this process's devices. Pairing follows
+    bench_pipeline_fusion: both paths run in each of five interleaved
+    passes, the per-pass ratio cancels that pass's machine load, and the
+    median over passes is the reported ratio. Byte-identity vs the
+    single-device fused output is asserted at every mesh size, and the
+    timed passes must add ZERO executable-cache misses after warmup — a
+    steady-state recompile at fixed mesh shape fails the family.
+
+    On forced host-platform devices (XLA_FLAGS, how CI runs this) the N
+    "chips" share one CPU's cores, so per_chip_rows_per_sec mechanically
+    lands near 1/n of single-chip — there the row is an accounting and
+    identity check. The ROADMAP ~0.9x per-chip criterion is judged on a
+    real multi-chip window, where each shard owns its own silicon."""
+    import jax
+
+    from mmlspark_tpu.core.fusion import fuse
+    from mmlspark_tpu.core.pipeline import pipeline_model
+    from mmlspark_tpu.core.schema import Table
+    from mmlspark_tpu.nn.models import ModelBundle
+    from mmlspark_tpu.nn.runner import DeepModelTransformer
+    from mmlspark_tpu.ops.conversion import DataConversion
+    from mmlspark_tpu.parallel.mesh import make_mesh
+
+    devs = jax.devices()
+    n_rows, bs = 4096, 512
+    n_batches = -(-n_rows // bs)
+    rng = np.random.default_rng(7)
+    table = Table({"x": rng.normal(size=(n_rows, 32)).astype(np.float32)})
+
+    def build(mesh):
+        stages = [
+            DeepModelTransformer(input_col="x", mini_batch_size=bs).set_model(
+                ModelBundle.init("mlp", (32,), seed=0, num_outputs=8,
+                                 features=(64, 32))),
+            DataConversion(cols=["output"], convert_to="float"),
+        ]
+        return fuse(pipeline_model(*stages), mini_batch_size=bs, mesh=mesh)
+
+    def timed(fn):
+        t0 = time.perf_counter()
+        fn()
+        return time.perf_counter() - t0
+
+    single = build(None)
+    ref = np.asarray(single.transform(table)["output"])
+
+    ladder = []
+    single_per_chip = None
+    for nd in (1, 2, 4, 8):
+        if nd > len(devs):
+            continue
+        mesh = None if nd == 1 else make_mesh(n_data=nd, devices=devs[:nd])
+        fused = single if nd == 1 else build(mesh)
+        out = np.asarray(fused.transform(table)["output"])  # compile + warm
+        assert out.tobytes() == ref.tobytes(), \
+            f"fused on {nd}-device mesh != single-device fused"
+        warm = dict(fused.last_stats["segments"][0])
+
+        t_single, t_nd = [], []
+        rows = [
+            (t_single, lambda: np.asarray(single.transform(table)["output"])),
+            (t_nd, lambda: np.asarray(fused.transform(table)["output"])),
+        ]
+        for rep in range(5):
+            # rotate within-pass order so neither path owns the cooler slot
+            for acc, fn in rows[rep % 2:] + rows[:rep % 2]:
+                acc.append(timed(fn))
+        ratios = sorted(s / t for s, t in zip(t_single, t_nd))
+
+        seg = fused.last_stats["segments"][0]
+        steady_misses = seg["misses"] - warm["misses"]
+        steady_recompiles = seg["recompiles"] - warm["recompiles"]
+        assert steady_misses == 0 and steady_recompiles == 0, (
+            f"steady-state compile at fixed mesh {seg['mesh_shape']}: "
+            f"+{steady_misses} misses / +{steady_recompiles} recompiles")
+        rate = n_rows / min(t_nd)
+        if single_per_chip is None:
+            single_per_chip = rate
+        row = {
+            "n_devices": nd,
+            "mesh_shape": seg["mesh_shape"],
+            "sharded_vs_single_paired_median": ratios[len(ratios) // 2],
+            "rows_per_sec": rate,
+            "per_chip_rows_per_sec": rate / nd,
+            "per_chip_vs_single_chip": (rate / nd) / single_per_chip,
+            "uploads_per_batch": seg["uploads"] / n_batches,
+            "downloads_per_batch": seg["downloads"] / n_batches,
+            "steady_state_misses": steady_misses,
+            "steady_state_recompiles": steady_recompiles,
+        }
+        if "shard_skew_ratio" in seg:
+            row["shard_skew_ratio"] = seg["shard_skew_ratio"]
+        ladder.append(row)
+    return {"fused_sharded_vs_single": ladder,
+            "rows": n_rows, "batch_size": bs,
+            "devices_available": len(devs)}
+
+
 def bench_instrumentation() -> dict:
     """Per-iteration cost of the telemetry layer on a runner-style loop
     (counter + histogram.time + span around each step), as a slowdown
@@ -1612,6 +1719,60 @@ def _family_solo_main(bench_fn, label: str) -> None:
     print(json.dumps(out))
 
 
+def _family_multichip_main() -> None:
+    """Sharded-fusion family. Always runs on host-platform CPU devices —
+    the orchestrator sets XLA_FLAGS=--xla_force_host_platform_device_count
+    in this child's env before jax is ever imported — so it never probes
+    the real backend; the real-chip variant belongs to a chip window's
+    session script. The config update below beats the axon sitecustomize
+    pin, same as _family_core_main."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    print(f"bench: multichip family on {len(jax.devices())} forced "
+          "host-platform device(s)", file=sys.stderr)
+    print(json.dumps(bench_fused_sharded()))
+
+
+def _multichip_orchestrator() -> None:
+    """Run the multichip family watched and write the MULTICHIP artifact.
+
+    Rounds 1-5 recorded only whether the dryrun exited 0 ({n_devices, rc,
+    ok, ...} with no numbers), which left the ROADMAP per-chip-throughput
+    criterion unmeasurable. The artifact keeps those fields and adds the
+    fused_sharded_vs_single ladder the criterion is judged on."""
+    idx = sys.argv.index("--multichip") + 1
+    path = (sys.argv[idx]
+            if idx < len(sys.argv) and not sys.argv[idx].startswith("-")
+            else _MULTICHIP_ARTIFACT)
+    env = dict(os.environ)
+    flags = env.get("XLA_FLAGS", "")
+    env["XLA_FLAGS"] = ((flags + " ") if flags else "") + (
+        f"--xla_force_host_platform_device_count={_MULTICHIP_DEVICES}")
+    env["JAX_PLATFORMS"] = "cpu"
+    timeout = float(os.environ.get(_MULTICHIP_TIMEOUT_ENV, 900))
+    rc, out, err = _run_watched(
+        [sys.executable, os.path.abspath(__file__), "--family", "multichip"],
+        env, timeout)
+    sys.stderr.write(err[-20000:])
+    result = _last_json_line(out) if rc == 0 else None
+    record = {
+        "n_devices": _MULTICHIP_DEVICES,
+        "rc": rc,
+        "ok": rc == 0 and result is not None,
+        "skipped": False,
+        "tail": "" if rc == 0 else (err or out)[-2000:],
+    }
+    if result is not None:
+        record.update(result)
+    with open(path, "w") as fh:
+        json.dump(record, fh, indent=2)
+        fh.write("\n")
+    print(json.dumps(record))
+    if not record["ok"]:
+        raise SystemExit(1)
+
+
 def _bench_gbdt_large_solo(_peak_tflops):
     """Solo-family adapter: the large family keys off HBM peak, not FLOPs.
     Mirrors the core suite's kernel-mode insurance — if the Pallas
@@ -1666,7 +1827,12 @@ def main() -> None:
             return _family_solo_main(bench_transformer, "transformer")
         if family == "gbdt_large":
             return _family_solo_main(_bench_gbdt_large_solo, "gbdt_large")
+        if family == "multichip":
+            return _family_multichip_main()
         raise SystemExit(f"bench: unknown family {family!r}")
+
+    if "--multichip" in sys.argv:
+        return _multichip_orchestrator()
 
     # Orchestrator: never imports jax (the tunneled TPU is single-process;
     # holding it here would deadlock the children). Core families first —

@@ -352,6 +352,23 @@ class ExecutableCache:
         self.misses = 0
         self.recompiles = 0
 
+    @staticmethod
+    def family_key(base: Any, mesh_shape: Any = None,
+                   sharding_spec: Any = None) -> Any:
+        """Extend a family key with the mesh dimension.
+
+        `mesh_shape` is the ((axis, size), ...) layout of the mesh the
+        executable was compiled under and `sharding_spec` describes how the
+        family's inputs/params are placed on it.  With `mesh_shape=None`
+        (single chip) the base key is returned UNCHANGED — the pre-mesh key
+        — so a sharded executable can never be handed to the single-chip
+        path or vice versa: the two lineages live under different family
+        keys and a mesh-shape change is a new family, not a recompile of
+        the old one."""
+        if mesh_shape is None:
+            return base
+        return (base, ("mesh", tuple(mesh_shape), tuple(sharding_spec or ())))
+
     def _bump(self, **deltas: int) -> None:
         with _GLOBAL_STATS_LOCK:
             for k, v in deltas.items():

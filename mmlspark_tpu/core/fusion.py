@@ -45,6 +45,24 @@ Integration
 `serve_model` and `StreamingQuery` fuse `PipelineModel` handlers
 automatically; `fuse()` is idempotent and `FusedPipelineModel` serializes
 like the `PipelineModel` it wraps.
+
+Sharded execution
+-----------------
+A fused segment optionally compiles under a `parallel.mesh` mesh
+(`fuse(model, mesh=...)`, `FusedPipelineModel.set_mesh`, or the `use_mesh`
+param picking up `get_mesh()`).  Batch chunks upload row-sharded over the
+data axis (`data_sharding`), kernel params upload replicated
+(`replicated_sharding`) unless the kernel supplies a `mesh_fn` with its own
+placement (e.g. tensor-parallel matmul weights), and the jitted composition
+is compiled with the inputs' committed shardings — GSPMD inserts the
+collectives.  Chunk sizes and bucket-ladder steps round up to multiples of
+the data-axis size so every shard gets equal rows; the executable-cache
+family key gains `(mesh_shape, sharding_spec)` so sharded and single-chip
+executables never collide.  Because kernels are row-independent and the
+engine only ever row-shards them (a kernel's own `mesh_fn` must preserve
+values too), the sharded result is byte-identical to the single-device
+fused path.  A `mesh` of one device (or none) is exactly the single-chip
+path.
 """
 
 from __future__ import annotations
@@ -89,7 +107,15 @@ class DeviceKernel:
     like IMAGE_SPEC).  `ready(table)` is the runtime fusability check on
     the HOST inputs (dtype / uniformity preconditions); returning a string
     vetoes fusion for that table and the segment falls back to the staged
-    path."""
+    path.
+
+    Mesh hooks: by default a kernel runs unchanged under a mesh — rows
+    shard over the data axis, `params` replicate.  `mesh_fn(mesh)` lets a
+    kernel specialize beyond that: return `(fn, param_shardings)` to swap
+    in a mesh-aware body (e.g. tensor-parallel matmuls) with explicit
+    param placement, or None to accept the default.  Any specialized body
+    must still produce byte-identical values.  `mesh_desc` is the
+    human-readable sharding contract `fusion_report` prints."""
 
     fn: Callable[[Any, dict], dict]
     input_cols: tuple[str, ...]
@@ -99,6 +125,8 @@ class DeviceKernel:
     out_dtypes: dict[str, Any] = field(default_factory=dict)
     out_meta: dict[str, Any] = field(default_factory=dict)
     ready: "Callable[[Table], Any] | None" = None
+    mesh_fn: "Callable[[Any], tuple | None] | None" = None
+    mesh_desc: str = "rows P(data) / params replicated"
 
 
 @dataclass
@@ -143,13 +171,18 @@ class FusionPlan:
         staged = 2 * self.n_fused_stages
         return fused, staged
 
-    def describe(self) -> str:
-        """Human-readable segment plan (tools/fusion_report.py prints it)."""
+    def describe(self, mesh: Any = None) -> str:
+        """Human-readable segment plan (tools/fusion_report.py prints it).
+        With a mesh, each fused segment also shows the mesh shape and the
+        per-stage sharding spec it would compile under."""
         lines = []
         fused_t, staged_t = self.transfers_per_batch()
+        mesh_label = ("x".join(str(s) for s in mesh.shape.values())
+                      if mesh is not None else "1")
         for i, seg in enumerate(self.segments):
             kind = "FUSED" if seg.fused else "HOST"
-            lines.append(f"segment {i} [{kind}]")
+            suffix = f" mesh={mesh_label}" if seg.fused else ""
+            lines.append(f"segment {i} [{kind}]{suffix}")
             for sp in seg.stages:
                 name = type(sp.stage).__name__
                 if seg.fused:
@@ -157,6 +190,7 @@ class FusionPlan:
                     lines.append(
                         f"  {name}: {','.join(k.input_cols)} -> "
                         f"{','.join(k.output_cols)}")
+                    lines.append(f"    sharding: {k.mesh_desc}")
                 else:
                     lines.append(f"  {name}: {sp.reason}")
         lines.append(
@@ -217,11 +251,16 @@ def plan_fusion(stages: Sequence[Any]) -> FusionPlan:
 
 class _FusedSegment:
     """One maximal run of device-capable stages compiled as a single jitted
-    composition over device-resident columns."""
+    composition over device-resident columns.  With a mesh (always >1
+    device — callers normalize 1-device meshes to None so the single-chip
+    path stays exactly the pre-mesh one), inputs row-shard over the data
+    axis and params replicate unless a kernel's `mesh_fn` placed them
+    itself."""
 
-    def __init__(self, index: int, plans: list[StagePlan]):
+    def __init__(self, index: int, plans: list[StagePlan], mesh: Any = None):
         self.index = index
         self.plans = plans
+        self.mesh = mesh
         self.kernels = [p.kernel for p in plans]
         self.stage_names = [type(p.stage).__name__ for p in plans]
         # upload set: inputs not produced by an earlier kernel in the run;
@@ -240,6 +279,8 @@ class _FusedSegment:
         self._exec_cache = ExecutableCache()
         self._jitted = None
         self._device_params = None
+        self._bodies = None
+        self._param_placements: "tuple[str, ...] | None" = None
 
     # -- compilation ---------------------------------------------------- #
 
@@ -251,24 +292,70 @@ class _FusedSegment:
             # boundaries — uploaded once, reused by every batch (never
             # captured as jit constants, so they are not re-staged per
             # compiled shape)
-            self._device_params = tuple(
-                jax.tree.map(jax.device_put, k.params) if k.params is not None
-                else None
-                for k in self.kernels
-            )
+            if self.mesh is None:
+                self._device_params = tuple(
+                    jax.tree.map(jax.device_put, k.params)
+                    if k.params is not None else None
+                    for k in self.kernels
+                )
+                self._bodies = [k.fn for k in self.kernels]
+                self._param_placements = tuple(
+                    "single" for _ in self.kernels)
+            else:
+                from ..parallel.mesh import replicated_sharding
+
+                repl = replicated_sharding(self.mesh)
+                bodies, dparams, placements = [], [], []
+                for k in self.kernels:
+                    body, shardings = k.fn, None
+                    if k.mesh_fn is not None:
+                        spec = k.mesh_fn(self.mesh)
+                        if spec is not None:
+                            body, shardings = spec
+                    bodies.append(body)
+                    if k.params is None:
+                        dparams.append(None)
+                        placements.append("none")
+                    elif shardings is None:
+                        dparams.append(jax.device_put(k.params, repl))
+                        placements.append("replicated")
+                    else:
+                        dparams.append(jax.device_put(k.params, shardings))
+                        placements.append("custom")
+                self._device_params = tuple(dparams)
+                self._bodies = bodies
+                self._param_placements = tuple(placements)
         if self._jitted is None:
-            kernels = self.kernels
+            bodies = self._bodies
             upload_cols = self.upload_cols
             download_cols = self.download_cols
 
             def composed(params_tuple, in_arrays):
                 cols = dict(zip(upload_cols, in_arrays))
-                for k, p in zip(kernels, params_tuple):
-                    cols.update(k.fn(p, cols))
+                for body, p in zip(bodies, params_tuple):
+                    cols.update(body(p, cols))
                 return tuple(cols[c] for c in download_cols)
 
+            # no in/out_shardings: the committed placement of the uploaded
+            # params and row-sharded chunks drives GSPMD partitioning
             self._jitted = jax.jit(composed)
         return self._jitted, self._device_params
+
+    def _family_key(self, ins: dict) -> Any:
+        """Executable-cache family: program lineage = this segment's column
+        contract plus, under a mesh, (mesh_shape, sharding_spec) — a mesh
+        change is a NEW family, never a recompile of the old one."""
+        base = (id(self), tuple(
+            (c, str(ins[c].dtype), ins[c].shape[1:]) for c in self.upload_cols))
+        if self.mesh is None:
+            return base
+        self._build()  # placements are part of the lineage
+        spec = tuple(zip((k.name for k in self.kernels),
+                         self._param_placements)) + tuple(
+            (c, "P(data)") for c in self.upload_cols)
+        return ExecutableCache.family_key(
+            base, mesh_shape=tuple(self.mesh.shape.items()),
+            sharding_spec=spec)
 
     # -- execution ------------------------------------------------------ #
 
@@ -301,23 +388,47 @@ class _FusedSegment:
         return table
 
     def run(self, table: Table, *, mini_batch_size: int, prefetch_depth: int,
-            shape_buckets: bool, tracer: Any) -> tuple[Table, dict]:
+            shape_buckets: bool, tracer: Any, fused_label: str = "pipeline",
+            ) -> tuple[Table, dict]:
         n = table.num_rows
         jitted, params = self._build()
         bs = max(int(mini_batch_size), 1)
+        mesh = self.mesh
+        if mesh is None:
+            mesh_label = "1"
+            in_shardings = None
+            d = 1
+        else:
+            from ..parallel.mesh import (DATA_AXIS, data_sharding,
+                                         mesh_shape_label)
+
+            mesh_label = mesh_shape_label(mesh)
+            d = int(mesh.shape[DATA_AXIS])
+            # every shard gets equal rows: chunk size (and therefore every
+            # full chunk) must divide evenly over the data axis
+            bs = -(-bs // d) * d
         # The ladder must depend only on mini_batch_size, never on the row
         # count of THIS table: an n-derived max would mint n-specific bucket
-        # shapes for small tables and recompile in steady state.
-        bucketer = ShapeBucketer(bs) if shape_buckets else None
+        # shapes for small tables and recompile in steady state.  Under a
+        # mesh every ladder step rounds up to a multiple of the data-axis
+        # size so padded tails stay shardable.
+        bucketer = ShapeBucketer(bs, multiple_of=d) if shape_buckets else None
         ins = {c: np.asarray(table[c]) for c in self.upload_cols}
-        family = (id(self), tuple(
-            (c, str(ins[c].dtype), ins[c].shape[1:]) for c in self.upload_cols))
+        if mesh is not None:
+            in_shardings = {
+                c: data_sharding(mesh, *([None] * (ins[c].ndim - 1)))
+                for c in self.upload_cols}
+        family = self._family_key(ins)
         stats = {
             "kind": "fused", "segment": self.index,
             "stages": list(self.stage_names), "rows": n,
+            "mesh_shape": mesh_label,
             "uploads": 0, "downloads": 0,
             "prepare_seconds": 0.0, "fetch_seconds": 0.0,
         }
+        if mesh is not None:
+            stats["param_placements"] = list(self._param_placements)
+        shard_seconds: dict[str, float] = {}
 
         def prepare(start: int):
             stop = min(start + bs, n)
@@ -330,14 +441,23 @@ class _FusedSegment:
                     chunk = np.concatenate(
                         [chunk, np.repeat(chunk[-1:], target - m, axis=0)])
                 cols[c] = chunk
-            dt = DeviceTable.from_host(cols)  # one upload per input column
+            # one upload per input column; under a mesh the chunk commits
+            # row-sharded, so the transfer lands per-shard on each chip
+            dt = DeviceTable.from_host(cols, shardings=in_shardings)
             stats["uploads"] += len(self.upload_cols)
             return dt, m, target
 
         def fetch(item):
             outs, m = item
             t0 = time.perf_counter()
-            host = tuple(np.asarray(o)[:m] for o in outs)
+            if mesh is None:
+                host = tuple(np.asarray(o)[:m] for o in outs)
+            else:
+                # per-shard read-back: fetch each chip's shard separately,
+                # timing the copies — the spread between the slowest and
+                # fastest chip is the shard-skew gauge
+                host = tuple(
+                    _fetch_sharded(o, m, shard_seconds) for o in outs)
             stats["fetch_seconds"] += time.perf_counter() - t0
             stats["downloads"] += len(host)
             return host
@@ -348,7 +468,8 @@ class _FusedSegment:
         readback = AsyncReadback(fetch, lag=1)
         chunks: list[tuple[np.ndarray, ...]] = []
         with tracer.start_span("pipeline.fused_segment", segment=self.index,
-                               stages=",".join(self.stage_names), rows=n):
+                               stages=",".join(self.stage_names), rows=n,
+                               mesh_shape=mesh_label):
             for dt, m, target in prefetch:
                 shape_key = (target, tuple(
                     (str(dt[c].dtype), tuple(dt[c].shape[1:]))
@@ -364,6 +485,11 @@ class _FusedSegment:
         stats["prepare_seconds"] = prefetch.stats["prepare_seconds"]
         stats["overlap_fraction"] = prefetch.overlap_fraction()
         stats.update(self._exec_cache.stats())
+        if shard_seconds:
+            per_shard = sorted(shard_seconds.values())
+            skew = per_shard[-1] / max(per_shard[0], 1e-9)
+            stats["shard_skew_ratio"] = skew
+            _set_shard_skew_gauge(fused_label, mesh_label, skew)
 
         out = table
         for j, c in enumerate(self.download_cols):
@@ -378,6 +504,28 @@ class _FusedSegment:
                 meta = meta(arr)
             out = out.with_column(c, arr, meta=meta)
         return out, stats
+
+
+def _fetch_sharded(arr: Any, m: int, shard_seconds: dict) -> np.ndarray:
+    """Read a device array back shard by shard, accumulating per-device
+    copy seconds into `shard_seconds` (feeds the shard-skew gauge).  Whole
+    -array copy for replicated/single-shard outputs (one transfer suffices
+    and there is no per-chip spread to measure)."""
+    sharding = getattr(arr, "sharding", None)
+    if sharding is not None and getattr(sharding, "is_fully_replicated", False):
+        return np.asarray(arr)[:m]
+    shards = list(getattr(arr, "addressable_shards", None) or [])
+    if len(shards) <= 1:
+        return np.asarray(arr)[:m]
+    out = np.empty(arr.shape, np.dtype(arr.dtype))
+    for sh in shards:
+        t0 = time.perf_counter()
+        piece = np.asarray(sh.data)
+        key = str(sh.device)
+        shard_seconds[key] = (shard_seconds.get(key, 0.0)
+                              + time.perf_counter() - t0)
+        out[sh.index] = piece
+    return out[:m]
 
 
 # --------------------------------------------------------------------- #
@@ -403,38 +551,78 @@ class FusedPipelineModel(PipelineModel):
               "compiled-shape set stays closed", ptype=bool)
     fused_label = Param(
         "pipeline", "label for the fusion-ratio gauge", ptype=str)
+    use_mesh = Param(
+        False, "compile fused segments under the process mesh "
+               "(parallel.mesh.get_mesh()) when no explicit mesh was set "
+               "via fuse(model, mesh=...) / set_mesh()", ptype=bool)
 
     #: stats from the most recent transform: per-segment timings, transfer
     #: counts, executable-cache counters, fusion ratio
     last_stats: "dict | None" = None
+    #: explicit mesh (runtime handle, not serialized state — like a model
+    #: bundle, it is re-attached after load via set_mesh)
+    mesh: Any = None
     _segments: "list | None" = None
     _segments_key: "tuple | None" = None
     _plan: "FusionPlan | None" = None
+    _mesh: Any = None  # the normalized mesh the current segments compile on
 
     def plan(self) -> FusionPlan:
         self._ensure_segments()
         return self._plan
 
+    def set_mesh(self, mesh: Any) -> "FusedPipelineModel":
+        """Attach (or with None, detach) the mesh fused segments compile
+        under; segments rebuild on next use.  Returns self."""
+        self.mesh = mesh
+        self._segments = None
+        return self
+
+    def _effective_mesh(self) -> Any:
+        """The mesh segments actually compile on: the explicit one, else
+        `get_mesh()` when `use_mesh` is set — normalized to None whenever
+        it spans a single device, so a trivial mesh IS the single-chip
+        path (same executables, same cache keys)."""
+        mesh = self.mesh
+        if mesh is None and self.get("use_mesh"):
+            from ..parallel.mesh import get_mesh
+
+            mesh = get_mesh()
+        if mesh is None:
+            return None
+        from ..parallel.mesh import mesh_device_count
+
+        return mesh if mesh_device_count(mesh) > 1 else None
+
     def _ensure_segments(self):
         stages = list(self.get("stages") or [])
-        key = tuple(id(s) for s in stages)
+        mesh = self._effective_mesh()
+        key = (tuple(id(s) for s in stages), mesh)
         if self._segments is None or self._segments_key != key:
             self._plan = plan_fusion(stages)
             segs = []
             for i, sp in enumerate(self._plan.segments):
-                segs.append(_FusedSegment(i, sp.stages) if sp.fused else sp)
+                segs.append(_FusedSegment(i, sp.stages, mesh=mesh)
+                            if sp.fused else sp)
             self._segments = segs
             self._segments_key = key
+            self._mesh = mesh
         return self._segments
 
     def _transform(self, table: Table) -> Table:
         segments = self._ensure_segments()
         tracer = _get_tracer()
+        mesh_label = "1"
+        if self._mesh is not None:
+            from ..parallel.mesh import mesh_shape_label
+
+            mesh_label = mesh_shape_label(self._mesh)
         stats: dict[str, Any] = {
             "segments": [], "uploads": 0, "downloads": 0,
             "fusion_ratio": self._plan.fusion_ratio,
             "n_stages": self._plan.n_stages,
             "n_fused_stages": self._plan.n_fused_stages,
+            "mesh_shape": mesh_label,
         }
         current = table
         for seg in segments:
@@ -446,6 +634,7 @@ class FusedPipelineModel(PipelineModel):
                     seg_stats = {
                         "kind": "host_fallback", "segment": seg.index,
                         "stages": list(seg.stage_names), "reason": why_not,
+                        "mesh_shape": "1",  # ran staged on the host path
                     }
                 else:
                     current, seg_stats = seg.run(
@@ -453,7 +642,8 @@ class FusedPipelineModel(PipelineModel):
                         mini_batch_size=self.get("mini_batch_size"),
                         prefetch_depth=self.get("prefetch_depth"),
                         shape_buckets=self.get("shape_buckets"),
-                        tracer=tracer)
+                        tracer=tracer,
+                        fused_label=self.get("fused_label"))
                     stats["uploads"] += seg_stats["uploads"]
                     stats["downloads"] += seg_stats["downloads"]
             else:
@@ -462,11 +652,13 @@ class FusedPipelineModel(PipelineModel):
                 seg_stats = {
                     "kind": "host",
                     "stages": [type(sp.stage).__name__ for sp in seg.stages],
+                    "mesh_shape": "1",
                 }
             seg_stats["seconds"] = time.perf_counter() - t0
             stats["segments"].append(seg_stats)
         self.last_stats = stats
-        _set_fusion_gauge(self.get("fused_label"), stats["fusion_ratio"])
+        _set_fusion_gauge(self.get("fused_label"), stats["fusion_ratio"],
+                          mesh_label)
         return current
 
     def _load_state(self, state: dict[str, Any]) -> None:
@@ -474,19 +666,24 @@ class FusedPipelineModel(PipelineModel):
         self._segments = None  # rebuild against the loaded stages
 
 
-def fuse(model: Any, **params: Any) -> FusedPipelineModel:
+def fuse(model: Any, mesh: Any = None, **params: Any) -> FusedPipelineModel:
     """Compile a PipelineModel (or any Transformer) for whole-pipeline
     fusion.  Idempotent; non-fusable stages keep their staged path, so
-    `fuse` never changes results — only where the work runs."""
+    `fuse` never changes results — only where the work runs.  With `mesh`,
+    fused segments compile sharded over that mesh (still byte-identical;
+    a 1-device mesh is the plain single-chip path)."""
     if isinstance(model, FusedPipelineModel):
-        return model
+        return model.set_mesh(mesh) if mesh is not None else model
     if isinstance(model, PipelineModel):
         stages = list(model.get("stages") or [])
     elif isinstance(model, Transformer):
         stages = [model]
     else:
         raise TypeError(f"fuse() needs a Transformer, got {type(model).__name__}")
-    return FusedPipelineModel(stages, **params)
+    fm = FusedPipelineModel(stages, **params)
+    if mesh is not None:
+        fm.set_mesh(mesh)
+    return fm
 
 
 # --------------------------------------------------------------------- #
@@ -519,13 +716,28 @@ def _get_tracer():
         return _NullTracer()
 
 
-def _set_fusion_gauge(label: str, ratio: float) -> None:
+def _set_fusion_gauge(label: str, ratio: float, mesh_shape: str = "1") -> None:
     try:
         from ..observability.metrics import get_registry
 
         get_registry().gauge(
             "mmlspark_tpu_pipeline_fusion_ratio",
             "fraction of pipeline stages executing inside fused segments",
-            labels=("pipeline",)).labels(pipeline=label).set(ratio)
+            labels=("pipeline", "mesh_shape")).labels(
+                pipeline=label, mesh_shape=mesh_shape).set(ratio)
+    except Exception:
+        pass
+
+
+def _set_shard_skew_gauge(label: str, mesh_shape: str, ratio: float) -> None:
+    try:
+        from ..observability.metrics import get_registry
+
+        get_registry().gauge(
+            "mmlspark_tpu_shard_skew_ratio",
+            "slowest/fastest per-shard wall time within a fused sharded "
+            "segment (1.0 = perfectly balanced chips)",
+            labels=("pipeline", "mesh_shape")).labels(
+                pipeline=label, mesh_shape=mesh_shape).set(ratio)
     except Exception:
         pass

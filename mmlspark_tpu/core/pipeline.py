@@ -201,8 +201,10 @@ class Timer(Transformer):
                 reg.histogram(
                     "mmlspark_tpu_pipeline_segment_seconds",
                     "fused-pipeline segment wall time by execution kind",
-                    labels=("kind",)).labels(
-                        kind=seg["kind"]).observe(seg["seconds"])
+                    labels=("kind", "mesh_shape")).labels(
+                        kind=seg["kind"],
+                        mesh_shape=seg.get("mesh_shape", "1"),
+                    ).observe(seg["seconds"])
         except Exception:
             pass
         return out
@@ -228,6 +230,7 @@ class Timer(Transformer):
                 "segment": seg.get("segment", i), "kind": seg.get("kind"),
                 "stages": list(seg.get("stages", [])), "seconds": total,
                 "device_seconds": device, "host_seconds": host,
+                "mesh_shape": seg.get("mesh_shape", "1"),
             })
         return report
 

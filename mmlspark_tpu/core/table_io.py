@@ -55,12 +55,31 @@ class DeviceTable:
         self._cols = dict(cols)
 
     @classmethod
-    def from_host(cls, cols: dict) -> "DeviceTable":
+    def from_host(cls, cols: dict, shardings: "dict | None" = None
+                  ) -> "DeviceTable":
         """Upload host ndarrays (one `device_put` per column).  Note jax's
-        x64 default: float64 uploads as float32, int64 as int32."""
+        x64 default: float64 uploads as float32, int64 as int32.
+
+        `shardings` optionally maps column names to `jax.sharding.Sharding`
+        placements: a listed column uploads committed to that sharding (the
+        fusion engine row-shards batch chunks over a mesh this way, one
+        per-shard transfer per chip); unlisted columns take the default
+        single-device upload."""
+        import jax
         import jax.numpy as jnp
 
-        return cls({name: jnp.asarray(arr) for name, arr in cols.items()})
+        shardings = shardings or {}
+        out = {}
+        for name, arr in cols.items():
+            s = shardings.get(name)
+            if s is not None:
+                # direct host->sharding transfer (no staging hop through the
+                # default device); device_put canonicalizes dtypes exactly
+                # like jnp.asarray, so both paths yield the same device dtype
+                out[name] = jax.device_put(arr, s)
+            else:
+                out[name] = jnp.asarray(arr)
+        return cls(out)
 
     @property
     def columns(self) -> list:

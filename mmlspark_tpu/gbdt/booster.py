@@ -952,6 +952,22 @@ class Booster:
 
         return params, fn
 
+    def device_predict_shardings(self, mesh, params=None):
+        """Placement of `device_predict_fn` params under a mesh: everything
+        REPLICATED — every row's traversal reads the whole binning table
+        (ub/rounded_up/nb) and every tree SoA, while rows themselves shard
+        over the data axis (the fusion engine's default input sharding).
+        Stating the contract explicitly keeps the scoring path's placement
+        pinned even if the engine's default ever changes."""
+        import jax
+
+        from ..parallel.mesh import replicated_sharding
+
+        if params is None:
+            params, _ = self.device_predict_fn()
+        repl = replicated_sharding(mesh)
+        return jax.tree.map(lambda _: repl, params)
+
     # ------------------------------------------------------------------ #
     # importances / persistence                                          #
     # ------------------------------------------------------------------ #

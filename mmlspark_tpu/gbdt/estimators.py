@@ -393,11 +393,18 @@ class GBDTRegressionModel(_BoosterModelMixin, HasFeaturesCol, HasPredictionCol, 
                             "representable (device binning would shift bins)")
             return True
 
+        def mesh_fn(mesh):
+            # same traversal body; rows shard over the data axis while the
+            # binning table + tree SoAs pin themselves replicated
+            return fn, b.device_predict_shardings(mesh, params)
+
         return DeviceKernel(
             fn=fn, input_cols=(in_col,), output_cols=(out_col,),
             params=params, name="GBDTRegressionModel",
             out_dtypes={out_col: np.float64},
-            out_meta={out_col: {SCORE_KIND: "prediction"}}, ready=ready)
+            out_meta={out_col: {SCORE_KIND: "prediction"}}, ready=ready,
+            mesh_fn=mesh_fn,
+            mesh_desc="rows P(data); binning table + tree SoAs replicated")
 
     @staticmethod
     def load_native_model(path: str, **cols) -> "GBDTRegressionModel":

@@ -862,6 +862,7 @@ def serve_model(
     host: str = "127.0.0.1",
     port: int = 0,
     fuse_pipeline: bool = True,
+    mesh=None,
     **server_kw,
 ) -> ServingServer:
     """Deploy a fitted Transformer: JSON body {col: value, ...} in,
@@ -871,7 +872,9 @@ def serve_model(
     PipelineModel handlers score through the whole-pipeline fusion path
     (core/fusion.py) automatically: adjacent device-capable stages compile
     into one XLA program per request batch. `fuse_pipeline=False` keeps
-    the stage-by-stage path."""
+    the stage-by-stage path. With `mesh` (a parallel.mesh mesh) the fused
+    segments compile sharded over it — request batches score data-parallel
+    across chips, byte-identical to the single-chip path."""
     from ..core.fusion import FusedPipelineModel
     from ..core.pipeline import PipelineModel
 
@@ -879,7 +882,9 @@ def serve_model(
             and not isinstance(model, FusedPipelineModel)):
         from ..core.fusion import fuse
 
-        model = fuse(model)
+        model = fuse(model, mesh=mesh)
+    elif mesh is not None and isinstance(model, FusedPipelineModel):
+        model.set_mesh(mesh)
 
     def handler(table: Table) -> Table:
         t = parse_request(table)

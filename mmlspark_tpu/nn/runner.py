@@ -320,17 +320,104 @@ class DeepModelTransformer(Model):
             )
         return variables
 
+    def _tp_forward_fn(self, fetches: tuple[str, ...], mesh):
+        """Column-parallel forward for the fused tensor-parallel path, or
+        None when this model can't take it (then the fused engine's default
+        — rows sharded, variables replicated — applies).
+
+        Only the hand-rolled MLP layout qualifies: its forward is a chain
+        of Dense+relu, which maps exactly onto `gathered_column_parallel`
+        (each chip computes a full-contraction slice of the output
+        features, then a tiled all_gather reassembles them) — identical
+        arithmetic to the unsharded matmul, so byte-identity holds.
+        Returns (forward, variable_shardings)."""
+        from ..parallel.mesh import MODEL_AXIS
+        from ..parallel.tensor_parallel import (dense_column_shardings,
+                                                dense_column_specs,
+                                                gathered_column_parallel)
+        try:
+            from jax import shard_map
+        except ImportError:  # jax < 0.5: shard_map lives under experimental
+            import functools
+
+            from jax.experimental.shard_map import shard_map as _shard_map
+
+            # the old rep-checker cannot see that the tiled all_gather
+            # replicates the output over the model axis; new jax proves it
+            shard_map = functools.partial(_shard_map, check_rep=False)
+        from jax.sharding import PartitionSpec as P
+
+        bundle = self.bundle
+        n_model = int(dict(mesh.shape).get(MODEL_AXIS, 1))
+        if n_model <= 1:
+            return None  # pure data parallelism: nothing to specialize
+        if bundle.architecture != "mlp":
+            return None
+        if any(f not in ("logits", "probability") for f in fetches):
+            return None  # intermediate captures need module.apply
+        if self.get("bfloat16"):
+            return None  # bf16 accumulation order voids byte-identity
+        variables = bundle.variables
+        if set(variables) != {"params"}:
+            return None
+        params = variables["params"]
+        if "head" not in params:
+            return None
+        names = sorted((nm for nm in params if nm.startswith("dense_")),
+                       key=lambda nm: int(nm.split("_", 1)[1]))
+        names.append("head")
+        if set(names) != set(params):
+            return None
+        for nm in names:
+            layer = params[nm]
+            k, b = layer.get("kernel"), layer.get("bias")
+            if (k is None or b is None
+                    or np.ndim(k) != 2 or np.ndim(b) != 1
+                    or jnp.asarray(k).dtype != jnp.float32):
+                return None
+            if k.shape[1] % n_model:
+                return None  # output features must split evenly
+
+        mean = np.asarray(bundle.preprocess.get("mean", 0.0), np.float32)
+        std = np.asarray(bundle.preprocess.get("std", 1.0), np.float32)
+
+        def tp_body(variables, x):
+            p = variables["params"]
+            h = x.reshape((x.shape[0], -1))
+            for nm in names:
+                h = gathered_column_parallel(
+                    h, p[nm]["kernel"], p[nm]["bias"], MODEL_AXIS)
+                if nm != "head":
+                    h = jax.nn.relu(h)
+            return h
+
+        specs = {"params": dense_column_specs(params)}
+        body = shard_map(tp_body, mesh=mesh,
+                         in_specs=(specs, P(DATA_AXIS, None)),
+                         out_specs=P(DATA_AXIS, None))
+
+        def forward(variables, x):
+            x = (x.astype(jnp.float32) - mean) / std
+            logits = body(variables, x).astype(jnp.float32)
+            return tuple(jax.nn.softmax(logits, axis=-1)
+                         if f == "probability" else logits
+                         for f in fetches)
+
+        shardings = {"params": dense_column_shardings(mesh, params)}
+        return forward, shardings
+
     def device_kernel(self):
         """Fusion kernel (core/fusion.py): the same `_forward_fn` the staged
         path jits, with the variables passed as device-resident params.
         The forward is row-independent (eval mode — no batch statistics),
-        so the engine's chunking/padding cannot change any row's value."""
+        so the engine's chunking/padding cannot change any row's value.
+        Under a mesh the engine row-shards batches by default; a mesh with
+        a >1 model axis additionally swaps in the column-parallel forward
+        via `mesh_fn` (weights sharded on output features)."""
         from ..core.fusion import DeviceKernel
 
         if self.bundle is None:
             return "no model bundle attached (call set_model())"
-        if self.get("use_mesh"):
-            return "mesh-sharded apply manages its own device placement"
         fetch = dict(self.get("fetch_dict"))
         fetches = tuple(fetch.values())
         out_cols = tuple(fetch.keys())
@@ -346,13 +433,28 @@ class DeepModelTransformer(Model):
                 return f"column {in_col!r} is a ragged list (host stacks it)"
             return True
 
+        def mesh_fn(mesh):
+            tp = self._tp_forward_fn(fetches, mesh)
+            if tp is None:
+                return None
+            tp_forward, shardings = tp
+
+            def tp_fn(params, cols):
+                outs = tp_forward(params, cols[in_col])
+                return dict(zip(out_cols, outs))
+
+            return tp_fn, shardings
+
         meta = {c: {SCORE_KIND: "probability" if f == "probability"
                     else "raw_prediction"} for c, f in fetch.items()}
         return DeviceKernel(
             fn=fn, input_cols=(in_col,), output_cols=out_cols,
             params=self._device_variables(), name="DeepModelTransformer",
             out_dtypes={c: np.float32 for c in out_cols},
-            out_meta=meta, ready=ready)
+            out_meta=meta, ready=ready, mesh_fn=mesh_fn,
+            mesh_desc=("rows P(data); dense kernels column-parallel "
+                       "P(None,model) + tiled all_gather when the mesh has "
+                       "a >1 model axis, else variables replicated"))
 
     # -- persistence ---------------------------------------------------- #
 

@@ -236,6 +236,8 @@ GAUGE_MERGE_POLICIES: dict[str, str] = {
     "mmlspark_tpu_dataplane_overlap_ratio": "max",
     "mmlspark_tpu_streaming_lookahead_hit_ratio": "max",
     "mmlspark_tpu_pipeline_fusion_ratio": "max",
+    # worst chip imbalance across the fleet is the actionable signal
+    "mmlspark_tpu_shard_skew_ratio": "max",
     "mmlspark_tpu_resilience_breaker_state_count": "max",
     "mmlspark_tpu_slo_burn_rate": "max",
     "mmlspark_tpu_slo_budget_remaining_ratio": "min",

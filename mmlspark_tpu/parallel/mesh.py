@@ -41,6 +41,8 @@ __all__ = [
     "replicated_sharding",
     "shard_rows",
     "local_device_count",
+    "mesh_shape_label",
+    "mesh_device_count",
 ]
 
 DATA_AXIS = "data"
@@ -161,6 +163,20 @@ def replicated_sharding(mesh: Mesh | None = None) -> NamedSharding:
 
 def local_device_count() -> int:
     return jax.local_device_count()
+
+
+def mesh_device_count(mesh: Mesh | None) -> int:
+    """Total devices under a mesh; 1 for None (the single-chip path)."""
+    return 1 if mesh is None else int(np.asarray(mesh.devices).size)
+
+
+def mesh_shape_label(mesh: Mesh | None = None) -> str:
+    """Compact axis-size label for metrics/spans: '8x1' for an (8, 1)
+    data x model mesh, '1' for no mesh (single-chip). One string per mesh
+    shape, so series labeled by it cannot mix chip counts."""
+    if mesh is None:
+        return "1"
+    return "x".join(str(s) for s in mesh.shape.values())
 
 
 def shard_rows(array, mesh: Mesh | None = None, pad_value=0):

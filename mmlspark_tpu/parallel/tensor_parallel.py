@@ -23,9 +23,13 @@ except ImportError:  # jax < 0.5: shard_map lives under experimental
     from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .mesh import MODEL_AXIS
+
 __all__ = [
     "column_parallel",
     "row_parallel",
+    "gathered_column_parallel",
+    "dense_column_specs",
     "make_tp_mlp",
 ]
 
@@ -49,6 +53,51 @@ def row_parallel(x_local, w_local, axis_name: str, b=None):
     if b is not None:
         y = y + b
     return y
+
+
+def gathered_column_parallel(x, w_local, b_local, axis_name: str):
+    """Column-parallel dense followed by a tiled all_gather, so every chip
+    leaves with the FULL output features.
+
+    This is the bit-exact tensor-parallel layout: unlike the Megatron
+    column->row pair (whose psum adds PARTIAL contraction sums in a
+    device-count-dependent order), every output element here is one full
+    -contraction dot — identical arithmetic to the unsharded matmul — and
+    the gather merely concatenates disjoint feature slices.  That is what
+    lets the fused pipeline engine keep its byte-identity contract while
+    splitting matmul FLOPs/weights over the model axis."""
+    y = column_parallel(x, w_local, b_local)
+    return lax.all_gather(y, axis_name, axis=y.ndim - 1, tiled=True)
+
+
+def _column_spec(leaf, model_axis: str) -> P:
+    nd = getattr(leaf, "ndim", None)
+    if nd == 2:
+        return P(None, model_axis)   # kernel: shard OUTPUT features
+    if nd == 1:
+        return P(model_axis)         # bias: same feature slices
+    return P()
+
+
+def dense_column_specs(params, model_axis: str = MODEL_AXIS):
+    """PartitionSpec pytree for a tree of dense layers under column
+    parallelism: 2-D kernels shard on OUTPUT features, 1-D biases on the
+    same axis, anything else replicated.  Matches flax's
+    {layer: {"kernel", "bias"}} layout but only looks at ranks, so any
+    dict-of-dense params works."""
+    return jax.tree.map(lambda leaf: _column_spec(leaf, model_axis), params)
+
+
+def dense_column_shardings(mesh: Mesh, params, model_axis: str = MODEL_AXIS):
+    """`dense_column_specs` bound to a mesh as NamedSharding leaves — the
+    placement pytree `jax.device_put` takes.  (Built directly from the
+    params tree: PartitionSpec leaves can't be tree-mapped over, they ARE
+    containers to some jax versions.)"""
+    from jax.sharding import NamedSharding
+
+    return jax.tree.map(
+        lambda leaf: NamedSharding(mesh, _column_spec(leaf, model_axis)),
+        params)
 
 
 def make_tp_mlp(mesh: Mesh, model_axis: str,

@@ -81,13 +81,16 @@ class StreamingQuery:
                  name: str = "query",
                  metrics: Any = None,
                  tracer: Any = None,
-                 fuse_pipeline: bool = True) -> None:
+                 fuse_pipeline: bool = True,
+                 mesh: Any = None) -> None:
         self.source = source
         # PipelineModel transforms score through the whole-pipeline fusion
         # path (core/fusion.py): adjacent device-capable stages compile
         # into one XLA program per micro-batch. FusedPipelineModel still
         # exposes the `stages` param, so stateful-operator discovery below
-        # walks the same leaves either way.
+        # walks the same leaves either way. With `mesh`, fused segments
+        # compile sharded over it (byte-identical, so exactly-once replay
+        # semantics are untouched).
         if fuse_pipeline and transform is not None:
             from ..core.fusion import FusedPipelineModel
             from ..core.pipeline import PipelineModel
@@ -96,7 +99,10 @@ class StreamingQuery:
                     and not isinstance(transform, FusedPipelineModel)):
                 from ..core.fusion import fuse
 
-                transform = fuse(transform)
+                transform = fuse(transform, mesh=mesh)
+            elif mesh is not None and isinstance(transform,
+                                                 FusedPipelineModel):
+                transform.set_mesh(mesh)
         self.transform = transform
         self.sink = sink if sink is not None else MemorySink()
         self.name = name

@@ -8,8 +8,9 @@
 #' @param prefetch_depth chunks prepared/uploaded ahead of device compute (0 = sequential)
 #' @param shape_buckets pad ragged chunk tails to a pow-2 bucket ladder so the compiled-shape set stays closed
 #' @param fused_label label for the fusion-ratio gauge
+#' @param use_mesh compile fused segments under the process mesh (parallel.mesh.get_mesh()) when no explicit mesh was set via fuse(model, mesh=...) / set_mesh()
 #' @export
-ml_fused_pipeline_model <- function(x, stages = NULL, mini_batch_size = 4096L, prefetch_depth = 2L, shape_buckets = TRUE, fused_label = "pipeline")
+ml_fused_pipeline_model <- function(x, stages = NULL, mini_batch_size = 4096L, prefetch_depth = 2L, shape_buckets = TRUE, fused_label = "pipeline", use_mesh = FALSE)
 {
   params <- list()
   if (!is.null(stages)) params$stages <- as.list(stages)
@@ -17,5 +18,6 @@ ml_fused_pipeline_model <- function(x, stages = NULL, mini_batch_size = 4096L, p
   if (!is.null(prefetch_depth)) params$prefetch_depth <- as.integer(prefetch_depth)
   if (!is.null(shape_buckets)) params$shape_buckets <- as.logical(shape_buckets)
   if (!is.null(fused_label)) params$fused_label <- as.character(fused_label)
+  if (!is.null(use_mesh)) params$use_mesh <- as.logical(use_mesh)
   .tpu_apply_stage("mmlspark_tpu.core.fusion.FusedPipelineModel", params, x, is_estimator = FALSE)
 }

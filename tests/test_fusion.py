@@ -403,7 +403,8 @@ class TestObservability:
         assert "pipeline.fused_segment" in names
         gauge = get_registry().gauge(
             "mmlspark_tpu_pipeline_fusion_ratio",
-            labels=("pipeline",)).labels(pipeline="ratio-test")
+            labels=("pipeline", "mesh_shape")).labels(
+                pipeline="ratio-test", mesh_shape="1")
         assert gauge.value == pytest.approx(0.5)
 
     def test_timer_reports_device_host_split_for_fused(self):

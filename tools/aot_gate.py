@@ -28,11 +28,14 @@ VERDICTS = []
 
 
 def gate(name, build):
-    """build() -> (fn, abstract_args); compile and record the verdict."""
+    """build() -> (fn, abstract_args); compile and record the verdict.
+    `fn` may already be jitted (e.g. with in_shardings for the sharded
+    ladder) — then its own lower() is used instead of re-wrapping."""
     t0 = time.time()
     try:
         fn, args = build()
-        jax.jit(fn).lower(*args).compile()
+        lowerable = fn if hasattr(fn, "lower") else jax.jit(fn)
+        lowerable.lower(*args).compile()
         VERDICTS.append((name, "OK", time.time() - t0, ""))
         print(f"AOT {name}: OK ({time.time() - t0:.1f}s)", flush=True)
     except Exception as e:  # noqa: BLE001 — each kernel gets its own verdict
@@ -97,6 +100,39 @@ def runner_bucket_build(n):
     return fwd, (t.bundle.variables, sds((n, 8), jnp.float32))
 
 
+def runner_sharded_build(n, n_data, n_model=1):
+    """One (bucket shape x mesh shape) cell of the SHARDED ladder.
+
+    Under a mesh the fusion engine pads to buckets that are multiples of
+    the data-axis size, and a different mesh shape is a different program
+    (the executable-cache family key includes it) — so every combination
+    the sharded ladder can mint must compile, or a chip-count change means
+    a steady-state recompile. n_model > 1 compiles the tensor-parallel
+    (column-parallel + all_gather) forward, the same body the fused
+    DeepModelTransformer kernel swaps in via mesh_fn."""
+    from mmlspark_tpu.nn.models import ModelBundle
+    from mmlspark_tpu.nn.runner import DeepModelTransformer
+    from mmlspark_tpu.parallel.mesh import (data_sharding, make_mesh,
+                                            replicated_sharding)
+
+    mesh = make_mesh(n_data=n_data, n_model=n_model,
+                     devices=jax.devices()[: n_data * n_model])
+    t = DeepModelTransformer(input_col="x", fused_dispatch=False)
+    # feature/output widths divisible by the model axis so TP qualifies
+    t.set_model(ModelBundle.init("mlp", (8,), seed=0, num_outputs=4,
+                                 features=(16, 8)))
+    x = sds((n, 8), jnp.float32)
+    if n_model > 1:
+        fwd, shardings = t._tp_forward_fn(("logits",), mesh)
+        jfn = jax.jit(fwd, in_shardings=(shardings,
+                                         data_sharding(mesh, None)))
+    else:
+        jfn = jax.jit(t._forward_fn(("logits",)),
+                      in_shardings=(replicated_sharding(mesh),
+                                    data_sharding(mesh, None)))
+    return jfn, (t.bundle.variables, x)
+
+
 def main():
     dev = jax.devices()[0]
     print(f"device: {dev.platform} {getattr(dev, 'device_kind', '?')}",
@@ -122,6 +158,18 @@ def main():
     for bucket in ShapeBucketer(64).ladder:
         gate(f"runner_bucket_b{bucket}",
              lambda n=bucket: runner_bucket_build(n))
+
+    # sharded ladder: every (bucket shape x mesh shape) the fused engine
+    # can mint on this host's devices, incl. one 2-D data x model mesh
+    n_dev = len(jax.devices())
+    mesh_shapes = [(d, 1) for d in (2, 4, 8) if d <= n_dev]
+    if n_dev >= 8:
+        mesh_shapes.append((4, 2))
+    for n_data, n_model in mesh_shapes:
+        for bucket in ShapeBucketer(64, multiple_of=n_data).ladder:
+            gate(f"runner_bucket_b{bucket}_mesh{n_data}x{n_model}",
+                 lambda n=bucket, d=n_data, m=n_model:
+                 runner_sharded_build(n, d, m))
 
     n_fail = sum(1 for _, v, _, _ in VERDICTS if v == "FAIL")
     print(f"\nAOT GATE SUMMARY: {len(VERDICTS) - n_fail}/{len(VERDICTS)} "

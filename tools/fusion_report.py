@@ -67,14 +67,24 @@ def build_pipelines():
 
 
 def main() -> int:
+    import jax
+
     from mmlspark_tpu.core.fusion import plan_fusion
 
+    # with >1 device (e.g. XLA_FLAGS=--xla_force_host_platform_device_count)
+    # describe the plan against the full-device mesh; single-device CI keeps
+    # mesh=1 and still prints each kernel's sharding contract
+    mesh = None
+    if len(jax.devices()) > 1:
+        from mmlspark_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh()
     failures = []
     for title, model, expected_ratio in build_pipelines():
         plan = plan_fusion(model.get("stages"))
         fused_t, staged_t = plan.transfers_per_batch()
         print(f"== {title} ==")
-        print(plan.describe())
+        print(plan.describe(mesh=mesh))
         print(f"   transfers/batch: fused={fused_t} staged={staged_t}")
         if plan.fusion_ratio < expected_ratio:
             failures.append(

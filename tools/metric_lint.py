@@ -20,6 +20,12 @@ the static skeleton), and enforces:
      merge policy via ``observability.fleet.merge_policy_for`` — a gauge
      that neither appears in GAUGE_MERGE_POLICIES nor matches a suffix
      default would silently aggregate wrong in the fleet ``/metrics``.
+  4. ``_ratio`` gauges need an EXPLICIT GAUGE_MERGE_POLICIES entry, not
+     just the suffix fallback: ratios split between worst-case signals
+     (fusion ratio, shard skew → max) and best-case budgets (SLO budget
+     remaining → min), so the author must state which one — the suffix
+     default silently picking max is exactly the aggregation bug this
+     lint exists to stop.
 
 Usage: python tools/metric_lint.py    # exit 1 with a report if any fail
 """
@@ -61,6 +67,15 @@ def _merge_policy_for(name: str) -> "str | None":
     return merge_policy_for(name, kind)
 
 
+def _explicit_policy(name: str) -> "str | None":
+    sys.path.insert(0, ROOT)
+    try:
+        from mmlspark_tpu.observability.fleet import GAUGE_MERGE_POLICIES
+    finally:
+        sys.path.pop(0)
+    return GAUGE_MERGE_POLICIES.get(name)
+
+
 def iter_sources() -> list[str]:
     paths = []
     for entry in SCAN:
@@ -97,6 +112,14 @@ def lint_file(path: str) -> list[str]:
                         "policy (add it to observability.fleet."
                         "GAUGE_MERGE_POLICIES or use a suffix with a "
                         "default)")
+                    continue
+                if (name.endswith("_ratio")
+                        and _explicit_policy(name) is None):
+                    problems.append(
+                        f"{where}: ratio gauge {name!r} relies on the "
+                        "suffix-default merge policy — declare max/min "
+                        "intent explicitly in observability.fleet."
+                        "GAUGE_MERGE_POLICIES")
     return problems
 
 
