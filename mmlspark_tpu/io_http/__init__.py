@@ -13,9 +13,10 @@ from .schema import (
     parse_request,
     make_reply,
 )
-from .clients import http_send, HTTPClient
+from .clients import http_send, HTTPClient, TargetPool
 from .transformer import (
     HTTPTransformer,
+    DistributedHTTPTransformer,
     SimpleHTTPTransformer,
     JSONInputParser,
     JSONOutputParser,
@@ -26,6 +27,8 @@ from .transformer import (
 from .forwarding import ForwardingOptions, PortForward, establish_forward
 from .journal import ServingJournal
 from .serving import MicroBatchQuery, ServingFleet, ServingServer, serve_model
+from .gateway import ServingGateway
+from .autoscale import FleetAutoscaler
 from .consolidator import PartitionConsolidator
 from .powerbi import PowerBIWriter
 from .cognitive import (
@@ -58,7 +61,9 @@ __all__ = [
     "make_reply",
     "http_send",
     "HTTPClient",
+    "TargetPool",
     "HTTPTransformer",
+    "DistributedHTTPTransformer",
     "SimpleHTTPTransformer",
     "JSONInputParser",
     "JSONOutputParser",
@@ -69,6 +74,8 @@ __all__ = [
     "ServingJournal",
     "ServingFleet",
     "ServingServer",
+    "ServingGateway",
+    "FleetAutoscaler",
     "serve_model",
     "PartitionConsolidator",
     "PowerBIWriter",

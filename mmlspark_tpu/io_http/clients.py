@@ -11,22 +11,34 @@ implementation for the whole package); the legacy `retries`/`backoff_ms`
 arguments build an equivalent fixed-ladder policy. An optional
 resilience.CircuitBreaker short-circuits a dead endpoint to a synthetic
 503 instead of burning the backoff budget per request.
+
+`TargetPool` is the one request-spreading primitive for multi-replica
+targets (the reference's load balancer in front of per-executor servers):
+a mutable set of base URLs with a per-URL breaker, manual eject/admit on
+top of breaker state, in-flight accounting, and three pick strategies —
+round-robin, least-loaded, and consistent hash on a caller key.
+`HTTPClient(urls=[...])` and io_http.gateway.ServingGateway both route
+through it, so replica failover has exactly one tested implementation.
 """
 
 from __future__ import annotations
 
+import hashlib
+import itertools
+import threading
 import urllib.error
+import urllib.parse
 import urllib.request
 from typing import Iterable, Sequence
 
 from ..observability.tracing import current_traceparent
-from ..resilience.breaker import CircuitBreaker
+from ..resilience.breaker import BreakerRegistry, CircuitBreaker
 from ..resilience.policy import (RetryPolicy, is_retryable_exception,
                                  is_retryable_status)
 from ..utils.async_utils import buffered_map
 from .schema import HTTPRequestData, HTTPResponseData
 
-__all__ = ["http_send", "HTTPClient"]
+__all__ = ["http_send", "HTTPClient", "TargetPool"]
 
 
 def _legacy_policy(retries: int, backoff_ms: Sequence[float]) -> RetryPolicy:
@@ -122,23 +134,274 @@ def http_send(
                 status_code=0, reason=str(last_exc), entity=None)
 
 
+class _Target:
+    """Per-URL pool state: in-flight count + manual health gate."""
+
+    __slots__ = ("url", "inflight", "ejected", "eject_reason")
+
+    def __init__(self, url: str):
+        self.url = url
+        self.inflight = 0
+        self.ejected = False
+        self.eject_reason = ""
+
+
+def _stable_hash(s: str) -> int:
+    """Process-independent 64-bit hash (builtin hash() is salted per
+    process — a consistent-hash ring must agree across restarts)."""
+    return int.from_bytes(
+        hashlib.blake2b(s.encode(), digest_size=8).digest(), "big")
+
+
+class _Lease:
+    """Context manager pairing pick with in-flight accounting."""
+
+    __slots__ = ("_pool", "url")
+
+    def __init__(self, pool: "TargetPool", url: str):
+        self._pool = pool
+        self.url = url
+
+    def __enter__(self) -> str:
+        return self.url
+
+    def __exit__(self, *exc) -> None:
+        self._pool._release(self.url)
+
+
+class TargetPool:
+    """The one request-spreading primitive over a mutable set of replica
+    base URLs (the reference's load balancer in front of per-executor
+    servers, SURVEY.md §3.4). Thread-safe.
+
+    Health is layered: each URL gets a per-endpoint CircuitBreaker (from
+    `breakers`, a resilience.BreakerRegistry), and an independent manual
+    eject/admit gate for probe-driven control (the gateway ejects on a
+    failed /readyz and re-admits after probe success). A target is *live*
+    when it is admitted AND its breaker is not open — half-open targets
+    stay live so breaker probe traffic can heal them.
+
+    Pick strategies:
+      round_robin   next live target after a rotating cursor
+      least_loaded  live target with the fewest in-flight leases
+      hash          consistent hash of `key` over a virtual-node ring —
+                    a key keeps its target until that target leaves the
+                    live set (stateful/session-affine handlers)
+    """
+
+    VNODES = 32  # virtual nodes per target on the hash ring
+
+    def __init__(self, urls: Sequence[str] = (),
+                 breakers: "BreakerRegistry | None" = None,
+                 clock=None, **breaker_kw):
+        if breakers is None:
+            from ..resilience.policy import SYSTEM_CLOCK
+
+            breakers = BreakerRegistry(
+                clock=clock if clock is not None else SYSTEM_CLOCK,
+                **breaker_kw)
+        self.breakers = breakers
+        self._lock = threading.Lock()
+        self._targets: dict[str, _Target] = {}
+        self._rr = itertools.count()
+        for u in urls:
+            self.add(u)
+
+    # -- membership ----------------------------------------------------- #
+
+    def add(self, url: str) -> None:
+        with self._lock:
+            if url not in self._targets:
+                self._targets[url] = _Target(url)
+
+    def remove(self, url: str) -> None:
+        with self._lock:
+            self._targets.pop(url, None)
+
+    @property
+    def urls(self) -> list[str]:
+        with self._lock:
+            return list(self._targets)
+
+    # -- health gating -------------------------------------------------- #
+
+    def eject(self, url: str, reason: str = "") -> bool:
+        """Take a member out of rotation without forgetting it (breaker
+        open / failed readiness probe). Returns True if state changed."""
+        with self._lock:
+            t = self._targets.get(url)
+            if t is None or t.ejected:
+                return False
+            t.ejected = True
+            t.eject_reason = reason
+            return True
+
+    def admit(self, url: str) -> bool:
+        """Return an ejected member to rotation (adds it first if it is
+        not yet a member — the rolling-swap admission path)."""
+        with self._lock:
+            t = self._targets.get(url)
+            if t is None:
+                t = self._targets[url] = _Target(url)
+                return True
+            changed = t.ejected
+            t.ejected = False
+            t.eject_reason = ""
+            return changed
+
+    def breaker_for(self, url: str) -> CircuitBreaker:
+        return self.breakers.breaker_for(url)
+
+    def _is_live(self, t: _Target) -> bool:
+        return not t.ejected and \
+            self.breakers.breaker_for(t.url).state != "open"
+
+    def live(self) -> list[str]:
+        with self._lock:
+            targets = list(self._targets.values())
+        return [t.url for t in targets if self._is_live(t)]
+
+    # -- picking + accounting ------------------------------------------- #
+
+    def pick(self, strategy: str = "round_robin", key: "str | None" = None,
+             exclude: Sequence[str] = ()) -> "str | None":
+        """One live target URL (None when the live set minus `exclude` is
+        empty). `hash` strategy requires `key`."""
+        with self._lock:
+            targets = list(self._targets.values())
+        live = [t for t in targets
+                if t.url not in exclude and self._is_live(t)]
+        if not live:
+            return None
+        if strategy == "hash" and key is not None:
+            ring = sorted(
+                (_stable_hash(f"{t.url}#{v}"), t.url)
+                for t in live for v in range(self.VNODES))
+            point = _stable_hash(key)
+            for h, url in ring:
+                if h >= point:
+                    return url
+            return ring[0][1]
+        if strategy == "least_loaded":
+            return min(live, key=lambda t: t.inflight).url
+        # round_robin (and the hash strategy with no key)
+        return live[next(self._rr) % len(live)].url
+
+    def lease(self, url: str) -> _Lease:
+        """In-flight accounting around one forwarded request — the
+        least_loaded signal. Use as a context manager."""
+        with self._lock:
+            t = self._targets.get(url)
+            if t is not None:
+                t.inflight += 1
+        return _Lease(self, url)
+
+    def _release(self, url: str) -> None:
+        with self._lock:
+            t = self._targets.get(url)
+            if t is not None and t.inflight > 0:
+                t.inflight -= 1
+
+    def inflight(self, url: str) -> int:
+        with self._lock:
+            t = self._targets.get(url)
+            return t.inflight if t is not None else 0
+
+    def states(self) -> dict[str, dict]:
+        """The routing table: per-URL live/ejected/in-flight/breaker
+        state (tools/diagnose.py prints this)."""
+        with self._lock:
+            targets = list(self._targets.values())
+        return {t.url: {
+            "live": self._is_live(t),
+            "ejected": t.ejected,
+            "eject_reason": t.eject_reason,
+            "inflight": t.inflight,
+            "breaker": self.breakers.breaker_for(t.url).state,
+        } for t in targets}
+
+    # -- sending -------------------------------------------------------- #
+
+    @staticmethod
+    def _rebase(req: HTTPRequestData, base: str) -> HTTPRequestData:
+        """Point `req` at `base`, keeping its path+query: requests carry
+        a path (or a full URL whose path is reused) and the pool decides
+        the host."""
+        path = req.url or "/"
+        split = urllib.parse.urlsplit(path)
+        if split.netloc:
+            path = urllib.parse.urlunsplit(
+                ("", "", split.path or "/", split.query, ""))
+        return HTTPRequestData(
+            method=req.method, url=urllib.parse.urljoin(base, path),
+            headers=req.headers, entity=req.entity)
+
+    def send(self, req: HTTPRequestData, timeout: float = 60.0,
+             policy: "RetryPolicy | None" = None,
+             strategy: str = "round_robin", key: "str | None" = None,
+             retry_connect: bool = True,
+             on_failover=None) -> HTTPResponseData:
+        """Route one request to a picked live target. On a CONNECTION
+        failure (status 0 — no HTTP answer, so resending is safe even
+        mid-POST) the request is retried once against a different live
+        target: a crashed replica costs a retry, not an error.
+        `on_failover(url, resp)` observes the failed first attempt."""
+        tried: list[str] = []
+        resp = HTTPResponseData(503, "no live targets", entity=None,
+                                headers={"Retry-After": "1"})
+        for _ in range(2 if retry_connect else 1):
+            url = self.pick(strategy=strategy, key=key, exclude=tried)
+            if url is None and tried:
+                # failover found no OTHER live target: retry the failed
+                # one rather than erroring a request a recovering replica
+                # could still serve
+                url = self.pick(strategy=strategy, key=key)
+            if url is None:
+                return resp
+            with self.lease(url):
+                resp = http_send(self._rebase(req, url), timeout=timeout,
+                                 policy=policy,
+                                 breaker=self.breaker_for(url))
+            if resp.status_code != 0:
+                return resp
+            tried.append(url)
+            if on_failover is not None:
+                on_failover(url, resp)
+        return resp
+
+
 class HTTPClient:
     """Batched sender. concurrency>1 = the reference's AsyncHTTPClient
-    sliding window; 1 = SingleThreadedHTTPClient."""
+    sliding window; 1 = SingleThreadedHTTPClient.
+
+    `urls=[...]` turns on round-robin spreading over a replica set via a
+    TargetPool (per-URL breakers, connection-failure failover to another
+    replica) — the client-side version of the gateway's routing, for
+    callers that talk to `ServingFleet.urls` directly. Each request's
+    own `url` contributes only its path."""
 
     def __init__(self, concurrency: int = 1, timeout: float = 60.0,
                  retries: int = 3, policy: "RetryPolicy | None" = None,
-                 breaker: "CircuitBreaker | None" = None):
+                 breaker: "CircuitBreaker | None" = None,
+                 urls: "Sequence[str] | None" = None,
+                 pool: "TargetPool | None" = None):
         self.concurrency = concurrency
         self.timeout = timeout
         self.retries = retries
         self.policy = policy
         self.breaker = breaker
+        if pool is None and urls:
+            pool = TargetPool(urls)
+        self.pool = pool
 
     def send_all(self, reqs: Iterable[HTTPRequestData]) -> list[HTTPResponseData]:
-        fn = lambda r: http_send(  # noqa: E731
-            r, timeout=self.timeout, retries=self.retries,
-            policy=self.policy, breaker=self.breaker)
+        if self.pool is not None:
+            fn = lambda r: self.pool.send(  # noqa: E731
+                r, timeout=self.timeout, policy=self.policy)
+        else:
+            fn = lambda r: http_send(  # noqa: E731
+                r, timeout=self.timeout, retries=self.retries,
+                policy=self.policy, breaker=self.breaker)
         if self.concurrency <= 1:
             return [fn(r) for r in reqs]
         return list(buffered_map(fn, list(reqs), self.concurrency))

@@ -1,0 +1,432 @@
+"""ServingGateway: the routing brain in front of a ServingFleet.
+
+Reference: Spark Serving's distributed mode puts a LOAD BALANCER in front
+of the per-executor servers (SURVEY.md §3.4, HTTPSourceV2's routing table
+keyed by ServiceInfo) — the reference leaves the balancer to the cloud;
+here it is a first-class, chaos-tested component:
+
+  * routes each POST to a live replica — least-loaded by in-flight count
+    by default, or consistent-hash on a routing-key header so stateful
+    handlers keep session affinity
+  * spreads through io_http.clients.TargetPool (per-replica circuit
+    breakers + manual eject/admit), so the gateway and direct
+    `HTTPClient(urls=...)` callers share ONE tested failover primitive
+  * a replica crash costs a RETRY, not an error: a connection failure
+    (status 0 — no HTTP answer was produced, so resending is safe)
+    hedges once against a different replica and ejects the dead one
+  * `probe_all()` ejects replicas whose /readyz fails (or whose breaker
+    is open) and re-admits them after probe success; wire it to a clock
+    loop with `start_probing()` or call it directly from tests
+  * tracks `ServingFleet` membership live via `attach_fleet` (scale-ups,
+    respawns and rolling swaps admit/eject atomically at the pool)
+  * optional `checkpoint_dir` journals every accept/reply at the gateway
+    (io_http.journal exactly-once semantics), so a mid-soak crash can
+    neither lose nor double-answer a journaled request
+
+Everything waits through the injectable clock; chaos tests drive the
+whole ejection/re-admission cycle on a FakeClock with zero real sleeps.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import time
+from http.server import ThreadingHTTPServer
+from typing import Any, Callable
+
+from ..resilience.policy import RetryPolicy, SYSTEM_CLOCK
+from .clients import TargetPool
+from .schema import HTTPRequestData, HTTPResponseData
+from .serving import SingleSegmentHandler
+
+__all__ = ["ServingGateway"]
+
+_GW_SEQ = itertools.count()
+
+# hop-by-hop headers never forwarded either direction (RFC 9110 §7.6.1)
+_HOP_HEADERS = frozenset((
+    "connection", "keep-alive", "proxy-authenticate", "proxy-authorization",
+    "te", "trailer", "transfer-encoding", "upgrade", "host",
+    "content-length",
+))
+
+
+class ServingGateway:
+    """HTTP front that routes to the live replicas of a serving fleet.
+
+    `urls` seeds the routing pool; `attach_fleet(fleet)` keeps it in sync
+    with a live `ServingFleet`. `routing_key_header` (default
+    `x-routing-key`) switches a request to consistent-hash routing.
+    """
+
+    def __init__(
+        self,
+        urls=(),
+        host: str = "127.0.0.1",
+        port: int = 0,
+        strategy: str = "least_loaded",
+        routing_key_header: str = "x-routing-key",
+        timeout_s: float = 30.0,
+        hedge: bool = True,
+        checkpoint_dir: "str | None" = None,
+        clock: Any = None,
+        metrics: Any = None,
+        policy: "RetryPolicy | None" = None,
+        pool: "TargetPool | None" = None,
+        probe_timeout_s: float = 2.0,
+        **breaker_kw,
+    ):
+        if strategy not in ("least_loaded", "round_robin", "hash"):
+            raise ValueError(f"unknown routing strategy {strategy!r}")
+        self.host, self.port = host, port
+        self.strategy = strategy
+        self.routing_key_header = routing_key_header.lower()
+        self.timeout_s = timeout_s
+        # hedge=False turns off the connection-failure retry for callers
+        # whose requests are NOT idempotent (side-effecting handlers)
+        self.hedge = hedge
+        self.clock = clock if clock is not None else SYSTEM_CLOCK
+        self.probe_timeout_s = probe_timeout_s
+        self.pool = pool if pool is not None else TargetPool(
+            urls, clock=self.clock, **breaker_kw)
+        if pool is not None:
+            for u in urls:
+                self.pool.add(u)
+        # forwarding does NOT retry in-place (no backoff sleeps on the
+        # gateway thread): retryable failures surface immediately and the
+        # hedge/breaker layer decides what happens next
+        self.policy = policy if policy is not None else RetryPolicy(
+            max_retries=0, clock=self.clock)
+        # exactly-once accept/reply journal at the gateway boundary
+        self.journal = None
+        self._id_counter = itertools.count()
+        if checkpoint_dir is not None:
+            from .journal import ServingJournal
+
+            self.journal = ServingJournal(checkpoint_dir)
+            self._id_counter = itertools.count(self.journal.max_id() + 1)
+        self._server: ThreadingHTTPServer | None = None
+        self._probe_thread: "threading.Thread | None" = None
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._fleet = None
+        self.autoscaler = None
+        self._init_metrics(metrics)
+
+    # -- metrics -------------------------------------------------------- #
+
+    def _init_metrics(self, metrics) -> None:
+        from ..observability.metrics import get_registry
+
+        self.metrics = metrics if metrics is not None else get_registry()
+        self.server_label = f"gw{next(_GW_SEQ)}"
+        lbl = {"server": self.server_label}
+        self._c_requests = self.metrics.counter(
+            "mmlspark_tpu_gateway_requests_total",
+            "requests routed through the gateway, by outcome",
+            labels=("server", "outcome"))
+        self._c_hedges = self.metrics.counter(
+            "mmlspark_tpu_gateway_hedged_retries_total",
+            "connection-failed requests retried on another replica",
+            labels=("server",)).labels(**lbl)
+        self._c_ejections = self.metrics.counter(
+            "mmlspark_tpu_gateway_ejections_total",
+            "replicas taken out of rotation, by reason",
+            labels=("server", "reason"))
+        self._c_admissions = self.metrics.counter(
+            "mmlspark_tpu_gateway_admissions_total",
+            "replicas (re)admitted into rotation",
+            labels=("server",)).labels(**lbl)
+        self._g_live = self.metrics.gauge(
+            "mmlspark_tpu_gateway_replicas_live_count",
+            "replicas currently in rotation",
+            labels=("server",)).labels(**lbl)
+        self._g_live_ratio = self.metrics.gauge(
+            "mmlspark_tpu_gateway_live_replicas_ratio",
+            "live replicas / known replicas (1.0 = fully healthy)",
+            labels=("server",)).labels(**lbl)
+        self._g_inflight = self.metrics.gauge(
+            "mmlspark_tpu_gateway_inflight_depth",
+            "requests currently forwarded and awaiting a replica reply",
+            labels=("server",)).labels(**lbl)
+        self._h_latency = self.metrics.histogram(
+            "mmlspark_tpu_gateway_latency_seconds",
+            "gateway latency, request read to reply written",
+            labels=("server",)).labels(**lbl)
+        self._update_pool_gauges()
+
+    def _update_pool_gauges(self) -> None:
+        states = self.pool.states()
+        live = sum(1 for s in states.values() if s["live"])
+        self._g_live.set(live)
+        self._g_live_ratio.set(live / len(states) if states else 0.0)
+        self._g_inflight.set(
+            sum(s["inflight"] for s in states.values()))
+
+    # -- membership ----------------------------------------------------- #
+
+    def admit(self, url: str) -> None:
+        """Put `url` into rotation (atomic at the pool: the next pick
+        already sees it). Counted even when already admitted — rolling
+        swap uses the admission stream as its audit trail."""
+        self.pool.admit(url)
+        self._c_admissions.inc()
+        self._update_pool_gauges()
+
+    def eject(self, url: str, reason: str = "manual") -> None:
+        if self.pool.eject(url, reason):
+            self._c_ejections.labels(
+                server=self.server_label, reason=reason).inc()
+        self._update_pool_gauges()
+
+    def remove(self, url: str) -> None:
+        """Forget `url` entirely (a retired/dead replica, not a sick one)."""
+        self.pool.remove(url)
+        self._update_pool_gauges()
+
+    def attach_fleet(self, fleet) -> "ServingGateway":
+        """Track a ServingFleet's membership: current `urls` seed the
+        pool, later scale/respawn/swap events admit/remove live."""
+        self._fleet = fleet
+        for u in fleet.urls:
+            self.admit(u)
+
+        def _on_change(event: str, url: str) -> None:
+            if event == "added":
+                self.admit(url)
+            elif event == "removed":
+                self.remove(url)
+
+        fleet.watch(_on_change)
+        return self
+
+    def attach_autoscaler(self, autoscaler) -> "ServingGateway":
+        """Expose an autoscaler's state under GET /autoscaler (the
+        diagnose snapshot reads it alongside /routes)."""
+        self.autoscaler = autoscaler
+        return self
+
+    # -- probing -------------------------------------------------------- #
+
+    def _probe(self, url: str) -> bool:
+        """One replica's /readyz — True = ready. Connection failures and
+        non-200s both count as not ready."""
+        import http.client
+        import urllib.parse
+
+        u = urllib.parse.urlsplit(url)
+        conn = None
+        try:
+            conn = http.client.HTTPConnection(
+                u.hostname, u.port, timeout=self.probe_timeout_s)
+            conn.request("GET", "/readyz")
+            r = conn.getresponse()
+            r.read()
+            return r.status == 200
+        except (OSError, http.client.HTTPException):
+            return False
+        finally:
+            if conn is not None:
+                conn.close()
+
+    def probe_all(self) -> dict[str, bool]:
+        """Probe every known replica: eject the not-ready (and the
+        breaker-open), re-admit ejected replicas whose probe succeeds.
+        Returns {url: ready}. Chaos tests call this directly; production
+        wires it to a clock loop via start_probing()."""
+        results: dict[str, bool] = {}
+        for url, st in self.pool.states().items():
+            ready = self._probe(url)
+            results[url] = ready
+            if not ready and not st["ejected"]:
+                # a breaker-open replica is already out of rotation; the
+                # explicit ejection keeps /routes' audit trail honest
+                # about WHY it is out
+                reason = "breaker" if st["breaker"] == "open" else "readyz"
+                self.eject(url, reason=reason)
+            elif ready and st["ejected"]:
+                self.admit(url)
+        self._update_pool_gauges()
+        return results
+
+    def start_probing(self, interval_s: float = 1.0) -> None:
+        """Background probe loop on the injectable clock."""
+        def _loop():
+            while not self._stop.is_set():
+                try:
+                    self.probe_all()
+                except Exception:  # noqa: BLE001 — probing must not die
+                    pass
+                self.clock.sleep(interval_s)
+
+        self._probe_thread = threading.Thread(target=_loop, daemon=True)
+        self._probe_thread.start()
+
+    # -- forwarding ----------------------------------------------------- #
+
+    def forward(self, req: HTTPRequestData,
+                key: "str | None" = None) -> HTTPResponseData:
+        """Route one request: pick a live replica (hash when `key` is
+        given), forward, hedge once on connection failure. A request no
+        live replica could take answers 503; both attempts dying on
+        connection errors answers 502."""
+        strategy = "hash" if key is not None else self.strategy
+
+        def _on_failover(url: str, _resp) -> None:
+            self._c_hedges.inc()
+            self.eject(url, reason="connect")
+
+        resp = self.pool.send(
+            req, timeout=self.timeout_s, policy=self.policy,
+            strategy=strategy, key=key, retry_connect=self.hedge,
+            on_failover=_on_failover)
+        if resp.status_code == 0:
+            # every attempt died at the connection level: the client gets
+            # a real HTTP answer (502), never a dropped socket
+            resp = HTTPResponseData(
+                502, f"no replica reachable: {resp.reason}",
+                headers={"Retry-After": "1"}, entity=None)
+        return resp
+
+    # -- HTTP surface --------------------------------------------------- #
+
+    def routes(self) -> dict:
+        """The routing table: per-replica pool state + strategy — what
+        GET /routes serves and tools/diagnose.py prints."""
+        states = self.pool.states()
+        return {
+            "strategy": self.strategy,
+            "routing_key_header": self.routing_key_header,
+            "hedge": self.hedge,
+            "n_targets": len(states),
+            "n_live": sum(1 for s in states.values() if s["live"]),
+            "targets": states,
+        }
+
+    def start(self) -> "ServingGateway":
+        outer = self
+
+        class Handler(SingleSegmentHandler):
+            protocol_version = "HTTP/1.1"
+            timeout = 5.0
+            body_timeout = 60.0
+
+            def do_POST(self):  # noqa: N802 — http.server API
+                self.connection.settimeout(self.body_timeout)
+                try:
+                    self._handle_post()
+                finally:
+                    self.connection.settimeout(self.timeout)
+
+            def _handle_post(self):
+                if self.headers.get("Transfer-Encoding"):
+                    self.send_response(411)
+                    self.send_header("Content-Length", "0")
+                    self.send_header("Connection", "close")
+                    self.end_headers()
+                    self.close_connection = True
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length) if length else b""
+                t0 = time.perf_counter()
+                headers = {k: v for k, v in self.headers.items()
+                           if k.lower() not in _HOP_HEADERS}
+                key = self.headers.get(outer.routing_key_header)
+                req = HTTPRequestData("POST", self.path, headers, body)
+                ex_id = None
+                if outer.journal is not None:
+                    ex_id = str(next(outer._id_counter))
+                    outer.journal.record_accept(ex_id, req)
+                # parent the forward on the caller's trace so the merged
+                # fleet trace reads client -> gateway -> replica
+                from ..observability.tracing import get_tracer
+
+                tracer = get_tracer()
+                remote = tracer.extract(self.headers.get("traceparent"))
+                with tracer.start_span("gateway.request", parent=remote,
+                                       path=self.path,
+                                       server=outer.server_label):
+                    resp = outer.forward(req, key=key)
+                if outer.journal is not None:
+                    outer.journal.record_reply(ex_id, resp)
+                status = resp.status_code or 500
+                outcome = ("ok" if 200 <= status < 400 else
+                           "unrouted" if status in (502, 503) else "error")
+                outer._c_requests.labels(server=outer.server_label,
+                                         outcome=outcome).inc()
+                self.send_response(status)
+                entity = resp.entity or b""
+                for k, v in (resp.headers or {}).items():
+                    if k.lower() not in _HOP_HEADERS:
+                        self.send_header(k, v)
+                self.send_header("Content-Length", str(len(entity)))
+                self.end_headers()
+                if entity:
+                    self.wfile.write(entity)
+                outer._h_latency.observe(time.perf_counter() - t0)
+                outer._update_pool_gauges()
+
+            def _reply_json(self, status: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    payload = outer.metrics.render_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
+                if path == "/routes":
+                    self._reply_json(200, outer.routes())
+                    return
+                if path == "/autoscaler":
+                    if outer.autoscaler is None:
+                        self._reply_json(404, {"error": "no autoscaler"})
+                    else:
+                        self._reply_json(200, outer.autoscaler.state())
+                    return
+                if path == "/healthz":
+                    self._reply_json(200, {
+                        "status": "ok", "routes": outer.routes()["n_live"]})
+                    return
+                if path == "/readyz":
+                    n_live = outer.routes()["n_live"]
+                    self._reply_json(200 if n_live else 503,
+                                     {"ready": n_live > 0,
+                                      "n_live": n_live})
+                    return
+                self._reply_json(404, {"error": "unknown path"})
+
+            def log_message(self, *a):
+                pass
+
+        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._server.server_address[1]
+        threading.Thread(target=self._server.serve_forever,
+                         daemon=True).start()
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}/"
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if self.journal is not None:
+            self.journal.close()

@@ -1283,7 +1283,14 @@ class ServingFleet:
     ServiceInfo at startup (HTTPSourceV2.scala:118-165), and `info()` /
     the rendezvous `GET /info` endpoint aggregates live per-replica
     counters into fleet totals.
-    """
+
+    Membership is dynamic: `kill()` prunes the dead replica from `urls`,
+    `respawn(index)` refills a slot through the same startup handshake,
+    `scale_to(n)` grows/shrinks the fleet (shrink = graceful drain), and
+    `rolling_swap(new_handler_factory)` replaces every replica's handler
+    with zero downtime. `watch(callback)` observes membership changes —
+    io_http.gateway.ServingGateway attaches itself this way so its
+    routing table tracks the live set."""
 
     def __init__(self, handler_factory: Callable[[], Callable[[Table], Table]],
                  n_hosts: int = 2, start_timeout_s: float = 60.0,
@@ -1305,55 +1312,184 @@ class ServingFleet:
         # how long stop() waits for the graceful drain-and-flush before
         # falling back to a hard kill
         self.stop_timeout_s = stop_timeout_s
+        # slot-indexed bookkeeping: _procs[slot] may hold a dead process
+        # (killed / retired); _url_of maps LIVE slots to their URLs and
+        # `urls` is rebuilt from it, so a crashed replica never lingers
+        # in the routing view
         self._procs: list[multiprocessing.Process] = []
+        self._url_of: dict[int, str] = {}
         self.urls: list[str] = []
-        # clock/stale_after_s feed the rendezvous aggregator's staleness
-        # logic — chaos tests pass a FakeClock so dead-replica detection
-        # needs zero real waiting
+        # fresh partition id per spawned process, NEVER reused: the
+        # aggregator retains a dead replica's counters for monotone fleet
+        # totals, so a respawn restarting the same id at zero would walk
+        # the totals backwards
+        self._next_part = 0
+        # slots drained ON PURPOSE (retire/scale-down) — dead_slots()
+        # excludes them so self-healing never resurrects a scale-down
+        self._retired: set[int] = set()
+        self._watchers: list[Callable[[str, str], None]] = []
+        self._fleet_lock = threading.RLock()
+        # the injectable clock drives the startup wait loop and the
+        # rendezvous aggregator's staleness logic — chaos tests pass a
+        # FakeClock so dead-replica detection needs zero real waiting
+        if clock is None:
+            from ..resilience.policy import SYSTEM_CLOCK
+
+            clock = SYSTEM_CLOCK
+        self.clock = clock
         self.rendezvous: FleetRendezvous | None = (
             FleetRendezvous(name="mmlspark_tpu.fleet", clock=clock,
                             stale_after_s=stale_after_s)
             if rendezvous else None
         )
 
+    # -- membership bookkeeping ----------------------------------------- #
+
+    def watch(self, callback: Callable[[str, str], None]) -> None:
+        """Register `callback(event, url)` for membership changes; event
+        is "added" (replica live and warm) or "removed" (about to drain
+        or already dead). The gateway admits/ejects through this."""
+        self._watchers.append(callback)
+
+    def _notify(self, event: str, url: str) -> None:
+        for cb in list(self._watchers):
+            try:
+                cb(event, url)
+            except Exception:  # noqa: BLE001 — watchers must not kill ops
+                pass
+
+    def _set_url(self, slot: int, url: str) -> None:
+        with self._fleet_lock:
+            self._url_of[slot] = url
+            self.urls = [self._url_of[s] for s in sorted(self._url_of)]
+        self._notify("added", url)
+
+    def _drop_url(self, slot: int) -> None:
+        with self._fleet_lock:
+            url = self._url_of.pop(slot, None)
+            self.urls = [self._url_of[s] for s in sorted(self._url_of)]
+        if url is not None:
+            self._notify("removed", url)
+
+    def live_slots(self) -> list[int]:
+        with self._fleet_lock:
+            return sorted(self._url_of)
+
+    def dead_slots(self) -> list[int]:
+        """Slots whose process died WITHOUT being retired on purpose —
+        the self-healing respawn set (FleetAutoscaler polls this)."""
+        with self._fleet_lock:
+            return [i for i, p in enumerate(self._procs)
+                    if i not in self._retired and not p.is_alive()]
+
+    @property
+    def n_live(self) -> int:
+        return len(self._url_of)
+
+    # -- spawning ------------------------------------------------------- #
+
+    def _launch(self, partition_id: int):
+        """Start one worker process; returns (process, parent_conn) for
+        the startup handshake."""
+        ctx = multiprocessing.get_context("spawn")
+        parent, child = ctx.Pipe()
+        p = ctx.Process(
+            target=_fleet_worker,
+            args=(self.handler_factory, child, self.server_kw, partition_id,
+                  self.rendezvous.url if self.rendezvous else None,
+                  self.forwarding, self.trace_dir),
+            daemon=True,
+        )
+        p.start()
+        return p, parent
+
+    def _await_url(self, slot: int, p, parent) -> str:
+        """The startup handshake wait: fail FAST on a dead child (e.g.
+        establish_forward raised on bad credentials/exhausted ports) —
+        waiting out the full timeout would mask the real error with a
+        generic one. The deadline runs on the injectable clock."""
+        deadline = self.clock.monotonic() + self.start_timeout_s
+        while not parent.poll(0.5):
+            if not p.is_alive():
+                raise RuntimeError(
+                    f"serving host {slot} died during startup (exitcode "
+                    f"{p.exitcode}) — see the child's "
+                    "stderr; with forwarding enabled this is usually "
+                    "the reverse tunnel failing to establish"
+                )
+            if self.clock.monotonic() > deadline:
+                raise TimeoutError("serving host failed to start")
+        host, port = parent.recv()
+        return f"http://{host}:{port}/"
+
+    def _wait_ready(self, url: str, timeout_s: "float | None" = None,
+                    proc=None) -> None:
+        """Poll the replica's /readyz until 200 — with a warmup request
+        configured, readiness means the fused executable is warm over the
+        FULL bucket ladder, so admitting the replica cannot cost a live
+        request a compile. Real-time deadline: this waits on a real
+        subprocess, not on simulated time."""
+        import http.client
+        import urllib.parse
+
+        u = urllib.parse.urlsplit(url)
+        deadline = time.monotonic() + (timeout_s if timeout_s is not None
+                                       else self.start_timeout_s)
+        while True:
+            try:
+                conn = http.client.HTTPConnection(u.hostname, u.port,
+                                                  timeout=2)
+                try:
+                    conn.request("GET", "/readyz")
+                    if conn.getresponse().status == 200:
+                        return
+                finally:
+                    conn.close()
+            except (OSError, http.client.HTTPException):
+                pass
+            if proc is not None and not proc.is_alive():
+                raise RuntimeError(
+                    f"replica {url} died while warming up (exitcode "
+                    f"{proc.exitcode})")
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"replica {url} never became ready")
+            time.sleep(0.02)
+
+    def _spawn(self, slot: int) -> str:
+        """Fill `slot` with a fresh worker: handshake, wait until warm
+        (/readyz), then publish it to `urls`/watchers — a spawned replica
+        is never routable before it is ready."""
+        part = self._next_part
+        self._next_part += 1
+        p, parent = self._launch(part)
+        with self._fleet_lock:
+            while len(self._procs) <= slot:
+                self._procs.append(p)
+            self._procs[slot] = p
+        url = self._await_url(slot, p, parent)
+        self._wait_ready(url, proc=p)
+        self._set_url(slot, url)
+        return url
+
     def start(self) -> "ServingFleet":
         if self.rendezvous is not None:
             self.rendezvous.start()
-        ctx = multiprocessing.get_context("spawn")
-        conns = []
-        for pid in range(self.n_hosts):
-            parent, child = ctx.Pipe()
-            p = ctx.Process(
-                target=_fleet_worker,
-                args=(self.handler_factory, child, self.server_kw, pid,
-                      self.rendezvous.url if self.rendezvous else None,
-                      self.forwarding, self.trace_dir),
-                daemon=True,
-            )
-            p.start()
+        # spawn all workers in parallel, then run each handshake
+        started = []
+        for slot in range(self.n_hosts):
+            part = self._next_part
+            self._next_part += 1
+            p, parent = self._launch(part)
             self._procs.append(p)
-            conns.append(parent)
-        import time as _time
-
-        for i, parent in enumerate(conns):
-            # fail FAST on a dead child (e.g. establish_forward raised on
-            # bad credentials/exhausted ports): waiting out the full
-            # timeout would mask the real error with a generic one
-            deadline = _time.monotonic() + self.start_timeout_s
-            while not parent.poll(0.5):
-                if not self._procs[i].is_alive():
-                    self.stop()
-                    raise RuntimeError(
-                        f"serving host {i} died during startup (exitcode "
-                        f"{self._procs[i].exitcode}) — see the child's "
-                        "stderr; with forwarding enabled this is usually "
-                        "the reverse tunnel failing to establish"
-                    )
-                if _time.monotonic() > deadline:
-                    self.stop()
-                    raise TimeoutError("serving host failed to start")
-            host, port = parent.recv()
-            self.urls.append(f"http://{host}:{port}/")
+            started.append((slot, p, parent))
+        try:
+            for slot, p, parent in started:
+                url = self._await_url(slot, p, parent)
+                self._wait_ready(url, proc=p)
+                self._set_url(slot, url)
+        except Exception:
+            self.stop()
+            raise
         return self
 
     def info(self) -> dict:
@@ -1366,11 +1502,73 @@ class ServingFleet:
         """Hard-kill one replica — the chaos path: no drain, no final
         flush, its ServiceInfo left registered (the rendezvous reports it
         unreachable/down, which is exactly what the fleet view must show
-        for a crashed process)."""
+        for a crashed process). The dead replica's URL is pruned from
+        `urls` so routing layers stop offering it."""
         p = self._procs[index]
         if p.is_alive():
             p.kill()
         p.join(timeout=10)
+        self._drop_url(index)
+
+    def respawn(self, index: int) -> str:
+        """Self-healing: refill a dead slot through the same startup
+        handshake `start()` uses. The new process gets a FRESH partition
+        id (the crashed one's counters stay retained in the fleet totals)
+        and is published only after /readyz. Returns the new URL."""
+        p = self._procs[index]
+        if p.is_alive():
+            raise RuntimeError(
+                f"slot {index} is still alive — kill() or retire() it "
+                "before respawning")
+        self._drop_url(index)  # no-op when kill() already pruned it
+        self._retired.discard(index)
+        return self._spawn(index)
+
+    def retire(self, index: int) -> None:
+        """Gracefully drain one replica out of the fleet: unpublish its
+        URL first (routing layers stop sending new work), then SIGTERM —
+        the worker sheds, drains in-flight requests, flushes its final
+        counters, and exits. Hard kill only past stop_timeout_s."""
+        self._retired.add(index)
+        self._drop_url(index)
+        p = self._procs[index]
+        if p.is_alive():
+            p.terminate()
+            p.join(timeout=self.stop_timeout_s)
+            if p.is_alive():
+                p.kill()
+                p.join(timeout=10)
+
+    def scale_to(self, n: int) -> list[str]:
+        """Grow or shrink the live replica set to `n`. Growth spawns into
+        fresh slots and publishes each replica once warm; shrink retires
+        the highest live slots via graceful drain. Returns `urls`."""
+        if n < 0:
+            raise ValueError(f"cannot scale to {n} replicas")
+        with self._fleet_lock:
+            live = sorted(self._url_of)
+        while len(live) < n:
+            slot = len(self._procs)
+            self._spawn(slot)
+            live.append(slot)
+        for slot in reversed(live[n:]):
+            self.retire(slot)
+        return list(self.urls)
+
+    def rolling_swap(self, new_handler_factory) -> int:
+        """Zero-downtime model swap: for each live replica, start a NEW
+        replica with `new_handler_factory`, warm it over the full bucket
+        ladder (the warmup/readyz gate in _spawn), publish it, and only
+        then drain and retire one old replica — the live set never drops
+        below its pre-swap size and every routable replica is warm, so
+        clients see no downtime and no compile stalls. Returns the number
+        of replicas swapped."""
+        self.handler_factory = new_handler_factory
+        old_slots = self.live_slots()
+        for slot in old_slots:
+            self._spawn(len(self._procs))
+            self.retire(slot)
+        return len(old_slots)
 
     def stop(self) -> None:
         """Graceful first: SIGTERM puts every worker through its drain-
@@ -1389,6 +1587,8 @@ class ServingFleet:
                 p.kill()
                 p.join(timeout=10)
         self._procs = []
+        self._url_of = {}
+        self._retired = set()
         self.urls = []
         if self.rendezvous is not None:
             self.rendezvous.stop()

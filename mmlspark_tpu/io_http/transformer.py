@@ -19,11 +19,13 @@ from ..core.params import HasInputCol, HasOutputCol, Param
 from ..core.pipeline import Transformer
 from ..core.schema import Table
 from ..core.serialize import register_stage
-from .clients import HTTPClient
+from ..utils.async_utils import buffered_map
+from .clients import HTTPClient, TargetPool
 from .schema import HTTPRequestData, HTTPResponseData
 
 __all__ = [
     "HTTPTransformer",
+    "DistributedHTTPTransformer",
     "SimpleHTTPTransformer",
     "JSONInputParser",
     "JSONOutputParser",
@@ -63,6 +65,67 @@ class HTTPTransformer(HasInputCol, HasOutputCol, Transformer):
                 breaker=self.breaker,
             )
             resps = client.send_all(list(reqs))
+        return table.with_column(self.get("output_col"), resps)
+
+
+@register_stage
+class DistributedHTTPTransformer(HasInputCol, HasOutputCol, Transformer):
+    """Request column -> response column spread over a REPLICA SET — the
+    client-side load-balancer role of the reference's distributed serving
+    mode (per-executor servers behind a balancer, SURVEY.md §3.4).
+
+    Routing goes through io_http.clients.TargetPool — the same primitive
+    ServingGateway uses — so every row gets per-replica circuit breakers
+    and one automatic failover to a different replica on a connection
+    failure. `routing_key_col` switches to consistent-hash routing on
+    that column's values (session affinity for stateful handlers)."""
+
+    input_col = Param("request", "HTTPRequestData column", ptype=str)
+    output_col = Param("response", "HTTPResponseData column", ptype=str)
+    urls = Param(None, "replica base URLs to spread over",
+                 ptype=(list, tuple), required=True)
+    strategy = Param("round_robin",
+                     "'round_robin' or 'least_loaded' replica pick",
+                     ptype=str)
+    routing_key_col = Param(None, "column whose values consistent-hash "
+                            "each row to a replica", ptype=str)
+    concurrency = Param(1, "in-flight requests per call", ptype=int)
+    timeout = Param(60.0, "per-request timeout (s)", ptype=float)
+
+    handler: Callable | None = None  # test hook: req -> HTTPResponseData
+    retry_policy = None              # runtime wiring, not serialized
+    _pool: "TargetPool | None" = None
+
+    @property
+    def pool(self) -> TargetPool:
+        """Pool (and its breakers) persists across transform calls, so
+        replica health learned in one batch guards the next."""
+        if self._pool is None:
+            self._pool = TargetPool(list(self.get("urls")))
+        return self._pool
+
+    def _transform(self, table: Table) -> Table:
+        reqs = list(table[self.get("input_col")])
+        if self.handler is not None:
+            resps = [self.handler(r) for r in reqs]
+            return table.with_column(self.get("output_col"), resps)
+        key_col = self.get("routing_key_col")
+        keys = ([str(k) for k in table[key_col]] if key_col
+                else [None] * len(reqs))
+        pool = self.pool
+
+        def send(pair):
+            req, key = pair
+            return pool.send(
+                req, timeout=self.get("timeout"), policy=self.retry_policy,
+                strategy=("hash" if key is not None
+                          else self.get("strategy")), key=key)
+
+        pairs = list(zip(reqs, keys))
+        if self.get("concurrency") <= 1:
+            resps = [send(p) for p in pairs]
+        else:
+            resps = list(buffered_map(send, pairs, self.get("concurrency")))
         return table.with_column(self.get("output_col"), resps)
 
 

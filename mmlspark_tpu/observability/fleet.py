@@ -245,6 +245,19 @@ GAUGE_MERGE_POLICIES: dict[str, str] = {
     "mmlspark_tpu_fleet_replicas_up_count": "last",
     "mmlspark_tpu_fleet_replicas_down_count": "last",
     "mmlspark_tpu_fleet_scrape_age_seconds": "max",
+    # gateway/autoscaler run ON THE DRIVER: their gauges describe the one
+    # routing/control plane, never a per-replica share — "last" wins over
+    # the _count suffix default (sum) which would multiply them by the
+    # number of scrape sources
+    "mmlspark_tpu_gateway_replicas_live_count": "last",
+    # fraction of known replicas in rotation: the WORST view across
+    # scrape sources is the actionable health signal
+    "mmlspark_tpu_gateway_live_replicas_ratio": "min",
+    # total in-flight across gateways genuinely sums, but rule 5 wants
+    # the intent written down, not inherited from the _depth default
+    "mmlspark_tpu_gateway_inflight_depth": "sum",
+    "mmlspark_tpu_autoscaler_target_replicas_count": "last",
+    "mmlspark_tpu_autoscaler_calm_ticks_count": "last",
 }
 
 _SUFFIX_POLICIES: tuple[tuple[str, str], ...] = (

@@ -1,0 +1,25 @@
+#' DistributedHTTPTransformer (Transformer)
+#'
+#' Request column -> response column spread over a REPLICA SET — the client-side load-balancer role of the reference's distributed serving mode (per-executor servers behind a balancer, SURVEY.md §3.4).
+#'
+#' @param x a data.frame or tpu_table
+#' @param output_col HTTPResponseData column
+#' @param input_col HTTPRequestData column
+#' @param urls replica base URLs to spread over
+#' @param strategy 'round_robin' or 'least_loaded' replica pick
+#' @param routing_key_col column whose values consistent-hash each row to a replica
+#' @param concurrency in-flight requests per call
+#' @param timeout per-request timeout (s)
+#' @export
+ml_distributed_http_transformer <- function(x, output_col = "response", input_col = "request", urls, strategy = "round_robin", routing_key_col = NULL, concurrency = 1L, timeout = 60.0)
+{
+  params <- list()
+  if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  if (!is.null(input_col)) params$input_col <- as.character(input_col)
+  if (!is.null(urls)) params$urls <- as.list(urls)
+  if (!is.null(strategy)) params$strategy <- as.character(strategy)
+  if (!is.null(routing_key_col)) params$routing_key_col <- as.character(routing_key_col)
+  if (!is.null(concurrency)) params$concurrency <- as.integer(concurrency)
+  if (!is.null(timeout)) params$timeout <- as.double(timeout)
+  .tpu_apply_stage("mmlspark_tpu.io_http.transformer.DistributedHTTPTransformer", params, x, is_estimator = FALSE)
+}

@@ -658,6 +658,7 @@ def _io_http_objects(ctx) -> dict[str, list[TestObject]]:
         CustomInputParser,
         CustomOutputParser,
         DescribeImage,
+        DistributedHTTPTransformer,
         RecognizeDomainSpecificContent,
         DetectFace,
         EntityDetector,
@@ -726,6 +727,20 @@ def _io_http_objects(ctx) -> dict[str, list[TestObject]]:
             HTTPTransformer(concurrency=2), transform_table=requests_tbl,
             skip_output_compare="response objects carry per-call latency headers",
         )],
+        "mmlspark_tpu.io_http.transformer.DistributedHTTPTransformer": [
+            TestObject(
+                DistributedHTTPTransformer(urls=[url], concurrency=2),
+                transform_table=requests_tbl,
+                skip_output_compare="response objects carry per-call "
+                                    "latency headers",
+            ),
+            TestObject(
+                DistributedHTTPTransformer(urls=[url], routing_key_col="key"),
+                transform_table=requests_tbl.with_column("key", ["a", "b"]),
+                skip_output_compare="response objects carry per-call "
+                                    "latency headers",
+            ),
+        ],
         "mmlspark_tpu.io_http.transformer.SimpleHTTPTransformer": [TestObject(
             SimpleHTTPTransformer(url=url, flatten_output_field="echo.q",
                                   output_col="answer", concurrency=2),

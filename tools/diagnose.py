@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """One-shot fleet diagnosis: scrape, aggregate, and print a snapshot table.
 
-Three entry modes:
+Four entry modes:
 
   python tools/diagnose.py --rendezvous http://HOST:PORT
       Ask a running FleetRendezvous for /healthz + /metrics and print the
@@ -10,6 +10,12 @@ Three entry modes:
   python tools/diagnose.py --urls http://H1:P1/metrics http://H2:P2/metrics
       No rendezvous: scrape the replica /metrics endpoints directly
       through a local MetricsAggregator and print the same table.
+
+  python tools/diagnose.py --gateway http://HOST:PORT
+      Ask a running ServingGateway for /routes (+ /autoscaler when one is
+      attached) and print the routing table — which replicas are live,
+      which are ejected and why, in-flight depth and breaker state per
+      replica — plus the autoscaler's control-loop state.
 
   python tools/diagnose.py --selftest
       Spin up a real 2-replica ServingFleet in-process, push traffic
@@ -166,6 +172,59 @@ def diagnose_urls(urls: list[str]) -> str:
     return diagnose_text(agg.render())
 
 
+def diagnose_gateway(url: str) -> str:
+    """Routing table + autoscaler state from a running ServingGateway."""
+    url = url.rstrip("/")
+    routes = json.loads(_fetch(url + "/routes"))
+    out = [
+        f"gateway: strategy={routes['strategy']} "
+        f"hedge={'on' if routes['hedge'] else 'off'} "
+        f"key_header={routes['routing_key_header']} "
+        f"live={routes['n_live']}/{routes['n_targets']}"
+    ]
+    rows = []
+    for target, st in sorted(routes.get("targets", {}).items()):
+        rows.append([
+            target,
+            "y" if st.get("live") else "n",
+            st.get("breaker", "?"),
+            _fmt(st.get("inflight", 0)),
+            (st.get("eject_reason") or "-") if st.get("ejected") else "-",
+        ])
+    if rows:
+        out.append(_render_table(
+            rows, ["replica", "live", "breaker", "inflight", "ejected"]))
+    else:
+        out.append("(no targets)")
+
+    try:
+        scaler = json.loads(_fetch(url + "/autoscaler"))
+    except urllib.error.HTTPError:  # 404 = no autoscaler attached
+        scaler = None
+    except Exception:  # noqa: BLE001 — autoscaler view is optional
+        scaler = None
+    if scaler is not None:
+        out.append("")
+        out.append(
+            f"autoscaler: n_live={scaler['n_live']} "
+            f"range={scaler['min_replicas']}..{scaler['max_replicas']} "
+            f"calm={scaler['calm_ticks']}/{scaler['hysteresis_ticks']} "
+            f"cooldown_left={_fmt(scaler['cooldown_remaining_s'], 1)}s "
+            f"last={scaler['last_action']}")
+        if scaler.get("pressure"):
+            out.append(f"pressure: {', '.join(scaler['pressure'])}")
+        sig = scaler.get("signals") or {}
+        if sig:
+            out.append("signals: " + " ".join(
+                f"{k}={_fmt(float(v), 3)}" for k, v in sorted(sig.items())
+                if isinstance(v, (int, float))))
+        for ev in scaler.get("events", []):
+            out.append(
+                f"  event t={_fmt(ev['t'], 1)} {ev['action']} "
+                f"({ev['detail']}) n_live={ev['n_live']}")
+    return "\n".join(out)
+
+
 # -- selftest ----------------------------------------------------------- #
 
 def _selftest_handler(table):
@@ -218,6 +277,7 @@ def main(argv: "list[str] | None" = None) -> int:
     g = ap.add_mutually_exclusive_group(required=True)
     g.add_argument("--rendezvous", help="FleetRendezvous base URL")
     g.add_argument("--urls", nargs="+", help="replica /metrics URLs")
+    g.add_argument("--gateway", help="ServingGateway base URL")
     g.add_argument("--selftest", action="store_true",
                    help="run a 2-replica fleet and diagnose it")
     args = ap.parse_args(argv)
@@ -225,6 +285,8 @@ def main(argv: "list[str] | None" = None) -> int:
         return selftest()
     if args.rendezvous:
         print(diagnose_rendezvous(args.rendezvous))
+    elif args.gateway:
+        print(diagnose_gateway(args.gateway))
     else:
         print(diagnose_urls(args.urls))
     return 0

@@ -26,6 +26,12 @@ the static skeleton), and enforces:
      remaining → min), so the author must state which one — the suffix
      default silently picking max is exactly the aggregation bug this
      lint exists to stop.
+  5. ``gateway_*`` / ``autoscaler_*`` gauges need an EXPLICIT entry too:
+     those series come from the DRIVER-SIDE control plane (one routing
+     gateway, one autoscaler), not from replicas, so per-replica suffix
+     defaults (``_count`` → sum) would multiply them by the number of
+     scrape sources. Counters and ``_seconds`` histogram families are
+     exempt — both genuinely sum.
 
 Usage: python tools/metric_lint.py    # exit 1 with a report if any fail
 """
@@ -120,6 +126,18 @@ def lint_file(path: str) -> list[str]:
                         "suffix-default merge policy — declare max/min "
                         "intent explicitly in observability.fleet."
                         "GAUGE_MERGE_POLICIES")
+                    continue
+                if (name.startswith(("mmlspark_tpu_gateway_",
+                                     "mmlspark_tpu_autoscaler_"))
+                        and not name.endswith("_total")
+                        and not base.endswith("_seconds")
+                        and _explicit_policy(name) is None):
+                    problems.append(
+                        f"{where}: control-plane gauge {name!r} relies "
+                        "on a per-replica suffix default — gateway/"
+                        "autoscaler series are driver singletons; add "
+                        "an explicit observability.fleet."
+                        "GAUGE_MERGE_POLICIES entry")
     return problems
 
 
