@@ -39,6 +39,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 import traceback
 
@@ -1435,6 +1436,136 @@ def bench_fleet_gateway() -> dict:
     }
 
 
+def bench_serving_hot_path() -> dict:
+    """Device-resident hot path vs today's handler path, PAIRED: the same
+    model served twice (`hot_path=False` is exactly the pre-hot-path
+    serve_model), driven at client concurrency 1/32/256 so the continuous
+    batcher actually coalesces at the upper sizes. Reports server p50/p99
+    and client-RTT medians per concurrency plus which route the measured
+    crossover picked — at batch 1 the auto-pick is allowed to choose the
+    native walk (that IS the policy working); at 32/256 the resident
+    executor must pull ahead on device-backed runs."""
+    import http.client
+
+    from mmlspark_tpu.core.schema import Table
+    from mmlspark_tpu.gbdt.estimators import GBDTRegressor
+    from mmlspark_tpu.io_http.schema import HTTPRequestData
+    from mmlspark_tpu.io_http.serving import serve_model
+
+    x, y = make_dataset(2048, 8, seed=11)
+    # f32-representable features: live batches stay resident-eligible
+    x = x.astype(np.float32).astype(np.float64)
+    model = GBDTRegressor(num_iterations=10, num_leaves=15).fit(
+        Table({"features": x, "label": y.astype(np.float64)}))
+    cols = [f"f{j}" for j in range(8)]
+    warm = HTTPRequestData.from_json(
+        "/", {c: float(x[0, j]) for j, c in enumerate(cols)})
+    bodies = [json.dumps({c: float(x[i, j]) for j, c in enumerate(cols)}
+                         ).encode() for i in range(64)]
+
+    def wait_ready(srv, timeout_s=180.0):
+        deadline = time.monotonic() + timeout_s
+        while not srv.ready:
+            if time.monotonic() > deadline:
+                raise TimeoutError("serving server never became ready")
+            time.sleep(0.02)
+
+    def drive(srv, n_clients, per_client):
+        """n_clients keep-alive connections posting concurrently; returns
+        every client-side RTT in seconds."""
+        rtt, errors = [], []
+        # all connections established BEFORE anyone posts: the measured
+        # window is scoring under concurrency, not a TCP connect storm
+        barrier = threading.Barrier(n_clients)
+
+        def client(k):
+            conn = http.client.HTTPConnection(srv.host, srv.port,
+                                              timeout=60)
+            try:
+                conn.connect()
+                barrier.wait()
+                for i in range(per_client):
+                    body = bodies[(k * per_client + i) % len(bodies)]
+                    t0 = time.perf_counter()
+                    for attempt in (0, 1):
+                        try:
+                            conn.request("POST", srv.api_path, body=body,
+                                         headers={"Content-Type":
+                                                  "application/json"})
+                            r = conn.getresponse()
+                            r.read()
+                            break
+                        except (OSError, http.client.HTTPException):
+                            # the server's idle keep-alive window can drop
+                            # a parked connection under high concurrency;
+                            # a reconnect (timed) is the honest client cost
+                            conn.close()
+                            conn = http.client.HTTPConnection(
+                                srv.host, srv.port, timeout=60)
+                            if attempt:
+                                raise
+                    if r.status != 200:
+                        errors.append(r.status)
+                    rtt.append(time.perf_counter() - t0)
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(repr(e))
+            finally:
+                conn.close()
+
+        threads = [threading.Thread(target=client, args=(k,))
+                   for k in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise RuntimeError(f"hot-path bench clients failed: "
+                               f"{errors[:3]} (+{max(len(errors)-3, 0)})")
+        return rtt
+
+    servers = {
+        "handler": serve_model(model, cols, hot_path=False,
+                               max_batch_size=256, warmup_request=warm),
+        "hot": serve_model(model, cols, max_batch_size=256,
+                           warmup_request=warm),
+    }
+    per_concurrency = {}
+    try:
+        for srv in servers.values():
+            wait_ready(srv)
+        hp = servers["hot"].hot_path
+        if hp is None or hp.disabled is not None:
+            raise RuntimeError(
+                "hot path unavailable: "
+                + (hp.disabled if hp else "no resident executor"))
+        for n_clients in (1, 32, 256):
+            per_client = max(2, 512 // n_clients) if n_clients > 1 else 200
+            row = {}
+            for name, srv in servers.items():
+                drive(srv, min(n_clients, 8), 3)   # warm the connections
+                srv.reset_latency_stats()
+                before = (dict(hp.path_requests) if name == "hot" else None)
+                rtt_ms = np.asarray(
+                    drive(srv, n_clients, per_client)) * 1e3
+                stats = srv.latency_stats()
+                row[f"{name}_p50_ms"] = stats["p50_ms"]
+                row[f"{name}_p99_ms"] = stats["p99_ms"]
+                row[f"{name}_rtt_p50_ms"] = float(np.percentile(rtt_ms, 50))
+                row[f"{name}_rtt_p99_ms"] = float(np.percentile(rtt_ms, 99))
+                if before is not None:
+                    delta = {p: hp.path_requests[p] - before.get(p, 0)
+                             for p in hp.path_requests}
+                    row["hot_route"] = max(delta, key=delta.get)
+            row["hot_vs_handler_rtt_p50"] = (
+                row["handler_rtt_p50_ms"] / max(row["hot_rtt_p50_ms"], 1e-9))
+            per_concurrency[n_clients] = row
+    finally:
+        for srv in servers.values():
+            srv.stop()
+    return {"per_concurrency": per_concurrency,
+            "crossover": servers["hot"].hot_path.snapshot()["crossover"]}
+
+
 def _write_metrics_snapshot() -> None:
     """Dump the process-default registry next to the bench output so the
     run's counters (executable-cache hits, serving counts, streaming rows)
@@ -1646,6 +1777,12 @@ def _run_suite(platform: str) -> dict:
     except Exception as e:  # noqa: BLE001 — gateway row is auxiliary
         print(f"bench: fleet gateway bench failed ({e!r})", file=sys.stderr)
         fleet_gateway = None
+    try:
+        hot_serving = bench_serving_hot_path()
+    except Exception as e:  # noqa: BLE001 — hot-path row is auxiliary
+        print(f"bench: serving hot path bench failed ({e!r})",
+              file=sys.stderr)
+        hot_serving = None
     _write_metrics_snapshot()
 
     resident = runner.get("resident_images_per_sec", 0.0)
@@ -1762,6 +1899,13 @@ def _run_suite(platform: str) -> dict:
                 if fleet_gateway else None,
             "fleet_gateway_kill_requests": (
                 fleet_gateway["kill_requests"] if fleet_gateway else None),
+            "serving_hot_path": ({
+                str(b): {k: (round(v, 3) if isinstance(v, float) else v)
+                         for k, v in row.items()}
+                for b, row in hot_serving["per_concurrency"].items()}
+                if hot_serving else None),
+            "serving_hot_path_crossover": (
+                hot_serving["crossover"] if hot_serving else None),
             "headroom_note": (
                 "gbdt fit is HBM-bound (see gbdt_modeled_hbm_* vs chip peak); "
                 "end-to-end runner throughput is host->device transfer bound: "

@@ -210,6 +210,12 @@ class AsyncReadback:
         self.lag = max(int(lag), 0)
         self._pending: list[Any] = []
 
+    @property
+    def pending(self) -> int:
+        """Batches dispatched but not yet fetched — the serving hot path
+        publishes this as its readback-lag gauge."""
+        return len(self._pending)
+
     def push(self, outs: Any) -> list[Any]:
         self._pending.append(outs)
         ready = []

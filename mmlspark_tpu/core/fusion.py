@@ -85,6 +85,7 @@ __all__ = [
     "StagePlan",
     "SegmentPlan",
     "FusionPlan",
+    "ResidentExecutor",
     "kernel_of",
     "plan_fusion",
     "fuse",
@@ -278,6 +279,7 @@ class _FusedSegment:
         self._last_producer = produced
         self._exec_cache = ExecutableCache()
         self._jitted = None
+        self._composed = None
         self._device_params = None
         self._bodies = None
         self._param_placements: "tuple[str, ...] | None" = None
@@ -338,6 +340,7 @@ class _FusedSegment:
 
             # no in/out_shardings: the committed placement of the uploaded
             # params and row-sharded chunks drives GSPMD partitioning
+            self._composed = composed
             self._jitted = jax.jit(composed)
         return self._jitted, self._device_params
 
@@ -389,7 +392,7 @@ class _FusedSegment:
 
     def run(self, table: Table, *, mini_batch_size: int, prefetch_depth: int,
             shape_buckets: bool, tracer: Any, fused_label: str = "pipeline",
-            ) -> tuple[Table, dict]:
+            readback_lag: int = 1) -> tuple[Table, dict]:
         n = table.num_rows
         jitted, params = self._build()
         bs = max(int(mini_batch_size), 1)
@@ -465,7 +468,7 @@ class _FusedSegment:
         prefetch = Prefetcher(range(0, n, bs), prepare,
                               depth=max(int(prefetch_depth), 0),
                               name=f"fused-seg{self.index}")
-        readback = AsyncReadback(fetch, lag=1)
+        readback = AsyncReadback(fetch, lag=max(int(readback_lag), 0))
         chunks: list[tuple[np.ndarray, ...]] = []
         with tracer.start_span("pipeline.fused_segment", segment=self.index,
                                stages=",".join(self.stage_names), rows=n,
@@ -529,6 +532,193 @@ def _fetch_sharded(arr: Any, m: int, shard_seconds: dict) -> np.ndarray:
 
 
 # --------------------------------------------------------------------- #
+# ResidentExecutor — the persistent serving session over one segment     #
+# --------------------------------------------------------------------- #
+
+
+class ResidentExecutor:
+    """A long-lived serving session over a single fused segment.
+
+    `_FusedSegment.run` is built for batch work: every call re-creates a
+    Prefetcher, an AsyncReadback, a span, and the family key, and blocks
+    until all chunks are back on host.  A serving hot path needs the
+    opposite shape: params pinned on device ONCE at startup, then per
+    request batch exactly one upload (`dispatch`) and one deferred
+    read-back (`fetch`), so the caller can overlap reply serialization of
+    batch N with device compute on batch N+1 (io_http/serving.py drives
+    this through `AsyncReadback`).  Split dispatch/fetch is what makes
+    that overlap possible — `run()` could never hand back an in-flight
+    batch.
+
+    Values are byte-identical to the staged path: the same jitted
+    composition, the same pinned params, the same `out_dtypes` cast on
+    read-back.  `round_trips` counts upload+readback pairs — one per
+    dispatched batch, so a batched request costs at most one host
+    round-trip (the ROADMAP serving bar)."""
+
+    def __init__(self, segment: "_FusedSegment"):
+        self.segment = segment
+        self.upload_cols = segment.upload_cols
+        self.download_cols = segment.download_cols
+        self._jitted, self._params = segment._build()
+        self._in_shardings: "dict | None" = None
+        if segment.mesh is not None:
+            # placements are fixed per ndim; resolved lazily at first
+            # dispatch (the feature rank is unknown until then)
+            self._in_shardings = {}
+        self._family_cache: dict[tuple, Any] = {}
+        self.dispatches = 0
+        self.round_trips = 0
+
+    @property
+    def data_axis_size(self) -> int:
+        """Every dispatched batch's row count must divide by this (the
+        mesh data-axis size; 1 single-device). Serving builds its bucket
+        ladder with `multiple_of=` this so padded rungs stay shardable."""
+        if self.segment.mesh is None:
+            return 1
+        from ..parallel.mesh import DATA_AXIS
+
+        return int(self.segment.mesh.shape[DATA_AXIS])
+
+    # -- host-side preconditions ---------------------------------------- #
+
+    def check_ready(self, table: Table) -> str:
+        """'' when this table can run resident, else the blocking reason
+        (same contract as `_FusedSegment.check_ready`)."""
+        return self.segment.check_ready(table)
+
+    # -- per-batch execution -------------------------------------------- #
+
+    def _signature(self, ins: dict) -> tuple:
+        return tuple((c, str(ins[c].dtype), ins[c].shape[1:])
+                     for c in self.upload_cols)
+
+    def _family_for(self, ins: dict) -> Any:
+        sig = self._signature(ins)
+        fam = self._family_cache.get(sig)
+        if fam is None:
+            fam = self.segment._family_key(ins)
+            self._family_cache[sig] = fam
+        return fam
+
+    def _shardings_for(self, ins: dict) -> "dict | None":
+        if self.segment.mesh is None:
+            return None
+        from ..parallel.mesh import data_sharding
+
+        out = {}
+        for c in self.upload_cols:
+            nd = ins[c].ndim
+            s = self._in_shardings.get((c, nd))
+            if s is None:
+                s = data_sharding(self.segment.mesh, *([None] * (nd - 1)))
+                self._in_shardings[(c, nd)] = s
+            out[c] = s
+        return out
+
+    def dispatch(self, cols: dict) -> tuple:
+        """Upload one padded batch and launch the resident executable.
+        Returns the still-in-flight device outputs (async dispatch): the
+        caller is free to assemble the next batch before `fetch`ing."""
+        ins = {c: np.asarray(cols[c]) for c in self.upload_cols}
+        rows = next(iter(ins.values())).shape[0] if ins else 0
+        family = self._family_for(ins)
+        shape_key = (rows, self._signature(ins))
+        fn = self.segment._exec_cache.get_or_build(
+            family, shape_key, lambda: self._jitted)
+        dt = DeviceTable.from_host(ins, shardings=self._shardings_for(ins))
+        outs = fn(self._params, tuple(dt[c] for c in self.upload_cols))
+        self.dispatches += 1
+        self.round_trips += 1
+        return outs
+
+    def fetch(self, outs: tuple, n_valid: int) -> dict:
+        """Block on the device results, slice padding off, and apply the
+        staged path's host dtype casts — the columns a `transform` of the
+        same batch would have produced, bit for bit."""
+        result: dict[str, np.ndarray] = {}
+        for j, c in enumerate(self.download_cols):
+            arr = np.asarray(outs[j])[:n_valid]
+            kern = self.segment._last_producer[c]
+            want = kern.out_dtypes.get(c)
+            if want is not None and arr.dtype != np.dtype(want):
+                arr = arr.astype(want)
+            result[c] = arr
+        return result
+
+    # -- warmup / AOT ---------------------------------------------------- #
+
+    def warm(self, cols: dict, ladder: Sequence[int],
+             prefetch_depth: int = 1, readback_lag: int = 1) -> int:
+        """Compile and execute the resident program once per ladder rung so
+        live traffic never pays a compile.  `cols` is a sample batch (>= 1
+        row) of the upload columns; each rung's input is built by repeating
+        its last row — the same padding live batches use, so the compiled
+        shape set exactly covers what serving can mint.  Rung assembly
+        overlaps the previous rung's device execution (`Prefetcher`) and
+        read-backs trail by `readback_lag` (`AsyncReadback`), mirroring the
+        hot loop's steady-state schedule.  Returns rungs executed."""
+        ins = {c: np.asarray(cols[c]) for c in self.upload_cols}
+
+        def prepare(rung: int):
+            padded = {}
+            for c, arr in ins.items():
+                if rung > len(arr):
+                    arr = np.concatenate(
+                        [arr, np.repeat(arr[-1:], rung - len(arr), axis=0)])
+                padded[c] = arr[:rung]
+            return rung, padded
+
+        prefetch = Prefetcher(list(ladder), prepare,
+                              depth=max(int(prefetch_depth), 0),
+                              name="resident-warm")
+        readback = AsyncReadback(lambda item: self.fetch(item[0], item[1]),
+                                 lag=max(int(readback_lag), 0))
+        n = 0
+        for rung, padded in prefetch:
+            outs = self.dispatch(padded)
+            readback.push((outs, rung))
+            n += 1
+        readback.drain()
+        return n
+
+    def aot_args(self, cols: dict, n_rows: int) -> tuple:
+        """(fn, args) for `tools/aot_gate.py`: the resident executable plus
+        abstract inputs at a ladder rung of `n_rows` (params stay concrete
+        — they are already pinned on device).  `cols` is a >=1-row host
+        sample fixing feature rank and dtype; dtypes canonicalize exactly
+        as `DeviceTable.from_host` would (float64 -> float32 under jax's
+        x64 default), so the lowered program is the one serving runs."""
+        import jax
+        import jax.numpy as jnp
+
+        ins = {c: np.asarray(cols[c]) for c in self.upload_cols}
+        abstract = tuple(
+            jax.ShapeDtypeStruct((n_rows,) + ins[c].shape[1:],
+                                 jnp.asarray(ins[c][:1]).dtype)
+            for c in self.upload_cols)
+        if self.segment.mesh is None:
+            return self._jitted, (self._params, abstract)
+        from ..parallel.mesh import data_sharding, replicated_sharding
+
+        mesh = self.segment.mesh
+        # replicated prefix for the params tree matches the default (and
+        # the GBDT mesh_fn's explicit) placement; rows shard over data
+        jfn = jax.jit(self.segment._composed, in_shardings=(
+            replicated_sharding(mesh),
+            tuple(data_sharding(mesh, *([None] * (ins[c].ndim - 1)))
+                  for c in self.upload_cols)))
+        return jfn, (self._params, abstract)
+
+    def stats(self) -> dict:
+        """Executable-cache counters + session round-trip accounting."""
+        out = self.segment._exec_cache.stats()
+        out.update(dispatches=self.dispatches, round_trips=self.round_trips)
+        return out
+
+
+# --------------------------------------------------------------------- #
 # FusedPipelineModel                                                    #
 # --------------------------------------------------------------------- #
 
@@ -551,6 +741,10 @@ class FusedPipelineModel(PipelineModel):
               "compiled-shape set stays closed", ptype=bool)
     fused_label = Param(
         "pipeline", "label for the fusion-ratio gauge", ptype=str)
+    readback_lag = Param(
+        1, "device batches kept in flight before device->host readback is "
+           "forced (0 = fetch synchronously after every dispatch); also the "
+           "lag of the serving hot path's overlapped reply fetch", ptype=int)
     use_mesh = Param(
         False, "compile fused segments under the process mesh "
                "(parallel.mesh.get_mesh()) when no explicit mesh was set "
@@ -570,6 +764,19 @@ class FusedPipelineModel(PipelineModel):
     def plan(self) -> FusionPlan:
         self._ensure_segments()
         return self._plan
+
+    def resident_executor(self) -> "ResidentExecutor | str":
+        """A persistent serving session over this model, or the reason one
+        cannot exist.  Requires the whole plan to be ONE fused segment —
+        any host stage in the chain forces a host materialization between
+        device programs, so there is no single resident executable to pin
+        (`serve_model` falls back to the per-request handler path then)."""
+        segments = self._ensure_segments()
+        if len(segments) != 1 or not isinstance(segments[0], _FusedSegment):
+            fused = sum(1 for s in segments if isinstance(s, _FusedSegment))
+            return (f"plan is {len(segments)} segments ({fused} fused) — a "
+                    "resident session needs exactly one fused segment")
+        return ResidentExecutor(segments[0])
 
     def set_mesh(self, mesh: Any) -> "FusedPipelineModel":
         """Attach (or with None, detach) the mesh fused segments compile
@@ -643,7 +850,8 @@ class FusedPipelineModel(PipelineModel):
                         prefetch_depth=self.get("prefetch_depth"),
                         shape_buckets=self.get("shape_buckets"),
                         tracer=tracer,
-                        fused_label=self.get("fused_label"))
+                        fused_label=self.get("fused_label"),
+                        readback_lag=self.get("readback_lag"))
                     stats["uploads"] += seg_stats["uploads"]
                     stats["downloads"] += seg_stats["downloads"]
             else:

@@ -406,6 +406,26 @@ class GBDTRegressionModel(_BoosterModelMixin, HasFeaturesCol, HasPredictionCol, 
             mesh_fn=mesh_fn,
             mesh_desc="rows P(data); binning table + tree SoAs replicated")
 
+    def native_score_fn(self):
+        """Host-side scorer for the serving hot path's auto-pick route
+        (io_http/serving.py): `fn(x) -> float64 predictions`, forced onto
+        the native C++ tree walk — no device dispatch, zero host<->device
+        round-trips.  Bit-identical to `_transform`'s column: the host walk
+        replays the jitted traversal's float32 accumulation order
+        (booster.py HOST_PREDICT_MAX_ROWS), and regression objectives'
+        `transform_score` is the identity.  Returns a reason string when no
+        host route exists."""
+        b = self.booster
+        if b is None:
+            return "no fitted booster"
+
+        def fn(x: np.ndarray) -> np.ndarray:
+            if getattr(x, "ndim", 2) == 1:
+                x = x[:, None]
+            return np.asarray(b.predict(x, device="host"), np.float64)
+
+        return fn
+
     @staticmethod
     def load_native_model(path: str, **cols) -> "GBDTRegressionModel":
         booster = Booster.load_native_model(path)

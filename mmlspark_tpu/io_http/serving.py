@@ -34,9 +34,10 @@ from typing import Any, Callable
 
 import numpy as np
 
-from ..core.dataplane import ShapeBucketer, cache_stats
+from ..core.dataplane import AsyncReadback, ShapeBucketer, cache_stats
 from ..core.schema import Table
-from .schema import HTTPRequestData, HTTPResponseData, make_reply, parse_request
+from .schema import (HTTPRequestData, HTTPResponseData, RequestDecoder,
+                     make_reply, parse_request)
 
 __all__ = ["ServingServer", "ServingFleet", "MicroBatchQuery", "serve_model",
            "ServiceInfo", "FleetRendezvous"]
@@ -85,6 +86,183 @@ class SingleSegmentHandler(BaseHTTPRequestHandler):
     disable_nagle_algorithm = True
 
 
+class _DeepBacklogServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer with a serving-grade accept backlog.
+
+    socketserver's default listen backlog is 5: a burst of concurrent
+    clients connecting at once (exactly the load continuous batching is
+    built to coalesce) overflows it and the overflow gets TCP RSTs
+    before the server ever sees the requests. The batcher's admission
+    control (max_pending -> 503 + Retry-After) is the intended overload
+    answer — it can only run on connections that got accepted."""
+
+    request_queue_size = 128
+
+
+class _HotPath:
+    """serve_model's device-resident fast lane.
+
+    Holds the long-lived scoring session the batcher can route through
+    instead of the per-request handler: a `core.fusion.ResidentExecutor`
+    with the fused segment's params (and GBDT SoAs) pinned on device once
+    at startup, a `RequestDecoder` that turns a request batch into ONE
+    preallocated feature matrix, and — when the model exposes one — the
+    native C++ tree-walk scorer, the small-batch champion. `route_for`
+    picks the route per bucket rung from the crossover measured during
+    warmup; a rung warmup never measured stays on the handler path —
+    the fast routes are only ever taken where they were verified and
+    their executables pre-compiled (no warmup_request = no fast lane).
+
+    Every route must be byte-identical to the handler path. Warmup
+    enforces that literally: each rung's resident (and native) reply
+    BYTES are compared against the handler's replies for the same batch,
+    and the first divergence disables the fast lane — correctness
+    degrades to the handler path, never to different answers."""
+
+    # timing repetitions per rung when measuring the crossover
+    WARM_REPS = 3
+
+    def __init__(self, executor, decoder: RequestDecoder, feature_col: str,
+                 output_col: str, native_fn=None, readback_lag: int = 1):
+        self.executor = executor
+        self.decoder = decoder
+        self.feature_col = feature_col
+        self.output_col = output_col
+        self.native_fn = native_fn
+        self.readback_lag = max(int(readback_lag), 0)
+        # bucket rung -> "native" | "resident", learned by warm_rung
+        self.crossover: dict[int, str] = {}
+        self.timings_ms: dict[int, dict[str, float]] = {}
+        self.disabled: "str | None" = None
+        # test hook: pin every batch to one route ("resident"/"native"/
+        # "host") regardless of the crossover
+        self.force_path: "str | None" = None
+        self.path_requests = {"resident": 0, "native": 0, "host": 0}
+        self.resident_batches = 0
+
+    def route_for(self, bucket: int) -> str:
+        if self.disabled is not None:
+            return "host"
+        if self.force_path is not None:
+            return self.force_path
+        # only rungs warmup measured (and byte-verified) route fast: an
+        # unknown rung on the resident path would pay a LIVE compile and
+        # score through a route whose replies were never checked
+        return self.crossover.get(bucket, "host")
+
+    def replies_for(self, vals: np.ndarray) -> "list[HTTPResponseData]":
+        """Score column -> replies, byte-for-byte what the handler path's
+        `make_reply` produces (tolist() -> Python float -> json.dumps)."""
+        col = self.output_col
+        return [HTTPResponseData(
+            status_code=200, reason="OK",
+            headers={"Content-Type": "application/json"},
+            entity=json.dumps({col: v}).encode(),
+        ) for v in np.asarray(vals).tolist()]
+
+    def native_values(self, feats: np.ndarray) -> np.ndarray:
+        return np.asarray(self.native_fn(feats), np.float64)
+
+    def resident_values(self, feats: np.ndarray, n_valid: int) -> np.ndarray:
+        outs = self.executor.dispatch({self.feature_col: feats})
+        return self.executor.fetch(outs, n_valid)[self.output_col]
+
+    def warm_rung(self, handler, request: HTTPRequestData, rung: int,
+                  expect_entities: list) -> None:
+        """Compile, verify, and time one ladder rung. The handler's
+        replies for the same batch are the oracle: the resident and
+        native routes must reproduce their entity bytes exactly. The
+        faster measured route wins the rung in `crossover`."""
+        if self.disabled is not None:
+            return
+        feats = self.decoder.decode([request] * rung)
+        if feats is None:
+            self.disabled = "warmup request outside the fast-path schema"
+            return
+        expect = list(expect_entities)
+        reason = self.executor.check_ready(Table({self.feature_col: feats}))
+        if reason:
+            # commonly: the warmup payload's floats are not f32-
+            # representable, so the resident route would decline the batch
+            # (live routing guards this per batch too). Warm and time the
+            # ladder on the nearest representable request instead, with
+            # the handler re-scored on it as the byte oracle.
+            vals = feats[0].astype(np.float32).astype(np.float64)
+            req32 = HTTPRequestData.from_json(
+                request.url or "/",
+                dict(zip(self.decoder.cols, vals.tolist())))
+            feats = self.decoder.decode([req32] * rung)
+            reason = (self.executor.check_ready(
+                Table({self.feature_col: feats}))
+                if feats is not None else "warmup schema")
+            if feats is None or reason:
+                self.disabled = f"resident precondition: {reason}"
+                return
+            expect = [r.entity
+                      for r in handler(Table({"request": [req32] * rung}))
+                      ["reply"]]
+        try:
+            vals = self.resident_values(feats, rung)  # first call compiles
+        except Exception as e:  # noqa: BLE001 — degrade, don't break serving
+            self.disabled = f"resident dispatch failed: {e}"
+            return
+        if [r.entity for r in self.replies_for(vals)] != expect:
+            self.disabled = f"resident replies diverge at rung {rung}"
+            return
+        t = {"resident": self._time(
+            lambda: self.resident_values(feats, rung))}
+        if self.native_fn is not None:
+            try:
+                nvals = self.native_values(feats)
+            except Exception:  # noqa: BLE001 — native scorer unusable
+                self.native_fn = None
+            else:
+                if [r.entity for r in self.replies_for(nvals)] != expect:
+                    # wrong answers never route; resident is already proven
+                    self.native_fn = None
+                else:
+                    t["native"] = self._time(
+                        lambda: self.native_values(feats))
+        self.timings_ms[rung] = {k: v * 1e3 for k, v in t.items()}
+        self.crossover[rung] = min(t, key=t.get)
+
+    @staticmethod
+    def _time(fn) -> float:
+        best = float("inf")
+        for _ in range(_HotPath.WARM_REPS):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    def note(self, path: str, n: int) -> None:
+        self.path_requests[path] = self.path_requests.get(path, 0) + n
+
+    def snapshot(self) -> dict:
+        """The info() `hot_path` block: routing table, measured per-rung
+        timings, and round-trip accounting — the ROADMAP's ≤1-host-round-
+        trip-per-request bar is `round_trips_per_resident_request` (each
+        resident BATCH costs exactly one upload+readback pair, shared by
+        every request coalesced into it)."""
+        res_req = self.path_requests.get("resident", 0)
+        return {
+            "enabled": self.disabled is None,
+            "disabled_reason": self.disabled,
+            "crossover": {str(b): p
+                          for b, p in sorted(self.crossover.items())},
+            "timings_ms": {str(b): {k: round(v, 4) for k, v in t.items()}
+                           for b, t in sorted(self.timings_ms.items())},
+            "readback_lag": self.readback_lag,
+            "paths": dict(self.path_requests),
+            "resident_batches": self.resident_batches,
+            "round_trips": self.executor.round_trips,
+            "round_trips_per_resident_request": (
+                self.resident_batches / res_req if res_req else 0.0),
+            "decoder": {"hits": self.decoder.hits,
+                        "fallbacks": self.decoder.fallbacks},
+        }
+
+
 class ServingServer:
     """HTTP frontend + batched scoring loop.
 
@@ -108,9 +286,11 @@ class ServingServer:
         request_deadline_s: float | None = None,
         drain_timeout_s: float = 5.0,
         bucket_batches: bool = False,
+        bucket_multiple_of: int = 1,
         metrics: Any = None,
         warmup_request: "HTTPRequestData | None" = None,
         tracer: Any = None,
+        hot_path: "_HotPath | None" = None,
     ):
         if mode not in ("continuous", "batch"):
             raise ValueError(f"mode must be 'continuous' or 'batch', got {mode!r}")
@@ -148,7 +328,13 @@ class ServingServer:
         # for pure scoring handlers (serve_model enables it) — a handler
         # with side effects per row (e.g. forwarding upstream) would see
         # duplicates.
-        self.bucketer = (ShapeBucketer(max_batch_size)
+        # Under a mesh the resident executor row-shards each dispatch over
+        # the data axis, so every ladder rung must divide by its size —
+        # serve_model passes bucket_multiple_of from the fused model's mesh
+        # (mirroring _FusedSegment.run's mini-batch ladder).
+        m = max(1, int(bucket_multiple_of))
+        bmax = -(-max_batch_size // m) * m
+        self.bucketer = (ShapeBucketer(bmax, multiple_of=m)
                          if bucket_batches and max_batch_size > 1 else None)
         self.api_path = api_path
         # "continuous": batcher thread drains the queue and replies directly
@@ -219,6 +405,22 @@ class ServingServer:
             "mmlspark_tpu_serving_bucket_batches_total",
             "scored batches per bucket-ladder rung",
             labels=("server", "bucket"))
+        # hot-path accounting (serve_model's resident fast lane): which
+        # route scored each request, how many host<->device round-trips
+        # were spent, and how many dispatched batches await readback
+        self.hot_path = hot_path
+        self._c_path = self.metrics.counter(
+            "mmlspark_tpu_serving_path_total",
+            "requests scored per hot-path route (resident/native/host)",
+            labels=("server", "path"))
+        self._c_round_trips = _own(
+            "mmlspark_tpu_serving_host_round_trips_total",
+            "host<->device round-trips spent scoring (one per resident "
+            "batch; the native route adds none)")
+        self._g_readback = self.metrics.gauge(
+            "mmlspark_tpu_serving_readback_inflight_depth",
+            "resident batches dispatched, reply fetch still pending",
+            labels=("server",)).labels(server=self.server_label)
         # declare the process-wide executable-cache and breaker families on
         # this registry so a scrape shows them even before they move
         ensure_cache_metrics(self.metrics)
@@ -309,6 +511,13 @@ class ServingServer:
                 raise ValueError(
                     f"warmup handler returned {len(out['reply'])} replies "
                     f"for a batch of {rung}")
+            if self.hot_path is not None:
+                # compile the resident executable for this rung, verify
+                # its reply bytes against the handler's, and measure the
+                # native-vs-resident crossover that routes live traffic
+                self.hot_path.warm_rung(
+                    self.handler, req, rung,
+                    [r.entity for r in out["reply"]])
             self._warm_rungs.add(rung)
         self._warmed.set()
         return len(rungs)
@@ -524,6 +733,8 @@ class ServingServer:
                                       if outer.bucketer is not None
                                       else [outer.max_batch_size]),
                     "latency": outer.latency_stats(),
+                    "hot_path": (outer.hot_path.snapshot()
+                                 if outer.hot_path is not None else None),
                 }).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
@@ -534,7 +745,7 @@ class ServingServer:
             def log_message(self, *a):  # silence per-request stderr noise
                 pass
 
-        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        self._server = _DeepBacklogServer((self.host, self.port), Handler)
         self.port = self._server.server_address[1]
         st = threading.Thread(target=self._server.serve_forever, daemon=True)
         st.start()
@@ -668,7 +879,23 @@ class ServingServer:
     # ------------------------------------------------------------------ #
 
     def _batch_loop(self) -> None:
+        hp = self.hot_path
+        # lag-1 overlapped readback: a resident batch's reply fetch is
+        # deferred until the NEXT batch has been dispatched (or the queue
+        # goes idle), so reply serialization of batch N runs while the
+        # device computes batch N+1 — dispatch never blocks on readback
+        readback = (AsyncReadback(self._complete_resident,
+                                  lag=hp.readback_lag)
+                    if hp is not None else None)
         while not self._stop.is_set():
+            if (readback is not None and readback.pending
+                    and self._queue.empty()):
+                # nothing queued: force pending replies out NOW instead of
+                # holding them for a next batch that may never come — the
+                # overlap window is only ever other requests' compute
+                readback.drain()
+                self._g_readback.set(0)
+                continue
             try:
                 first = self._queue.get(timeout=0.1)
             except queue.Empty:
@@ -709,47 +936,138 @@ class ServingServer:
                 if not batch:
                     continue
             self._g_queue.set(self._load())
-            # a single-exchange batch scores INSIDE that request's span,
-            # so a proxying handler's outbound http_send propagates the
-            # same trace downstream (client -> gateway -> replica); multi-
-            # request batches fan in, so serving.score stands alone
-            tracer = self.tracer()
-            parent = batch[0].span if len(batch) == 1 else None
-            if parent is not None and not getattr(parent, "span_id", 0):
-                parent = None
-            with tracer.start_span("serving.score", parent=parent,
-                                   batch_rows=len(batch)) as sspan:
-                target = None
-                try:
-                    requests = [ex.request for ex in batch]
-                    if self.bucketer is not None:
-                        target = self.bucketer.bucket_for(len(requests))
-                        self._c_bucket.labels(
-                            server=self.server_label,
-                            bucket=str(target)).inc()
-                        requests = requests + \
-                            [requests[-1]] * (target - len(requests))
-                    table = Table({"request": requests})
-                    out = self.handler(table)
-                    replies = out["reply"]
-                    if len(replies) != len(requests):
-                        raise ValueError(
-                            f"handler returned {len(replies)} replies for a "
-                            f"batch of {len(requests)} requests — handlers "
-                            "must preserve row count and order"
-                        )
-                    replies = list(replies)[:len(batch)]
-                    if target is not None:
-                        # this rung's executable is compiled now — the
-                        # readiness signal warmup() drives deliberately
-                        self._warm_rungs.add(target)
-                except Exception as e:  # noqa: BLE001 — batch failure -> 500s
-                    self._c_failed.inc(len(batch))
-                    sspan.set(error=str(e))
-                    replies = [_handler_error_response(e)] * len(batch)
-            for ex, resp in zip(batch, replies):
-                ex.response = resp
+            route = "host"
+            if hp is not None:
+                target = (self.bucketer.bucket_for(len(batch))
+                          if self.bucketer is not None else len(batch))
+                route = hp.route_for(target)
+                if route == "resident" and not self._score_resident(
+                        batch, target, readback):
+                    # batch outside the cached schema or the device
+                    # precondition — the native walk is exact for ANY
+                    # float64 payload, so it catches what resident can't
+                    route = "native" if hp.native_fn is not None else "host"
+                if route == "native" and not self._score_native(batch):
+                    route = "host"
+            if route == "host":
+                self._score_batch(batch)
+            if hp is not None:
+                hp.note(route, len(batch))
+                self._c_path.labels(server=self.server_label,
+                                    path=route).inc(len(batch))
+        if readback is not None:
+            readback.drain()
+
+    def _score_resident(self, batch: "list[_Exchange]", target: int,
+                        readback: AsyncReadback) -> bool:
+        """Decode + upload + launch one batch on the resident executor;
+        replies complete through the readback window (see _batch_loop).
+        False = the batch fell outside the cached schema and the caller
+        must re-route it to the handler path."""
+        hp = self.hot_path
+        feats = hp.decoder.decode([ex.request for ex in batch], target)
+        if feats is None:
+            return False
+        if hp.executor.check_ready(Table({hp.feature_col: feats})):
+            # non-empty reason (e.g. floats not f32-representable): this
+            # batch cannot run resident byte-identically
+            return False
+        self._c_bucket.labels(server=self.server_label,
+                              bucket=str(target)).inc()
+        try:
+            outs = hp.executor.dispatch({hp.feature_col: feats})
+        except Exception as e:  # noqa: BLE001 — batch failure -> 500s
+            self._c_failed.inc(len(batch))
+            for ex in batch:
+                ex.response = _handler_error_response(e)
                 ex.event.set()
+            return True
+        hp.resident_batches += 1
+        self._c_round_trips.inc()
+        readback.push((outs, batch))
+        self._g_readback.set(readback.pending)
+        self._warm_rungs.add(target)
+        return True
+
+    def _complete_resident(self, item) -> None:
+        """AsyncReadback's fetch callback: block on one in-flight batch's
+        device results and write every exchange's reply."""
+        outs, batch = item
+        hp = self.hot_path
+        try:
+            vals = hp.executor.fetch(outs, len(batch))[hp.output_col]
+            replies = hp.replies_for(vals)
+        except Exception as e:  # noqa: BLE001 — batch failure -> 500s
+            self._c_failed.inc(len(batch))
+            replies = [_handler_error_response(e)] * len(batch)
+        for ex, resp in zip(batch, replies):
+            ex.response = resp
+            ex.event.set()
+
+    def _score_native(self, batch: "list[_Exchange]") -> bool:
+        """Score synchronously on the native C++ tree walk — zero
+        host<->device round-trips, no padding (nothing compiles, so
+        ragged sizes cost nothing); the small-batch side of the
+        crossover. False = re-route to the handler path."""
+        hp = self.hot_path
+        feats = hp.decoder.decode([ex.request for ex in batch])
+        if feats is None:
+            return False
+        try:
+            replies = hp.replies_for(hp.native_values(feats))
+        except Exception as e:  # noqa: BLE001 — batch failure -> 500s
+            self._c_failed.inc(len(batch))
+            replies = [_handler_error_response(e)] * len(batch)
+        for ex, resp in zip(batch, replies):
+            ex.response = resp
+            ex.event.set()
+        return True
+
+    def _score_batch(self, batch: "list[_Exchange]") -> None:
+        """The handler path: pad to the bucket rung, score through
+        `self.handler`, reply — serve_model's pre-hot-path behavior and
+        the fallback every other route degrades to."""
+        # a single-exchange batch scores INSIDE that request's span,
+        # so a proxying handler's outbound http_send propagates the
+        # same trace downstream (client -> gateway -> replica); multi-
+        # request batches fan in, so serving.score stands alone
+        tracer = self.tracer()
+        parent = batch[0].span if len(batch) == 1 else None
+        if parent is not None and not getattr(parent, "span_id", 0):
+            parent = None
+        with tracer.start_span("serving.score", parent=parent,
+                               batch_rows=len(batch)) as sspan:
+            target = None
+            try:
+                requests = [ex.request for ex in batch]
+                if self.bucketer is not None:
+                    target = self.bucketer.bucket_for(len(requests))
+                    self._c_bucket.labels(
+                        server=self.server_label,
+                        bucket=str(target)).inc()
+                    requests = requests + \
+                        [requests[-1]] * (target - len(requests))
+                table = Table({"request": requests})
+                out = self.handler(table)
+                replies = out["reply"]
+                if len(replies) != len(requests):
+                    raise ValueError(
+                        f"handler returned {len(replies)} replies for a "
+                        f"batch of {len(requests)} requests — handlers "
+                        "must preserve row count and order"
+                    )
+                replies = list(replies)[:len(batch)]
+                if target is not None:
+                    # this rung's executable is compiled now — the
+                    # readiness signal warmup() drives deliberately
+                    self._warm_rungs.add(target)
+            except Exception as e:  # noqa: BLE001 — batch failure -> 500s
+                self._c_failed.inc(len(batch))
+                sspan.set(error=str(e))
+                replies = [_handler_error_response(e)] * len(batch)
+        for ex, resp in zip(batch, replies):
+            ex.response = resp
+            ex.event.set()
 
 
 class MicroBatchQuery:
@@ -855,6 +1173,34 @@ class MicroBatchQuery:
         return not self._thread.is_alive()
 
 
+def _build_hot_path(model, decoder: RequestDecoder,
+                    output_col: str) -> "_HotPath | None":
+    """serve_model's resident fast lane over `model`, or None when the
+    model cannot host one (multi-segment plan, host-only stages, feature
+    column mismatch) — the handler path then serves everything,
+    unchanged."""
+    try:
+        rex = model.resident_executor()
+    except Exception:  # noqa: BLE001 — the fast lane is strictly optional
+        return None
+    if isinstance(rex, str):
+        return None
+    if rex.upload_cols != ("features",) or output_col not in rex.download_cols:
+        return None
+    # the native tree walk can substitute for the WHOLE segment only when
+    # the segment is exactly one stage exposing a host scorer
+    native_fn = None
+    stages = list(model.get("stages") or [])
+    if len(stages) == 1:
+        get_fn = getattr(stages[0], "native_score_fn", None)
+        fn = get_fn() if callable(get_fn) else None
+        if callable(fn):
+            native_fn = fn
+    return _HotPath(rex, decoder, "features", output_col,
+                    native_fn=native_fn,
+                    readback_lag=model.get("readback_lag"))
+
+
 def serve_model(
     model,
     input_cols: list[str],
@@ -863,6 +1209,7 @@ def serve_model(
     port: int = 0,
     fuse_pipeline: bool = True,
     mesh=None,
+    hot_path: bool = True,
     **server_kw,
 ) -> ServingServer:
     """Deploy a fitted Transformer: JSON body {col: value, ...} in,
@@ -874,7 +1221,14 @@ def serve_model(
     into one XLA program per request batch. `fuse_pipeline=False` keeps
     the stage-by-stage path. With `mesh` (a parallel.mesh mesh) the fused
     segments compile sharded over it — request batches score data-parallel
-    across chips, byte-identical to the single-chip path."""
+    across chips, byte-identical to the single-chip path.
+
+    `hot_path=True` (default) additionally pins a fully-fused model's
+    params on device ONCE and routes live batches between the resident
+    executor and the native tree walk per the bucket crossover measured
+    at warmup — byte-identical replies with no per-request re-staging.
+    It silently stays on the handler path whenever the model cannot host
+    a resident session."""
     from ..core.fusion import FusedPipelineModel
     from ..core.pipeline import PipelineModel
 
@@ -886,7 +1240,40 @@ def serve_model(
     elif mesh is not None and isinstance(model, FusedPipelineModel):
         model.set_mesh(mesh)
 
+    # one decoder serves the handler fast path AND the hot-path routes,
+    # so the cached schema and its hit/fallback counts stay unified
+    decoder = RequestDecoder(input_cols)
+    hp = None
+    if hot_path and fuse_pipeline:
+        hp_model = model
+        if (not isinstance(model, PipelineModel)
+                and hasattr(model, "device_kernel")):
+            # a bare device-capable transformer (e.g. a fitted GBDT model)
+            # hosts a resident session through a single-stage fused wrap;
+            # the handler keeps scoring through the original model —
+            # warmup verifies the two produce the same reply bytes
+            from ..core.fusion import fuse
+
+            try:
+                hp_model = fuse(PipelineModel([model]), mesh=mesh)
+            except Exception:  # noqa: BLE001 — fast lane is optional
+                hp_model = None
+        if isinstance(hp_model, FusedPipelineModel):
+            hp = _build_hot_path(hp_model, decoder, output_col)
+
     def handler(table: Table) -> Table:
+        reqs = list(table["request"])
+        # the fast assembly is safe exactly when a resident session could
+        # be built: that proves the model consumes the single "features"
+        # column (a model reading per-field columns needs parse_request)
+        feats = decoder.decode(reqs) if hp is not None else None
+        if feats is not None:
+            # fast assembly: one preallocated matrix straight from the
+            # request bytes — parse_request's per-request dtype
+            # re-inference and the per-column stack re-copy are both gone
+            scored = model.transform(
+                Table({"request": reqs, "features": feats}))
+            return make_reply(scored, output_col)
         t = parse_request(table)
         missing = [c for c in input_cols if c not in t]
         if missing:
@@ -902,7 +1289,12 @@ def serve_model(
     # scoring is pure per-row, so batch-size bucketing is safe here and
     # keeps the jitted model's compiled-shape set closed
     server_kw.setdefault("bucket_batches", True)
-    return ServingServer(handler, host=host, port=port, **server_kw).start()
+    if hp is not None:
+        # sharded resident dispatch needs every ladder rung divisible by
+        # the mesh data axis; single-device this is 1 (no-op)
+        server_kw.setdefault("bucket_multiple_of", hp.executor.data_axis_size)
+    return ServingServer(handler, host=host, port=port, hot_path=hp,
+                         **server_kw).start()
 
 
 @dataclass

@@ -258,6 +258,10 @@ GAUGE_MERGE_POLICIES: dict[str, str] = {
     "mmlspark_tpu_gateway_inflight_depth": "sum",
     "mmlspark_tpu_autoscaler_target_replicas_count": "last",
     "mmlspark_tpu_autoscaler_calm_ticks_count": "last",
+    # hot-path serving: batches in flight between dispatch and reply
+    # fetch genuinely add across replicas (rule 5: write the intent
+    # down, don't inherit it from the _depth suffix default)
+    "mmlspark_tpu_serving_readback_inflight_depth": "sum",
 }
 
 _SUFFIX_POLICIES: tuple[tuple[str, str], ...] = (

@@ -8,9 +8,10 @@
 #' @param prefetch_depth chunks prepared/uploaded ahead of device compute (0 = sequential)
 #' @param shape_buckets pad ragged chunk tails to a pow-2 bucket ladder so the compiled-shape set stays closed
 #' @param fused_label label for the fusion-ratio gauge
+#' @param readback_lag device batches kept in flight before device->host readback is forced (0 = fetch synchronously after every dispatch); also the lag of the serving hot path's overlapped reply fetch
 #' @param use_mesh compile fused segments under the process mesh (parallel.mesh.get_mesh()) when no explicit mesh was set via fuse(model, mesh=...) / set_mesh()
 #' @export
-ml_fused_pipeline_model <- function(x, stages = NULL, mini_batch_size = 4096L, prefetch_depth = 2L, shape_buckets = TRUE, fused_label = "pipeline", use_mesh = FALSE)
+ml_fused_pipeline_model <- function(x, stages = NULL, mini_batch_size = 4096L, prefetch_depth = 2L, shape_buckets = TRUE, fused_label = "pipeline", readback_lag = 1L, use_mesh = FALSE)
 {
   params <- list()
   if (!is.null(stages)) params$stages <- as.list(stages)
@@ -18,6 +19,7 @@ ml_fused_pipeline_model <- function(x, stages = NULL, mini_batch_size = 4096L, p
   if (!is.null(prefetch_depth)) params$prefetch_depth <- as.integer(prefetch_depth)
   if (!is.null(shape_buckets)) params$shape_buckets <- as.logical(shape_buckets)
   if (!is.null(fused_label)) params$fused_label <- as.character(fused_label)
+  if (!is.null(readback_lag)) params$readback_lag <- as.integer(readback_lag)
   if (!is.null(use_mesh)) params$use_mesh <- as.logical(use_mesh)
   .tpu_apply_stage("mmlspark_tpu.core.fusion.FusedPipelineModel", params, x, is_estimator = FALSE)
 }

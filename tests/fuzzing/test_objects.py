@@ -113,7 +113,7 @@ def _core_objects(ctx) -> dict[str, list[TestObject]]:
                     _mlp_bundle(8, 3)),
                  DataConversion(cols=["output"], convert_to="float")],
                 mini_batch_size=8, prefetch_depth=1, shape_buckets=True,
-                fused_label="fuzz",
+                readback_lag=0, fused_label="fuzz",
             ),
             transform_table=f_table,
         ), TestObject(

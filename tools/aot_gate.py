@@ -133,6 +133,59 @@ def runner_sharded_build(n, n_data, n_model=1):
     return jfn, (t.bundle.variables, x)
 
 
+# one fitted model + fused executor shared by every serving gate below —
+# training per (bucket x mesh) cell would swamp the gate's wall clock
+_RESIDENT = {}
+
+
+def _resident_executor(n_data=0):
+    """A ResidentExecutor over a tiny fitted GBDT model, fused under a
+    `n_data x 1` mesh (0 = single device). Cached per mesh shape."""
+    key = n_data
+    if key in _RESIDENT:
+        return _RESIDENT[key]
+    import numpy as np
+
+    from mmlspark_tpu.core.fusion import fuse
+    from mmlspark_tpu.core.pipeline import PipelineModel
+    from mmlspark_tpu.core.schema import Table
+    from mmlspark_tpu.gbdt.estimators import GBDTRegressor
+
+    if "model" not in _RESIDENT:
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(256, 8)).astype(np.float32).astype(np.float64)
+        y = X @ rng.normal(size=8)
+        _RESIDENT["model"] = GBDTRegressor(
+            num_iterations=5, num_leaves=7).fit(
+            Table({"features": X, "label": y}))
+    mesh = None
+    if n_data:
+        from mmlspark_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(n_data=n_data, n_model=1,
+                         devices=jax.devices()[:n_data])
+    fused = fuse(PipelineModel([_RESIDENT["model"]]), mesh=mesh)
+    rex = fused.resident_executor()
+    if isinstance(rex, str):
+        raise RuntimeError(f"no resident executor: {rex}")
+    _RESIDENT[key] = rex
+    return rex
+
+
+def serving_resident_build(n, n_data=0):
+    """The serving hot path's resident executable at ONE bucket rung.
+
+    io_http/serving.py routes live request batches straight onto these
+    programs (params pinned on device, one upload per batch), and its
+    warmup refuses to flip /readyz until the full ladder is compiled —
+    so every rung the batcher can mint must AOT-compile, single-device
+    and under each mesh shape this host can form."""
+    import numpy as np
+
+    rex = _resident_executor(n_data)
+    return rex.aot_args({"features": np.zeros((1, 8), np.float64)}, n)
+
+
 def main():
     dev = jax.devices()[0]
     print(f"device: {dev.platform} {getattr(dev, 'device_kind', '?')}",
@@ -170,6 +223,19 @@ def main():
             gate(f"runner_bucket_b{bucket}_mesh{n_data}x{n_model}",
                  lambda n=bucket, d=n_data, m=n_model:
                  runner_sharded_build(n, d, m))
+
+    # serving hot path: the resident executor's bucket ladder (the exact
+    # programs serve_model warmup compiles before /readyz flips),
+    # single-device and sharded over each pure-data mesh
+    for bucket in ShapeBucketer(64).ladder:
+        gate(f"serving_resident_b{bucket}",
+             lambda n=bucket: serving_resident_build(n))
+    for n_data, n_model in mesh_shapes:
+        if n_model != 1:
+            continue  # the GBDT kernel shards rows over data only
+        for bucket in ShapeBucketer(64, multiple_of=n_data).ladder:
+            gate(f"serving_resident_b{bucket}_mesh{n_data}x1",
+                 lambda n=bucket, d=n_data: serving_resident_build(n, d))
 
     n_fail = sum(1 for _, v, _, _ in VERDICTS if v == "FAIL")
     print(f"\nAOT GATE SUMMARY: {len(VERDICTS) - n_fail}/{len(VERDICTS)} "

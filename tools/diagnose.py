@@ -17,10 +17,17 @@ Four entry modes:
       which are ejected and why, in-flight depth and breaker state per
       replica — plus the autoscaler's control-loop state.
 
+  python tools/diagnose.py --serving http://HOST:PORT
+      Ask one ServingServer for its info JSON and print the hot-path
+      snapshot: per-bucket crossover routes with their measured timings,
+      path counters, readback lag, and host round-trips per request.
+
   python tools/diagnose.py --selftest
       Spin up a real 2-replica ServingFleet in-process, push traffic
-      through it, diagnose it, and exit nonzero unless every check holds
-      — the CI smoke for the whole fleet-observability path (ci.sh).
+      through it, diagnose it, then stand up a hot-path serve_model
+      server and assert ≤1 host round-trip per resident request; exit
+      nonzero unless every check holds — the CI smoke for the whole
+      fleet-observability path (ci.sh).
 
 The table is built ONLY from the exposition (never from side channels),
 so what it prints is exactly what a Prometheus scrape would see.
@@ -225,6 +232,56 @@ def diagnose_gateway(url: str) -> str:
     return "\n".join(out)
 
 
+def diagnose_serving(url: str) -> str:
+    """Hot-path snapshot from one ServingServer's info endpoint."""
+    info = json.loads(_fetch(url.rstrip("/") + "/"))
+    lat = info.get("latency") or {}
+    out = [
+        f"server: {info.get('host')}:{info.get('port')} "
+        f"mode={info.get('mode')} "
+        f"ready={'y' if info.get('ready') else 'n'} "
+        f"seen={_fmt(info.get('seen', 0))} "
+        f"answered={_fmt(info.get('answered', 0))} "
+        f"p50_ms={_fmt(lat.get('p50_ms', float('nan')), 2)} "
+        f"p99_ms={_fmt(lat.get('p99_ms', float('nan')), 2)}",
+        f"executable cache: hits={_fmt(info.get('executable_cache_hits', 0))} "
+        f"misses={_fmt(info.get('executable_cache_misses', 0))} "
+        f"recompiles={_fmt(info.get('executable_cache_recompiles', 0))}",
+    ]
+    hp = info.get("hot_path")
+    if not hp:
+        out.append("hot path: none (handler-only server)")
+        return "\n".join(out)
+    state = ("enabled" if hp.get("enabled")
+             else f"DISABLED ({hp.get('disabled_reason')})")
+    out.append(f"hot path: {state} readback_lag={hp.get('readback_lag')}")
+    timings = hp.get("timings_ms") or {}
+    rows = []
+    for bucket, route in sorted((hp.get("crossover") or {}).items(),
+                                key=lambda kv: int(kv[0])):
+        t = timings.get(bucket, {})
+        rows.append([bucket, route,
+                     _fmt(t.get("native", float("nan")), 3),
+                     _fmt(t.get("resident", float("nan")), 3)])
+    if rows:
+        out.append(_render_table(
+            rows, ["bucket", "route", "native_ms", "resident_ms"]))
+    else:
+        out.append("(no crossover measured — server not warmed?)")
+    paths = hp.get("paths") or {}
+    out.append("paths: " + " ".join(
+        f"{k}={_fmt(v)}" for k, v in sorted(paths.items())))
+    out.append(
+        f"round trips: total={_fmt(hp.get('round_trips', 0))} "
+        f"resident_batches={_fmt(hp.get('resident_batches', 0))} "
+        f"per_resident_request="
+        f"{_fmt(hp.get('round_trips_per_resident_request', 0), 3)}")
+    dec = hp.get("decoder") or {}
+    out.append(f"decoder: hits={_fmt(dec.get('hits', 0))} "
+               f"fallbacks={_fmt(dec.get('fallbacks', 0))}")
+    return "\n".join(out)
+
+
 # -- selftest ----------------------------------------------------------- #
 
 def _selftest_handler(table):
@@ -239,6 +296,57 @@ def _selftest_handler(table):
 
 def _selftest_factory():
     return _selftest_handler
+
+
+def _hot_path_selftest(checks: dict) -> None:
+    """Stand up a hot-path serve_model server in-process, push traffic
+    through every route, and assert the ≤1-host-round-trip-per-request
+    serving bar on the resident path."""
+    import time
+
+    import numpy as np
+
+    from mmlspark_tpu.core.schema import Table
+    from mmlspark_tpu.gbdt.estimators import GBDTRegressor
+    from mmlspark_tpu.io_http.schema import HTTPRequestData
+    from mmlspark_tpu.io_http.serving import serve_model
+
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(256, 4)).astype(np.float32).astype(np.float64)
+    y = X @ rng.normal(size=4)
+    model = GBDTRegressor(num_iterations=5, num_leaves=7).fit(
+        Table({"features": X, "label": y}))
+    cols = [f"x{i}" for i in range(4)]
+    warm = HTTPRequestData.from_json(
+        "/", {c: float(np.float32(0.25 * i)) for i, c in enumerate(cols)})
+    srv = serve_model(model, cols, max_batch_size=32, warmup_request=warm)
+    try:
+        deadline = time.monotonic() + 60
+        while not srv.ready and time.monotonic() < deadline:
+            time.sleep(0.05)
+        checks["hot server warmed"] = srv.ready
+        checks["hot path enabled"] = (
+            srv.hot_path is not None and srv.hot_path.disabled is None)
+        srv.hot_path.force_path = "resident"
+        n = 6
+        for i in range(n):
+            v = rng.normal(size=4).astype(np.float32)
+            req = urllib.request.Request(
+                srv.url, data=json.dumps(
+                    {c: float(x) for c, x in zip(cols, v)}).encode(),
+                headers={"Content-Type": "application/json"}, method="POST")
+            urllib.request.urlopen(req, timeout=10).read()
+        report = diagnose_serving(srv.url)
+        print()
+        print(report)
+        snap = srv.hot_path.snapshot()
+        checks[f"{n} resident requests"] = snap["paths"]["resident"] == n
+        checks["<=1 host round-trip per request"] = (
+            0 < snap["round_trips_per_resident_request"] <= 1.0)
+        checks["crossover measured"] = len(snap["crossover"]) > 0
+        checks["report shows crossover"] = "resident_ms" in report
+    finally:
+        srv.stop()
 
 
 def selftest() -> int:
@@ -264,6 +372,7 @@ def selftest() -> int:
         }
     finally:
         fleet.stop()
+    _hot_path_selftest(checks)
     failed = [name for name, ok in checks.items() if not ok]
     if failed:
         print(f"selftest FAILED: {failed}", file=sys.stderr)
@@ -278,6 +387,8 @@ def main(argv: "list[str] | None" = None) -> int:
     g.add_argument("--rendezvous", help="FleetRendezvous base URL")
     g.add_argument("--urls", nargs="+", help="replica /metrics URLs")
     g.add_argument("--gateway", help="ServingGateway base URL")
+    g.add_argument("--serving", help="ServingServer base URL (hot-path "
+                                     "snapshot)")
     g.add_argument("--selftest", action="store_true",
                    help="run a 2-replica fleet and diagnose it")
     args = ap.parse_args(argv)
@@ -287,6 +398,8 @@ def main(argv: "list[str] | None" = None) -> int:
         print(diagnose_rendezvous(args.rendezvous))
     elif args.gateway:
         print(diagnose_gateway(args.gateway))
+    elif args.serving:
+        print(diagnose_serving(args.serving))
     else:
         print(diagnose_urls(args.urls))
     return 0
