@@ -1272,6 +1272,94 @@ def bench_instrumentation() -> dict:
     }
 
 
+def bench_recorder_overhead() -> dict:
+    """The flight-recorder paired row: serving-hot-path p50 with the
+    black box ARMED (ring + exemplar-stamped latency observation +
+    metric-delta tick check per request) vs DISABLED (the one-attribute
+    no-op path). Same estimator as bench_instrumentation: the per-request
+    recorder cost is a paired difference of empty-body loop floors (host
+    noise cannot resolve a <2% delta on direct server timings), and the
+    p50 under load comes from a real keep-alive request loop against a
+    ServingServer. Acceptance bar: armed/disabled p50 ratio <= 1.02."""
+    import http.client
+    import json as _json
+    import urllib.parse
+
+    from mmlspark_tpu.core.schema import Table
+    from mmlspark_tpu.io_http.schema import make_reply, parse_request
+    from mmlspark_tpu.io_http.serving import ServingServer
+    from mmlspark_tpu.observability import MetricsRegistry
+    from mmlspark_tpu.observability.recorder import FlightRecorder
+
+    def handler(table: Table) -> Table:
+        t = parse_request(table)
+        return make_reply(
+            t.with_column("y", np.asarray(t["x"], dtype=float) * 2), "y")
+
+    # 1) real p50 under serving load (keep-alive, continuous batcher)
+    srv = ServingServer(handler, metrics=MetricsRegistry(),
+                        exemplars=False).start()
+    try:
+        p = urllib.parse.urlsplit(srv.url)
+        conn = http.client.HTTPConnection(p.hostname, p.port, timeout=30)
+        body = _json.dumps({"x": 2.0}).encode()
+        lat = []
+        for i in range(240):
+            t0 = time.perf_counter()
+            conn.request("POST", p.path or "/", body=body,
+                         headers={"Content-Type": "application/json"})
+            conn.getresponse().read()
+            if i >= 40:  # warm-up excluded
+                lat.append(time.perf_counter() - t0)
+        conn.close()
+    finally:
+        srv.stop()
+    p50 = float(np.percentile(lat, 50))
+
+    # 2) per-request recorder cost, paired empty-body difference
+    clock = time.perf_counter
+
+    def floor_per_call(body, calls: int = 20_000, passes: int = 5) -> float:
+        best = float("inf")
+        for _ in range(passes):
+            t0 = clock()
+            for _ in range(calls):
+                body()
+            best = min(best, clock() - t0)
+        return best / calls
+
+    def make_step(armed: bool):
+        reg = MetricsRegistry()
+        rec = FlightRecorder(enabled=armed, tick_interval_s=3600.0,
+                             registry=reg)
+        child = reg.histogram(
+            "mmlspark_tpu_serving_latency_seconds", "latency",
+            labels=("server",), exemplars=armed).labels(server="bench")
+        ex = ({"trace_id": "ab" * 16, "route": "resident", "bucket": "8"}
+              if armed else None)
+
+        def step():
+            child.observe(1e-3, exemplar=ex)
+            rec.record_request(trace_id="ab" * 16, route="resident",
+                               bucket=8, queue_depth=0, latency_s=1e-3,
+                               status=200)
+            rec.maybe_tick(reg)
+        return step
+
+    def nop():
+        pass
+
+    base = floor_per_call(nop)
+    cost_armed = max(floor_per_call(make_step(True)) - base, 0.0)
+    cost_disabled = max(floor_per_call(make_step(False)) - base, 0.0)
+    return {
+        "serving_p50_ms": p50 * 1e3,
+        "ratio_armed": (p50 + cost_armed) / max(p50 + cost_disabled, 1e-12),
+        "armed_cost_us_per_request": cost_armed * 1e6,
+        "disabled_cost_us_per_request": cost_disabled * 1e6,
+    }
+
+
 def bench_fleet_scrape() -> dict:
     """Cost of the fleet-observability aggregation path: scrape every
     replica's /metrics over real HTTP, parse, merge, and re-render the
@@ -1768,6 +1856,12 @@ def _run_suite(platform: str) -> dict:
         print(f"bench: instrumentation bench failed ({e!r})", file=sys.stderr)
         instrumentation = None
     try:
+        recorder = bench_recorder_overhead()
+    except Exception as e:  # noqa: BLE001 — overhead row is auxiliary
+        print(f"bench: recorder overhead bench failed ({e!r})",
+              file=sys.stderr)
+        recorder = None
+    try:
         fleet_scrape = bench_fleet_scrape()
     except Exception as e:  # noqa: BLE001 — aggregation row is auxiliary
         print(f"bench: fleet scrape bench failed ({e!r})", file=sys.stderr)
@@ -1872,6 +1966,16 @@ def _run_suite(platform: str) -> dict:
             "instrumentation_overhead_disabled": round(
                 instrumentation["ratio_disabled"], 3)
                 if instrumentation else None,
+            "recorder_overhead": round(
+                recorder["ratio_armed"], 4) if recorder else None,
+            "recorder_serving_p50_ms": round(
+                recorder["serving_p50_ms"], 3) if recorder else None,
+            "recorder_armed_cost_us": round(
+                recorder["armed_cost_us_per_request"], 3)
+                if recorder else None,
+            "recorder_disabled_cost_us": round(
+                recorder["disabled_cost_us_per_request"], 3)
+                if recorder else None,
             "fleet_scrape_aggregate_ms": {
                 str(n): round(v, 3) for n, v in
                 fleet_scrape["aggregate_ms_by_n"].items()}

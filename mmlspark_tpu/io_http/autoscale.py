@@ -145,6 +145,12 @@ class FleetAutoscaler:
         self.events.append({"t": now, "action": action, "detail": detail,
                             "n_live": self.fleet.n_live})
         self._c_events.labels(direction=action).inc()
+        # the same transition lands in the black box so a postmortem
+        # shows WHEN capacity moved relative to the trigger
+        from ..observability.recorder import get_recorder
+
+        get_recorder().record_transition(
+            "autoscaler", action, detail=detail, n_live=self.fleet.n_live)
 
     def heal(self) -> list[int]:
         """Respawn every crashed (non-retired) slot. Runs outside the

@@ -76,6 +76,9 @@ class ServingGateway:
         policy: "RetryPolicy | None" = None,
         pool: "TargetPool | None" = None,
         probe_timeout_s: float = 2.0,
+        exemplars: bool = True,
+        flight_recorder_dir: "str | None" = None,
+        recorder: Any = None,
         **breaker_kw,
     ):
         if strategy not in ("least_loaded", "round_robin", "hash"):
@@ -113,7 +116,17 @@ class ServingGateway:
         self._lock = threading.Lock()
         self._fleet = None
         self.autoscaler = None
+        self.exemplars = bool(exemplars)
         self._init_metrics(metrics)
+        # black-box flight recorder: admit/eject transitions and routed
+        # requests land in the ring; `flight_recorder_dir` arms triggered
+        # dumps (SLO burn via the driver, drain on stop())
+        if recorder is None and flight_recorder_dir:
+            from ..observability.recorder import FlightRecorder
+
+            recorder = FlightRecorder(dump_dir=flight_recorder_dir,
+                                      process=f"gateway-{self.server_label}")
+        self.recorder = recorder
 
     # -- metrics -------------------------------------------------------- #
 
@@ -154,7 +167,7 @@ class ServingGateway:
         self._h_latency = self.metrics.histogram(
             "mmlspark_tpu_gateway_latency_seconds",
             "gateway latency, request read to reply written",
-            labels=("server",)).labels(**lbl)
+            labels=("server",), exemplars=self.exemplars).labels(**lbl)
         self._update_pool_gauges()
 
     def _update_pool_gauges(self) -> None:
@@ -165,6 +178,15 @@ class ServingGateway:
         self._g_inflight.set(
             sum(s["inflight"] for s in states.values()))
 
+    def _recorder(self):
+        """The gateway's flight recorder, or the process default (armed
+        but dumping nowhere until someone configures a dump_dir)."""
+        if self.recorder is not None:
+            return self.recorder
+        from ..observability.recorder import get_recorder
+
+        return get_recorder()
+
     # -- membership ----------------------------------------------------- #
 
     def admit(self, url: str) -> None:
@@ -173,12 +195,15 @@ class ServingGateway:
         swap uses the admission stream as its audit trail."""
         self.pool.admit(url)
         self._c_admissions.inc()
+        self._recorder().record_transition("gateway", "admit", url=url)
         self._update_pool_gauges()
 
     def eject(self, url: str, reason: str = "manual") -> None:
         if self.pool.eject(url, reason):
             self._c_ejections.labels(
                 server=self.server_label, reason=reason).inc()
+            self._recorder().record_transition("gateway", "eject", url=url,
+                                               reason=reason)
         self._update_pool_gauges()
 
     def remove(self, url: str) -> None:
@@ -347,7 +372,7 @@ class ServingGateway:
                 remote = tracer.extract(self.headers.get("traceparent"))
                 with tracer.start_span("gateway.request", parent=remote,
                                        path=self.path,
-                                       server=outer.server_label):
+                                       server=outer.server_label) as span:
                     resp = outer.forward(req, key=key)
                 if outer.journal is not None:
                     outer.journal.record_reply(ex_id, resp)
@@ -365,7 +390,18 @@ class ServingGateway:
                 self.end_headers()
                 if entity:
                     self.wfile.write(entity)
-                outer._h_latency.observe(time.perf_counter() - t0)
+                elapsed = time.perf_counter() - t0
+                trace_id = getattr(span, "trace_id", 0)
+                tid = format(trace_id, "032x") if trace_id else ""
+                ex = ({"trace_id": tid, "route": "gateway"}
+                      if outer.exemplars and tid else None)
+                outer._h_latency.observe(elapsed, exemplar=ex)
+                rec = outer._recorder()
+                rec.record_request(trace_id=tid, route="gateway",
+                                   queue_depth=outer.routes()["n_live"],
+                                   latency_s=elapsed, status=status,
+                                   outcome=outcome)
+                rec.maybe_tick(outer.metrics)
                 outer._update_pool_gauges()
 
             def _reply_json(self, status: int, payload: dict) -> None:
@@ -430,3 +466,8 @@ class ServingGateway:
             self._server = None
         if self.journal is not None:
             self.journal.close()
+        if self.recorder is not None:
+            try:
+                self.recorder.trigger_dump("drain", force=True)
+            except Exception:
+                pass

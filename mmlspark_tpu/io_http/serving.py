@@ -68,6 +68,13 @@ class _Exchange:
     # the serving.request span (handler thread) — the batcher parents its
     # serving.score span on it so one trace covers park -> score -> reply
     span: Any = None
+    # stamped by the batcher before scoring: which hot-path route and
+    # bucket rung served this request (+ the readback window depth at
+    # resident dispatch) — the handler thread attaches them to the
+    # latency exemplar and the flight-recorder request record
+    route: str | None = None
+    bucket: int | None = None
+    readback_lag: int | None = None
 
 
 class SingleSegmentHandler(BaseHTTPRequestHandler):
@@ -291,6 +298,9 @@ class ServingServer:
         warmup_request: "HTTPRequestData | None" = None,
         tracer: Any = None,
         hot_path: "_HotPath | None" = None,
+        exemplars: bool = True,
+        flight_recorder_dir: "str | None" = None,
+        recorder: Any = None,
     ):
         if mode not in ("continuous", "batch"):
             raise ValueError(f"mode must be 'continuous' or 'batch', got {mode!r}")
@@ -397,10 +407,24 @@ class ServingServer:
             "mmlspark_tpu_serving_queue_depth",
             "requests parked awaiting scoring",
             labels=("server",)).labels(server=self.server_label)
+        # exemplars link each latency bucket to the exact trace that last
+        # filled it (OpenMetrics suffix on the _bucket lines) — the fleet
+        # aggregator merges them so a fleet p99 resolves to one trace_id
+        self.exemplars = bool(exemplars)
         self._h_latency = self.metrics.histogram(
             "mmlspark_tpu_serving_latency_seconds",
             "service latency, enqueue to reply written",
-            labels=("server",)).labels(server=self.server_label)
+            labels=("server",),
+            exemplars=self.exemplars).labels(server=self.server_label)
+        # the black box: None stays a one-attribute-check no-op on the hot
+        # path; a flight_recorder_dir arms a per-server recorder whose
+        # triggered dumps `tools/diagnose.py --postmortem` reassembles
+        if recorder is None and flight_recorder_dir:
+            from ..observability.recorder import FlightRecorder
+
+            recorder = FlightRecorder(dump_dir=flight_recorder_dir,
+                                      process=f"serving-{self.server_label}")
+        self.recorder = recorder
         self._c_bucket = self.metrics.counter(
             "mmlspark_tpu_serving_bucket_batches_total",
             "scored batches per bucket-ladder rung",
@@ -568,9 +592,30 @@ class ServingServer:
                 # the finally below restores the idle window for keep-alive
                 self.connection.settimeout(self.body_timeout)
                 try:
+                    path, _, query = self.path.partition("?")
+                    if path == "/flightrecorder/dump":
+                        self._dump_recorder(query)
+                        return
                     self._handle_post()
                 finally:
                     self.connection.settimeout(self.timeout)
+
+            def _dump_recorder(self, query: str) -> None:
+                # the fleet-wide dump broadcast (ServingFleet.dump_all):
+                # a driver-side trigger makes EVERY replica flush its
+                # black box while the evidence is still in the ring
+                import urllib.parse
+
+                length = int(self.headers.get("Content-Length", 0))
+                if length:
+                    self.rfile.read(length)
+                trigger = urllib.parse.parse_qs(query).get(
+                    "trigger", ["remote"])[0]
+                rec = outer.recorder
+                path = (rec.trigger_dump(trigger, force=True)
+                        if rec is not None else None)
+                self._reply_json(200, {"dumped": path is not None,
+                                       "path": path})
 
             def _handle_post(self):
                 # bind this request into the caller's trace: a client-
@@ -607,6 +652,8 @@ class ServingServer:
                         outer.max_pending and
                         outer._load() >= outer.max_pending):
                     outer._c_shed.inc()
+                    if outer.recorder is not None:
+                        outer.recorder.note_shed()
                     span.set(status=503)
                     self.send_response(503)
                     self.send_header("Retry-After", "1")
@@ -648,6 +695,8 @@ class ServingServer:
                         with outer._counter_lock:
                             outer._pending.pop(ex_id, None)
                     outer._c_expired.inc()
+                    if outer.recorder is not None:
+                        outer.recorder.note_expired()
                     span.set(status=504)
                     self.send_response(504)
                     self.send_header("Content-Length", "0")
@@ -671,7 +720,17 @@ class ServingServer:
                     self.wfile.write(entity)
                 elapsed = time.perf_counter() - ex.enqueued_at
                 outer._c_answered.inc()
-                outer._h_latency.observe(elapsed)
+                outer._h_latency.observe(elapsed,
+                                         exemplar=outer._exemplar_for(ex, span))
+                rec = outer.recorder
+                if rec is not None:
+                    rec.record_request(
+                        trace_id=format(getattr(span, "trace_id", 0), "032x"),
+                        route=ex.route or "", bucket=ex.bucket,
+                        queue_depth=outer._load(), latency_s=elapsed,
+                        status=resp.status_code or 500,
+                        readback_lag=ex.readback_lag)
+                    rec.maybe_tick(outer.metrics)
                 with outer._counter_lock:
                     outer._latencies.append(elapsed)
 
@@ -785,10 +844,35 @@ class ServingServer:
             self._server.server_close()
         if self.journal is not None:
             self.journal.close()
+        if self.recorder is not None:
+            try:
+                self.recorder.trigger_dump("drain", force=True)
+            except Exception:
+                pass
 
     @property
     def url(self) -> str:
         return f"http://{self.host}:{self.port}{self.api_path}"
+
+    def _exemplar_for(self, ex: "_Exchange", span) -> "dict | None":
+        """The OpenMetrics exemplar for one answered request: trace_id is
+        the join key (postmortem + fleet merge resolve it to the exact
+        trace), route/bucket/readback_lag say WHICH lane served it."""
+        if not self.exemplars:
+            return None
+        trace_id = getattr(span, "trace_id", 0)
+        if not trace_id and ex.route is None:
+            return None
+        labels: dict[str, str] = {}
+        if trace_id:
+            labels["trace_id"] = format(trace_id, "032x")
+        if ex.route:
+            labels["route"] = ex.route
+        if ex.bucket is not None:
+            labels["bucket"] = str(ex.bucket)
+        if ex.readback_lag is not None:
+            labels["readback_lag"] = str(ex.readback_lag)
+        return labels or None
 
     def latency_stats(self) -> dict[str, float]:
         """p50/p99 service latency (ms) over the rolling window — the measured
@@ -927,6 +1011,9 @@ class ServingServer:
                        if ex.deadline is not None and now > ex.deadline]
             if expired:
                 self._c_expired.inc(len(expired))
+                if self.recorder is not None:
+                    for _ in expired:
+                        self.recorder.note_expired()
                 for ex in expired:
                     ex.response = HTTPResponseData(
                         504, "deadline exceeded before scoring")
@@ -936,19 +1023,28 @@ class ServingServer:
                 if not batch:
                     continue
             self._g_queue.set(self._load())
+            # stamped BEFORE scoring (and re-stamped on each fallback) so
+            # the handler thread — which may complete the exchange the
+            # moment scoring sets its event — always reads the final
+            # route/bucket into the latency exemplar
+            target = (self.bucketer.bucket_for(len(batch))
+                      if self.bucketer is not None else len(batch))
             route = "host"
             if hp is not None:
-                target = (self.bucketer.bucket_for(len(batch))
-                          if self.bucketer is not None else len(batch))
                 route = hp.route_for(target)
+                self._stamp_route(batch, route, target)
                 if route == "resident" and not self._score_resident(
                         batch, target, readback):
                     # batch outside the cached schema or the device
                     # precondition — the native walk is exact for ANY
                     # float64 payload, so it catches what resident can't
                     route = "native" if hp.native_fn is not None else "host"
+                    self._stamp_route(batch, route, target)
                 if route == "native" and not self._score_native(batch):
                     route = "host"
+                    self._stamp_route(batch, route, target)
+            else:
+                self._stamp_route(batch, route, target)
             if route == "host":
                 self._score_batch(batch)
             if hp is not None:
@@ -957,6 +1053,12 @@ class ServingServer:
                                     path=route).inc(len(batch))
         if readback is not None:
             readback.drain()
+
+    @staticmethod
+    def _stamp_route(batch: "list[_Exchange]", route: str,
+                     bucket: int) -> None:
+        for ex in batch:
+            ex.route, ex.bucket = route, bucket
 
     def _score_resident(self, batch: "list[_Exchange]", target: int,
                         readback: AsyncReadback) -> bool:
@@ -985,7 +1087,10 @@ class ServingServer:
         hp.resident_batches += 1
         self._c_round_trips.inc()
         readback.push((outs, batch))
-        self._g_readback.set(readback.pending)
+        depth = readback.pending
+        for ex in batch:
+            ex.readback_lag = depth
+        self._g_readback.set(depth)
         self._warm_rungs.add(target)
         return True
 
@@ -1603,7 +1708,7 @@ def _push_final_metrics(rendezvous_url: str, partition_id: int,
 
 def _fleet_worker(handler_factory, conn, server_kw, partition_id=0,
                   rendezvous_url=None, forwarding=None,
-                  trace_dir=None) -> None:
+                  trace_dir=None, flight_recorder_dir=None) -> None:
     """Child-process entry: build the handler locally (models must not cross
     the process boundary — the reference re-creates per-JVM servers the same
     way, DistributedHTTPSource.scala:244-291), optionally open a reverse
@@ -1615,6 +1720,17 @@ def _fleet_worker(handler_factory, conn, server_kw, partition_id=0,
 
     from .forwarding import establish_forward, get_local_ip
 
+    rec = None
+    if flight_recorder_dir:
+        from ..observability.recorder import (FlightRecorder,
+                                              set_default_recorder)
+
+        rec = FlightRecorder(dump_dir=flight_recorder_dir,
+                             process=f"replica-{partition_id}")
+        # the process default, so gateway/autoscaler/supervisor code
+        # running in this replica records into the same ring
+        set_default_recorder(rec)
+        server_kw = dict(server_kw, recorder=rec)
     srv = ServingServer(handler_factory(), **server_kw).start()
     # SIGTERM (ServingFleet.stop) begins the GRACEFUL sequence below:
     # shed new work, drain what was already admitted (srv.stop's default
@@ -1640,7 +1756,11 @@ def _fleet_worker(handler_factory, conn, server_kw, partition_id=0,
     conn.send((srv.host, srv.port))
     try:
         shutdown.wait()
-        srv.stop()  # graceful: drains in-flight requests first
+        if rec is not None:
+            rec.record_transition("replica", "sigterm",
+                                  partition_id=partition_id)
+        srv.stop()  # graceful: drains in-flight requests first (and the
+        # recorder, when armed, dumps with trigger "drain")
         if rendezvous_url:
             try:
                 _push_final_metrics(rendezvous_url, partition_id,
@@ -1688,6 +1808,7 @@ class ServingFleet:
                  n_hosts: int = 2, start_timeout_s: float = 60.0,
                  rendezvous: bool = True, forwarding=None,
                  trace_dir: "str | None" = None,
+                 flight_recorder_dir: "str | None" = None,
                  stop_timeout_s: float = 15.0, clock: Any = None,
                  stale_after_s: float = 10.0, **server_kw):
         self.handler_factory = handler_factory
@@ -1701,6 +1822,9 @@ class ServingFleet:
         # when set, each gracefully-stopped replica exports its spans to
         # trace_dir/replica-N.jsonl (merge with Tracer.merge_jsonl)
         self.trace_dir = trace_dir
+        # when set, every replica arms a FlightRecorder dumping into this
+        # directory (tools/diagnose.py --postmortem merges the dumps)
+        self.flight_recorder_dir = flight_recorder_dir
         # how long stop() waits for the graceful drain-and-flush before
         # falling back to a hard kill
         self.stop_timeout_s = stop_timeout_s
@@ -1736,6 +1860,18 @@ class ServingFleet:
         )
 
     # -- membership bookkeeping ----------------------------------------- #
+
+    @staticmethod
+    def _record_transition(action: str, **detail) -> None:
+        """Driver-side fleet transitions land in the driver's black box
+        (the process-default recorder, armed once anything configures a
+        flight_recorder_dir on it)."""
+        try:
+            from ..observability.recorder import get_recorder
+
+            get_recorder().record_transition("fleet", action, **detail)
+        except Exception:  # noqa: BLE001 — telemetry stays optional
+            pass
 
     def watch(self, callback: Callable[[str, str], None]) -> None:
         """Register `callback(event, url)` for membership changes; event
@@ -1789,7 +1925,8 @@ class ServingFleet:
             target=_fleet_worker,
             args=(self.handler_factory, child, self.server_kw, partition_id,
                   self.rendezvous.url if self.rendezvous else None,
-                  self.forwarding, self.trace_dir),
+                  self.forwarding, self.trace_dir,
+                  self.flight_recorder_dir),
             daemon=True,
         )
         p.start()
@@ -1901,6 +2038,36 @@ class ServingFleet:
             p.kill()
         p.join(timeout=10)
         self._drop_url(index)
+        self._record_transition("kill", slot=index)
+
+    def dump_all(self, trigger: str = "fleet") -> int:
+        """Broadcast a flight-recorder dump to every LIVE replica (POST
+        /flightrecorder/dump) — the fleet-wide snapshot a driver-side
+        trigger (SLO burn, chaos kill about to land) fans out so each
+        process writes its ring BEFORE anything dies. Fail-soft per
+        replica; returns how many acknowledged."""
+        import http.client
+        import urllib.parse
+
+        dumped = 0
+        with self._fleet_lock:
+            urls = list(self.urls)
+        for url in urls:
+            u = urllib.parse.urlsplit(url)
+            try:
+                conn = http.client.HTTPConnection(u.hostname, u.port,
+                                                  timeout=5)
+                try:
+                    conn.request(
+                        "POST", f"/flightrecorder/dump?trigger={trigger}",
+                        body=b"")
+                    if conn.getresponse().status == 200:
+                        dumped += 1
+                finally:
+                    conn.close()
+            except (OSError, http.client.HTTPException):
+                pass
+        return dumped
 
     def respawn(self, index: int) -> str:
         """Self-healing: refill a dead slot through the same startup
@@ -1914,7 +2081,9 @@ class ServingFleet:
                 "before respawning")
         self._drop_url(index)  # no-op when kill() already pruned it
         self._retired.discard(index)
-        return self._spawn(index)
+        url = self._spawn(index)
+        self._record_transition("respawn", slot=index, url=url)
+        return url
 
     def retire(self, index: int) -> None:
         """Gracefully drain one replica out of the fleet: unpublish its
@@ -1923,6 +2092,7 @@ class ServingFleet:
         counters, and exits. Hard kill only past stop_timeout_s."""
         self._retired.add(index)
         self._drop_url(index)
+        self._record_transition("retire", slot=index)
         p = self._procs[index]
         if p.is_alive():
             p.terminate()
@@ -1957,9 +2127,11 @@ class ServingFleet:
         of replicas swapped."""
         self.handler_factory = new_handler_factory
         old_slots = self.live_slots()
+        self._record_transition("swap_begin", n=len(old_slots))
         for slot in old_slots:
             self._spawn(len(self._procs))
             self.retire(slot)
+        self._record_transition("swap_done", n=len(old_slots))
         return len(old_slots)
 
     def stop(self) -> None:

@@ -14,8 +14,11 @@ from .tracing import (Span, Tracer, get_tracer, set_default_tracer,
                       load_jsonl, merge_jsonl, format_traceparent,
                       parse_traceparent, current_traceparent,
                       CHROME_EVENT_KEYS)
-from .stage import InstrumentedTransformer
-from .fleet import (MetricFamily, MetricSample, MetricsAggregator,
+from .recorder import (FlightRecorder, load_dump, get_recorder,
+                       set_default_recorder, DUMP_SCHEMA_VERSION)
+from .stage import InstrumentedTransformer, FlightRecorderTransformer
+from .fleet import (MetricFamily, MetricSample, FamilyList,
+                    MetricsAggregator,
                     parse_prometheus, render_families, merge_policy_for,
                     GAUGE_MERGE_POLICIES, FLEET_REPLICA, REPLICA_LABEL)
 from .slo import (SLO, SLOEngine, SeriesReader, availability_slo,
@@ -27,7 +30,11 @@ __all__ = [
     "Span", "Tracer", "get_tracer", "set_default_tracer", "load_jsonl",
     "merge_jsonl", "format_traceparent", "parse_traceparent",
     "current_traceparent", "CHROME_EVENT_KEYS", "InstrumentedTransformer",
-    "MetricFamily", "MetricSample", "MetricsAggregator", "parse_prometheus",
+    "FlightRecorderTransformer",
+    "FlightRecorder", "load_dump", "get_recorder", "set_default_recorder",
+    "DUMP_SCHEMA_VERSION",
+    "MetricFamily", "MetricSample", "FamilyList", "MetricsAggregator",
+    "parse_prometheus",
     "render_families", "merge_policy_for", "GAUGE_MERGE_POLICIES",
     "FLEET_REPLICA", "REPLICA_LABEL", "SLO", "SLOEngine", "SeriesReader",
     "availability_slo", "latency_slo",

@@ -32,7 +32,8 @@ from typing import Any, Callable, Iterable
 from .metrics import _escape_label, _fmt_value
 
 __all__ = [
-    "MetricSample", "MetricFamily", "parse_prometheus", "render_families",
+    "MetricSample", "MetricFamily", "FamilyList",
+    "parse_prometheus", "render_families",
     "MetricsAggregator", "GAUGE_MERGE_POLICIES", "merge_policy_for",
     "FLEET_REPLICA", "REPLICA_LABEL",
 ]
@@ -47,14 +48,42 @@ FLEET_REPLICA = "fleet"
 class MetricSample:
     """One exposition line: `name{labels} value`. For histograms the name
     carries the `_bucket`/`_sum`/`_count` suffix and `le` rides in labels,
-    exactly as the text format spells it."""
+    exactly as the text format spells it. `exemplar` is the RAW OpenMetrics
+    suffix after the line's ` # ` separator (`{trace_id="..."} 0.0042`),
+    kept verbatim so exemplar lines round-trip byte-identically."""
 
     name: str
     labels: tuple[tuple[str, str], ...]
     value: float
+    exemplar: "str | None" = None
 
     def labels_dict(self) -> dict[str, str]:
         return dict(self.labels)
+
+    def exemplar_value(self) -> "float | None":
+        """The exemplar's observed value (the trailing number of the raw
+        suffix); None when absent or unparseable."""
+        if not self.exemplar:
+            return None
+        try:
+            return float(self.exemplar.rsplit(" ", 1)[1])
+        except (IndexError, ValueError):
+            return None
+
+    def exemplar_labels(self) -> dict[str, str]:
+        """The exemplar's label set parsed out of the raw suffix (empty
+        when absent) — the postmortem join key (`trace_id`) lives here."""
+        if not self.exemplar:
+            return {}
+        body = self.exemplar
+        end = body.rfind("}")
+        if not body.startswith("{") or end == -1:
+            return {}
+        try:
+            fake = _parse_sample_line("x" + body[:end + 1] + " 0", 0)
+        except ExpositionParseError:
+            return {}
+        return fake.labels_dict()
 
 
 @dataclass
@@ -68,6 +97,16 @@ class MetricFamily:
     # families synthesized for a bare sample with no HELP/TYPE render
     # without meta lines, preserving byte-identity for such input
     explicit_meta: bool = True
+
+
+class FamilyList(list):
+    """`parse_prometheus`'s result: a plain list of MetricFamily plus the
+    one piece of whole-document state the text format carries — whether
+    the input ended with the OpenMetrics `# EOF` terminator. Carrying it
+    here lets `render_families` reproduce exemplar-bearing expositions
+    byte-identically."""
+
+    eof: bool = False
 
 
 class ExpositionParseError(ValueError):
@@ -94,15 +133,27 @@ def _unescape_label(v: str) -> str:
     return "".join(out)
 
 
+def _split_exemplar(rest: str) -> "tuple[str, str | None]":
+    """Split a sample line's post-labels tail into (value text, raw
+    exemplar suffix). The OpenMetrics exemplar rides after ` # ` and is
+    preserved verbatim; label values never reach here, so the separator
+    scan is quote-safe."""
+    head, sep, ex = rest.partition(" # ")
+    if not sep:
+        return rest, None
+    return head, ex
+
+
 def _parse_sample_line(line: str, lineno: int) -> MetricSample:
     brace = line.find("{")
     if brace == -1:
+        rest, exemplar = _split_exemplar(line)
         try:
-            name, value = line.split(None, 1)
+            name, value = rest.split(None, 1)
         except ValueError:
             raise ExpositionParseError(f"line {lineno}: malformed sample "
                                        f"{line!r}") from None
-        return MetricSample(name, (), float(value))
+        return MetricSample(name, (), float(value), exemplar=exemplar)
     name = line[:brace]
     labels: list[tuple[str, str]] = []
     i = brace + 1
@@ -139,7 +190,9 @@ def _parse_sample_line(line: str, lineno: int) -> MetricSample:
     if not rest:
         raise ExpositionParseError(f"line {lineno}: sample {line!r} has no "
                                    "value")
-    return MetricSample(name, tuple(labels), float(rest.split()[0]))
+    rest, exemplar = _split_exemplar(rest)
+    return MetricSample(name, tuple(labels), float(rest.split()[0]),
+                        exemplar=exemplar)
 
 
 def _base_name(sample_name: str, family: "MetricFamily | None") -> str:
@@ -151,11 +204,12 @@ def _base_name(sample_name: str, family: "MetricFamily | None") -> str:
     return sample_name
 
 
-def parse_prometheus(text: str) -> list[MetricFamily]:
+def parse_prometheus(text: str) -> "FamilyList":
     """Parse text exposition 0.0.4 into families, preserving family order,
-    sample order, label order, and HELP docs — everything `render_families`
-    needs to reproduce the input byte-for-byte."""
-    families: list[MetricFamily] = []
+    sample order, label order, HELP docs, exemplar suffixes, and the
+    `# EOF` terminator (on the returned list's `.eof`) — everything
+    `render_families` needs to reproduce the input byte-for-byte."""
+    families: FamilyList = FamilyList()
     by_name: dict[str, MetricFamily] = {}
     current: MetricFamily | None = None
 
@@ -185,6 +239,9 @@ def parse_prometheus(text: str) -> list[MetricFamily]:
                                            f"{line!r}")
             _meta(parts[0]).kind = parts[1]
             continue
+        if line == "# EOF":
+            families.eof = True  # OpenMetrics terminator — round-trips
+            continue
         if line.startswith("#"):
             continue  # comments are legal and carry no state
         sample = _parse_sample_line(line, lineno)
@@ -201,11 +258,16 @@ def parse_prometheus(text: str) -> list[MetricFamily]:
     return families
 
 
-def render_families(families: Iterable[MetricFamily]) -> str:
+def render_families(families: Iterable[MetricFamily],
+                    eof: "bool | None" = None) -> str:
     """Render families back to text exposition, mirroring
     `MetricsRegistry.render_prometheus` exactly (same escaping, same value
-    formatting) so registry output survives a parse round trip
-    byte-for-byte."""
+    formatting, raw exemplar suffixes re-attached verbatim) so registry
+    output survives a parse round trip byte-for-byte. `eof=None` reads the
+    input's `.eof` (a `parse_prometheus` FamilyList) so the OpenMetrics
+    terminator round-trips too."""
+    if eof is None:
+        eof = bool(getattr(families, "eof", False))
     lines: list[str] = []
     for fam in families:
         if fam.explicit_meta:
@@ -217,7 +279,12 @@ def render_families(families: Iterable[MetricFamily]) -> str:
                     f'{n}="{_escape_label(v)}"' for n, v in s.labels) + "}"
             else:
                 lbl = ""
-            lines.append(f"{s.name}{lbl} {_fmt_value(s.value)}")
+            line = f"{s.name}{lbl} {_fmt_value(s.value)}"
+            if s.exemplar is not None:
+                line += f" # {s.exemplar}"
+            lines.append(line)
+    if eof:
+        lines.append("# EOF")
     return "\n".join(lines) + "\n"
 
 
@@ -423,7 +490,7 @@ class MetricsAggregator:
                         for rid, st in sorted(self._replicas.items())]
         merged: dict[str, MetricFamily] = {}
         # group key -> (policy-ready accumulation)
-        groups: dict[str, dict[tuple, list[tuple[float, float]]]] = {}
+        groups: dict[str, dict[tuple, list[tuple[float, MetricSample]]]] = {}
         for rid, fams, t in replicas:
             up = status[rid]["up"]
             for fam in fams:
@@ -439,23 +506,34 @@ class MetricsAggregator:
                 for s in fam.samples:
                     out.samples.append(MetricSample(
                         s.name,
-                        s.labels + ((REPLICA_LABEL, rid),), s.value))
-                    g.setdefault((s.name, s.labels), []).append((s.value, t))
+                        s.labels + ((REPLICA_LABEL, rid),), s.value,
+                        exemplar=s.exemplar))
+                    g.setdefault((s.name, s.labels), []).append((t, s))
         for name, fam in merged.items():
             pol = merge_policy_for(name, fam.kind) or "sum"
             for (sname, labels), vals in groups[name].items():
                 if pol == "sum":
-                    v = sum(v for v, _ in vals)
+                    v = sum(s.value for _, s in vals)
                 elif pol == "max":
-                    v = max(v for v, _ in vals)
+                    v = max(s.value for _, s in vals)
                 elif pol == "min":
-                    v = min(v for v, _ in vals)
+                    v = min(s.value for _, s in vals)
                 else:  # "last": the most recently scraped replica wins
-                    v = max(vals, key=lambda p: p[1])[0]
+                    v = max(vals, key=lambda p: p[0])[1].value
+                # the fleet-merged line keeps the WORST (highest-valued)
+                # exemplar across replicas — a fleet p99 bucket links to
+                # the exact slowest trace that filled it
+                with_ex = [s for _, s in vals
+                           if s.exemplar_value() is not None]
+                ex = (max(with_ex, key=lambda s: s.exemplar_value()).exemplar
+                      if with_ex else None)
                 fam.samples.append(MetricSample(
-                    sname, labels + ((REPLICA_LABEL, FLEET_REPLICA),), v))
-        out = [merged[k] for k in sorted(merged)]
+                    sname, labels + ((REPLICA_LABEL, FLEET_REPLICA),), v,
+                    exemplar=ex))
+        out = FamilyList(merged[k] for k in sorted(merged))
         out.extend(self._meta_families(status))
+        out.eof = any(s.exemplar is not None
+                      for fam in out for s in fam.samples)
         return out
 
     def _meta_families(self, status: dict[str, dict]) -> list[MetricFamily]:

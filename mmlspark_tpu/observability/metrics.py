@@ -38,7 +38,7 @@ from typing import Any, Callable, Iterable
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "get_registry", "set_default_registry", "set_enabled",
-    "DEFAULT_BUCKETS", "METRIC_NAME_RE",
+    "DEFAULT_BUCKETS", "METRIC_NAME_RE", "EXEMPLAR_LABEL_SET_MAX",
 ]
 
 METRIC_NAME_RE = re.compile(r"^mmlspark_tpu_[a-z0-9_]+$")
@@ -85,6 +85,35 @@ def _fmt_value(v: float) -> str:
     if f == int(f) and abs(f) < 1e15:
         return str(int(f))
     return repr(f)
+
+
+# OpenMetrics: "The combined length of the label names and values of an
+# Exemplar's LabelSet MUST NOT exceed 128 UTF-8 characters."
+EXEMPLAR_LABEL_SET_MAX = 128
+
+
+def _cap_exemplar_labels(
+        pairs: "tuple[tuple[str, str], ...]"
+) -> "tuple[tuple[str, str], ...] | None":
+    """Trim trailing label pairs until the OpenMetrics 128-char cap holds.
+    Callers put the join key (trace_id) first so it survives trimming;
+    None when even the first pair is oversized (drop the exemplar, never
+    render an invalid one)."""
+    kept: list[tuple[str, str]] = []
+    budget = EXEMPLAR_LABEL_SET_MAX
+    for n, v in pairs:
+        budget -= len(n) + len(v)
+        if budget < 0:
+            break
+        kept.append((n, v))
+    return tuple(kept) if kept else None
+
+
+def _fmt_exemplar(pairs: "tuple[tuple[str, str], ...]", value: float) -> str:
+    """The OpenMetrics exemplar suffix (sans the leading "# "):
+    `{trace_id="..."} 0.0042`."""
+    body = ",".join(f'{n}="{_escape_label(v)}"' for n, v in pairs)
+    return "{" + body + "} " + _fmt_value(value)
 
 
 # --------------------------------------------------------------------- #
@@ -143,9 +172,10 @@ class _GaugeChild:
 
 class _HistogramChild:
     __slots__ = ("_flag", "_clock", "_lock", "_bounds", "_counts",
-                 "_sum", "_count")
+                 "_sum", "_count", "_ex_on", "_exemplars")
 
-    def __init__(self, flag: _Flag, clock: Any, bounds: tuple[float, ...]):
+    def __init__(self, flag: _Flag, clock: Any, bounds: tuple[float, ...],
+                 exemplars: bool = False):
         self._flag = flag
         self._clock = clock
         self._lock = threading.Lock()
@@ -153,15 +183,41 @@ class _HistogramChild:
         self._counts = [0] * (len(bounds) + 1)   # last slot = +Inf
         self._sum = 0.0
         self._count = 0
+        # per-bucket last (label_pairs, value) observation; the list is
+        # allocated lazily so exemplar-free histograms pay nothing
+        self._ex_on = bool(exemplars)
+        self._exemplars: "list | None" = None
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: "dict | None" = None) -> None:
         if not self._flag.on:
             return
         i = bisect.bisect_left(self._bounds, v)
+        pairs = None
+        if exemplar and self._ex_on:
+            pairs = _cap_exemplar_labels(
+                tuple((str(k), str(val)) for k, val in exemplar.items()))
         with self._lock:
             self._counts[i] += 1
             self._sum += v
             self._count += 1
+            if pairs is not None:
+                if self._exemplars is None:
+                    self._exemplars = [None] * len(self._counts)
+                self._exemplars[i] = (pairs, float(v))
+
+    def exemplars(self) -> "dict[float, tuple]":
+        """Last retained (label_pairs, value) per bucket upper bound —
+        only buckets that hold one (the +Inf slot keyed as inf)."""
+        with self._lock:
+            exs = list(self._exemplars) if self._exemplars else []
+        out: dict[float, tuple] = {}
+        for idx, ex in enumerate(exs):
+            if ex is None:
+                continue
+            bound = (self._bounds[idx] if idx < len(self._bounds)
+                     else float("inf"))
+            out[bound] = ex
+        return out
 
     def time(self):
         """Observe the wall time of a block through the registry clock.
@@ -305,19 +361,36 @@ class Histogram(_Family):
 
     def __init__(self, registry: "MetricsRegistry", name: str, doc: str,
                  labelnames: tuple[str, ...],
-                 buckets: Iterable[float] = DEFAULT_BUCKETS):
+                 buckets: Iterable[float] = DEFAULT_BUCKETS,
+                 exemplars: bool = False):
         bounds = tuple(sorted(float(b) for b in buckets))
         if not bounds:
             raise ValueError("histogram needs at least one bucket bound")
         self._bounds = bounds
+        self._exemplars_on = bool(exemplars)
         super().__init__(registry, name, doc, labelnames)
 
     def _make_child(self):
         return _HistogramChild(self._registry._flag, self._registry._clock,
-                               self._bounds)
+                               self._bounds, exemplars=self._exemplars_on)
 
-    def observe(self, v: float) -> None:
-        self._default().observe(v)
+    @property
+    def exemplars_enabled(self) -> bool:
+        return self._exemplars_on
+
+    def enable_exemplars(self) -> None:
+        """Turn exemplar retention on for this family (idempotent; the
+        promote half of the registry's re-declaration contract — any
+        module asking for exemplars=True wins over earlier plain
+        declarations of the same series)."""
+        self._exemplars_on = True
+        with self._registry._lock:
+            children = list(self._children.values())
+        for child in children:
+            child._ex_on = True
+
+    def observe(self, v: float, exemplar: "dict | None" = None) -> None:
+        self._default().observe(v, exemplar=exemplar)
 
     def time(self):
         return self._default().time()
@@ -332,6 +405,9 @@ class Histogram(_Family):
 
     def buckets(self) -> "dict[float, int]":
         return self._default().buckets()
+
+    def exemplars(self) -> "dict[float, tuple]":
+        return self._default().exemplars()
 
 
 _KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
@@ -404,8 +480,13 @@ class MetricsRegistry:
         return self._family("gauge", name, doc, labels)
 
     def histogram(self, name: str, doc: str = "", labels: Iterable[str] = (),
-                  buckets: Iterable[float] = DEFAULT_BUCKETS) -> Histogram:
-        return self._family("histogram", name, doc, labels, buckets=buckets)
+                  buckets: Iterable[float] = DEFAULT_BUCKETS,
+                  exemplars: bool = False) -> Histogram:
+        fam = self._family("histogram", name, doc, labels, buckets=buckets,
+                           exemplars=exemplars)
+        if exemplars and not fam.exemplars_enabled:
+            fam.enable_exemplars()
+        return fam
 
     def register_callback(self, name: str, doc: str,
                           fn: Callable[[], Any], kind: str = "gauge") -> None:
@@ -443,8 +524,12 @@ class MetricsRegistry:
         return [(dict(lbl), float(v)) for lbl, v in out]
 
     def render_prometheus(self) -> str:
-        """Prometheus text exposition format 0.0.4."""
+        """Prometheus text exposition format 0.0.4. Histograms with
+        exemplars enabled render OpenMetrics exemplar suffixes on their
+        `_bucket` lines and the exposition gains the OpenMetrics `# EOF`
+        terminator (parsers of the plain 0.0.4 dialect skip both)."""
         lines: list[str] = []
+        any_exemplar = False
         with self._lock:
             families = sorted(self._families.items())
             callbacks = sorted(self._callbacks.items())
@@ -454,11 +539,17 @@ class MetricsRegistry:
             for key, child in fam.children():
                 lbl = _fmt_labels(fam.labelnames, key)
                 if fam.kind == "histogram":
+                    exs = child.exemplars() if child._ex_on else {}
                     for bound, cum in child.buckets().items():
                         le = "+Inf" if bound == float("inf") else _fmt_value(bound)
                         blbl = _fmt_labels(fam.labelnames, key,
                                            extra=(("le", le),))
-                        lines.append(f"{name}_bucket{blbl} {cum}")
+                        line = f"{name}_bucket{blbl} {cum}"
+                        ex = exs.get(bound)
+                        if ex is not None:
+                            line += " # " + _fmt_exemplar(*ex)
+                            any_exemplar = True
+                        lines.append(line)
                     lines.append(f"{name}_sum{lbl} {_fmt_value(child.sum)}")
                     lines.append(f"{name}_count{lbl} {child.count}")
                 else:
@@ -470,6 +561,8 @@ class MetricsRegistry:
                 lbl = _fmt_labels(tuple(labels), tuple(str(v) for v in
                                                        labels.values()))
                 lines.append(f"{name}{lbl} {_fmt_value(value)}")
+        if any_exemplar:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
     def snapshot(self) -> dict:
@@ -483,12 +576,19 @@ class MetricsRegistry:
             for key, child in fam.children():
                 labels = dict(zip(fam.labelnames, key))
                 if fam.kind == "histogram":
-                    samples.append({
+                    sample = {
                         "labels": labels, "count": child.count,
                         "sum": child.sum,
                         "buckets": {("+Inf" if b == float("inf") else b): c
                                     for b, c in child.buckets().items()},
-                    })
+                    }
+                    exs = child.exemplars() if child._ex_on else {}
+                    if exs:
+                        sample["exemplars"] = {
+                            ("+Inf" if b == float("inf") else b):
+                            {"labels": dict(pairs), "value": v}
+                            for b, (pairs, v) in exs.items()}
+                    samples.append(sample)
                 else:
                     samples.append({"labels": labels, "value": child.value})
             out[name] = {"kind": fam.kind, "samples": samples}

@@ -223,6 +223,16 @@ class SLOEngine:
         # cumulative shed counters history for signals() shed_rate
         self._shed_history: deque = deque()
         self._last_results: dict[str, dict] = {}
+        # optional flight recorder: every evaluation reports the alerting
+        # set, and the recorder dumps on the not-alerting -> alerting
+        # transition (the SLO-burn black-box trigger)
+        self._recorder = None
+
+    def attach_recorder(self, recorder) -> None:
+        """Dump a FlightRecorder when an SLO starts alerting (the burn-rate
+        trigger). The engine only calls `recorder.note_slo(...)`; transition
+        and cooldown logic live on the recorder."""
+        self._recorder = recorder
 
     def add(self, slo: SLO) -> None:
         with self._lock:
@@ -284,6 +294,11 @@ class SLOEngine:
         self._shed_history.append((now, shed, 0.0))
         self._prune(self._shed_history, now)
         self._last_results = results
+        if self._recorder is not None:
+            try:
+                self._recorder.note_slo(self.alerting())
+            except Exception:  # noqa: BLE001 — a dump must not kill eval
+                pass
         return results
 
     def _prune(self, hist: deque, now: float) -> None:

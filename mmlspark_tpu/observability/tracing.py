@@ -197,6 +197,7 @@ class Tracer:
             bool(os.environ.get("MMLSPARK_TPU_TRACE_DIR"))
             if annotate_device is None else bool(annotate_device))
         self._spans: deque[Span] = deque(maxlen=int(max_spans))
+        self._dropped = 0
         self._lock = threading.Lock()
         # Ids are PROCESS-SEEDED: the pid owns the top bits and random
         # bits scatter the counter base, so per-replica exports merge
@@ -219,7 +220,18 @@ class Tracer:
 
     def _record(self, span: Span) -> None:
         with self._lock:
+            if len(self._spans) == self._spans.maxlen:
+                # the ring is about to evict its oldest span — count it,
+                # so exports can say "N spans lost" instead of silently
+                # truncating the incident's head
+                self._dropped += 1
             self._spans.append(span)
+
+    @property
+    def drop_count(self) -> int:
+        """Spans evicted from the ring since the last export (or clear) —
+        the truncation an incident report must disclose."""
+        return self._dropped
 
     # -- span API ------------------------------------------------------- #
 
@@ -288,6 +300,7 @@ class Tracer:
     def clear(self) -> None:
         with self._lock:
             self._spans.clear()
+            self._dropped = 0
 
     def chrome_events(self) -> list[dict]:
         """Completed spans as Chrome-trace duration events."""
@@ -306,8 +319,23 @@ class Tracer:
     def export_jsonl(self, path: str) -> int:
         """Write one Chrome-trace event per line; returns the event count.
         Perfetto/chrome://tracing load the same events wrapped in a list —
-        `json.dumps({"traceEvents": [json.loads(l) for l in open(p)]})`."""
+        `json.dumps({"traceEvents": [json.loads(l) for l in open(p)]})`.
+
+        When the ring evicted spans since the last export, the file leads
+        with a synthetic zero-duration `tracer.spans_lost` event (schema-
+        valid, args.count = N) so the truncation is stated in-band; the
+        drop counter resets, scoping the disclosure to this export."""
         events = self.chrome_events()
+        with self._lock:
+            dropped, self._dropped = self._dropped, 0
+        if dropped:
+            first_ts = min((ev["ts"] for ev in events), default=0.0)
+            events.insert(0, {
+                "name": "tracer.spans_lost", "cat": "mmlspark_tpu",
+                "ph": "X", "ts": first_ts, "dur": 0.0,
+                "pid": os.getpid(), "tid": 0,
+                "args": {"count": dropped},
+            })
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         with open(path, "w", encoding="utf-8") as fh:
             for ev in events:

@@ -128,6 +128,13 @@ class CircuitBreaker:
             child.inc()
         if self._m_state is not None:
             self._m_state.set(STATE_VALUES.get(to, 0))
+        try:
+            from ..observability.recorder import get_recorder
+
+            get_recorder().record_transition(
+                "breaker", to, breaker=self.name or "breaker")
+        except Exception:  # noqa: BLE001 — telemetry stays optional
+            pass
 
     # -- state ---------------------------------------------------------- #
 

@@ -110,6 +110,26 @@ class QuerySupervisor:
         except Exception:
             pass
 
+    def _flight_record(self, action: str, exc: "BaseException | None",
+                       dump_trigger: "str | None" = None,
+                       force: bool = False) -> None:
+        """Restart/escalation transitions land in the process black box;
+        `dump_trigger` additionally dumps the ring (restarts respect the
+        recorder's cooldown, escalation forces — it is terminal)."""
+        try:
+            from ..observability.recorder import get_recorder
+
+            rec = get_recorder()
+            rec.record_transition(
+                "supervisor", action,
+                query=getattr(self.query, "name", "query"),
+                restarts=self.restarts,
+                error=(f"{type(exc).__name__}: {exc}" if exc else None))
+            if dump_trigger is not None:
+                rec.trigger_dump(dump_trigger, force=force)
+        except Exception:  # noqa: BLE001 — telemetry never blocks recovery
+            pass
+
     # -- lifecycle ------------------------------------------------------- #
 
     def start(self) -> "QuerySupervisor":
@@ -164,6 +184,8 @@ class QuerySupervisor:
                 return
             if self.policy.is_fatal(exc) or not self._restart_allowed():
                 self.state = "failed"
+                self._flight_record("escalate", exc,
+                                    dump_trigger="restart", force=True)
                 if self.on_failure is not None:
                     self.on_failure(self.query, exc)
                 return
@@ -174,6 +196,8 @@ class QuerySupervisor:
                 sess = self.policy.backoff.session()
             if not sess.should_retry():
                 self.state = "failed"
+                self._flight_record("escalate", exc,
+                                    dump_trigger="restart", force=True)
                 if self.on_failure is not None:
                     self.on_failure(self.query, exc)
                 return
@@ -184,6 +208,7 @@ class QuerySupervisor:
             self._restart_times.append(self.clock.monotonic())
             self.restarts += 1
             self._count_restart()
+            self._flight_record("restart", exc, dump_trigger="restart")
             batches_at_restart = self.query.batches_processed
             if self.on_restart is not None:
                 self.on_restart(self.query, exc, self.restarts)

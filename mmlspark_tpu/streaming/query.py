@@ -340,6 +340,19 @@ class StreamingQuery:
                     # with `exception` set — a QuerySupervisor above takes
                     # it from here; the WAL plan keeps a later replay exact
                     self._failed = True
+                    # last chance to get the black box out before the
+                    # loop dies: record the fatal error and dump
+                    try:
+                        from ..observability.recorder import get_recorder
+
+                        rec = get_recorder()
+                        rec.record("streaming.fatal", query=self.name,
+                                   batch_id=self._next_id,
+                                   error=f"{type(e).__name__}: {e}")
+                        rec.trigger_dump("exception", force=True,
+                                         query=self.name)
+                    except Exception:  # noqa: BLE001 — never mask the fail
+                        pass
                     return
                 # interruptible backoff: stop() must not wait it out
                 sess.backoff(wait=self._stop.wait)

@@ -1,0 +1,23 @@
+#' FlightRecorderTransformer (Transformer)
+#'
+#' Wrap a transformer with a flight recorder: every transform appends a structured event (stage, rows, duration, trace_id) to a bounded per-stage ring, the stage latency histogram retains OpenMetrics exemplars linking buckets to trace ids, and an unhandled exception in the wrapped stage dumps the ring to `flight_recorder_dir` (atomic JSONL, `tools/diagnose.py --postmortem` loads it) before re-raising.
+#'
+#' @param x a data.frame or tpu_table
+#' @param inner wrapped transformer stage
+#' @param stage_name event/series label (default: inner class name)
+#' @param flight_recorder_dir directory triggered dumps land in (None: record only)
+#' @param exemplars retain OpenMetrics exemplars on the stage latency histogram
+#' @param ring_capacity flight-recorder ring bound (oldest events evicted)
+#' @param tick_interval_s coarse cadence of metric-delta snapshot events in the ring
+#' @export
+ml_flight_recorder_transformer <- function(x, inner, stage_name = NULL, flight_recorder_dir = NULL, exemplars = TRUE, ring_capacity = 4096L, tick_interval_s = 5.0)
+{
+  params <- list()
+  if (!is.null(inner)) params$inner <- inner
+  if (!is.null(stage_name)) params$stage_name <- as.character(stage_name)
+  if (!is.null(flight_recorder_dir)) params$flight_recorder_dir <- as.character(flight_recorder_dir)
+  if (!is.null(exemplars)) params$exemplars <- as.logical(exemplars)
+  if (!is.null(ring_capacity)) params$ring_capacity <- as.integer(ring_capacity)
+  if (!is.null(tick_interval_s)) params$tick_interval_s <- as.double(tick_interval_s)
+  .tpu_apply_stage("mmlspark_tpu.observability.stage.FlightRecorderTransformer", params, x, is_estimator = FALSE)
+}

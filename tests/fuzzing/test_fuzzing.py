@@ -110,6 +110,40 @@ def test_experiment_fuzzing(stage_name, test_objects):
         experiment_fuzz(to)
 
 
+def test_flight_recorder_dump_mid_fuzz_is_loadable(tmp_path):
+    """A ring dumped MID-FUZZ (the wrapped stage explodes on a fuzz
+    input) must always round-trip through the postmortem parser's
+    schema-validating load — a recorder that writes a dump the
+    postmortem cannot read is worse than no recorder at all."""
+    import os
+
+    import numpy as np
+
+    from mmlspark_tpu.core.schema import Table
+    from mmlspark_tpu.observability import (FlightRecorderTransformer,
+                                            load_dump)
+    from mmlspark_tpu.ops.stages import DropColumns
+    from mmlspark_tpu.resilience import ChaosTransformer
+
+    stage = FlightRecorderTransformer(
+        inner=DropColumns(cols=["b"]), stage_name="fuzz_crash",
+        flight_recorder_dir=str(tmp_path), ring_capacity=32,
+        tick_interval_s=0.0)
+    ab = Table({"a": np.arange(4.0), "b": np.arange(4.0)})
+    stage.transform(ab)  # a healthy pass fills the ring first
+    stage.set(inner=ChaosTransformer(fail_calls=[0]))
+    with pytest.raises(Exception):
+        stage.transform(ab)
+    dumps = sorted(f for f in os.listdir(tmp_path)
+                   if f.startswith("flight-") and f.endswith(".jsonl"))
+    assert dumps, "the exception trigger wrote no dump"
+    for name in dumps:
+        meta, events = load_dump(os.path.join(tmp_path, name))
+        assert meta["trigger"] == "exception"
+        assert any(e["kind"] == "stage.exception" for e in events)
+        assert any(e["kind"] == "stage.transform" for e in events)
+
+
 @pytest.mark.parametrize("stage_name", _ALL_STAGES)
 def test_serialization_fuzzing(stage_name, test_objects, tmp_path):
     """SerializationFuzzing (Fuzzing.scala:108-175): save/load roundtrips of

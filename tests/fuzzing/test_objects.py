@@ -847,7 +847,8 @@ def _resilience_objects(ctx) -> dict[str, list[TestObject]]:
 
 
 def _observability_objects(ctx) -> dict[str, list[TestObject]]:
-    from mmlspark_tpu.observability import InstrumentedTransformer
+    from mmlspark_tpu.observability import (FlightRecorderTransformer,
+                                            InstrumentedTransformer)
     from mmlspark_tpu.ops.stages import DropColumns
 
     ab = Table({"a": np.arange(6.0), "b": np.arange(6.0) * 2})
@@ -856,6 +857,18 @@ def _observability_objects(ctx) -> dict[str, list[TestObject]]:
             TestObject(
                 InstrumentedTransformer(inner=DropColumns(cols=["b"]),
                                         stage_name="fuzz"),
+                transform_table=ab,
+            )],
+        # every recorder knob exercised through the Param surface;
+        # tick_interval_s=0 snapshots metric deltas on EVERY transform so
+        # the fuzz rings carry the densest event mix the schema allows
+        "mmlspark_tpu.observability.stage.FlightRecorderTransformer": [
+            TestObject(
+                FlightRecorderTransformer(
+                    inner=DropColumns(cols=["b"]),
+                    stage_name="fuzz_recorder",
+                    flight_recorder_dir=str(ctx["tmpdir"] / "flightrec"),
+                    exemplars=True, ring_capacity=64, tick_interval_s=0.0),
                 transform_table=ab,
             )],
     }

@@ -713,3 +713,189 @@ class TestFleetChaos:
         assert rv.aggregator.total(_SEEN) == 4.0
         st = rv.aggregator.replica_status()
         assert all(s["final"] and not s["up"] for s in st.values())
+
+
+# --------------------------------------------------------------------- #
+# the flight-recorder chaos soak (ISSUE 10 acceptance)                   #
+# --------------------------------------------------------------------- #
+
+
+def _diagnose():
+    """tools/diagnose.py, imported the way test_r_wrappers reaches tools/."""
+    import pathlib
+    import sys
+
+    tools = str(pathlib.Path(__file__).parents[1] / "tools")
+    if tools not in sys.path:
+        sys.path.insert(0, tools)
+    import diagnose
+
+    return diagnose
+
+
+def _train_hot_model():
+    """A tiny single-feature GBDT so the SAME request body {"x": v}
+    serves both the chaos replicas and the resident hot path."""
+    from mmlspark_tpu.gbdt.estimators import GBDTRegressor
+
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(64, 1)).astype(np.float32).astype(np.float64)
+    y = X[:, 0] * 2.0 + rng.normal(scale=0.05, size=64)
+    return GBDTRegressor(num_iterations=3, num_leaves=4).fit(
+        Table({"features": X, "label": y}))
+
+
+class TestFlightRecorderPostmortem:
+    def test_chaos_soak_burn_trigger_dumps_everywhere(self, tmp_path):
+        """The end-to-end black-box story: 3 chaos replicas + a resident
+        hot-path server behind a real routing gateway; the burn-rate
+        alert makes the driver recorder dump and fan the trigger out to
+        every process; one replica is then killed WITHOUT warning (no
+        drain, no dump possible); the postmortem still reconstructs a
+        single timeline holding the killed replica's final events and an
+        exemplar trace that crossed gateway -> resident executor."""
+        from mmlspark_tpu.io_http.gateway import ServingGateway
+        from mmlspark_tpu.io_http.serving import serve_model
+        from mmlspark_tpu.observability.recorder import (
+            FlightRecorder, set_default_recorder)
+
+        diagnose = _diagnose()
+        fake = FakeClock()
+        dump_dir = tmp_path / "blackbox"
+        dump_dir.mkdir()
+        tracer = Tracer(enabled=True)
+        old_tracer = set_default_tracer(tracer)
+        # the driver's own ring: fleet kill/respawn transitions and the
+        # SLO-burn trigger land here (clock=fake so the burn evaluation
+        # and the dump share a timeline)
+        driver_rec = FlightRecorder(dump_dir=str(dump_dir),
+                                    process="driver", clock=fake,
+                                    dump_cooldown_s=5.0)
+        old_rec = set_default_recorder(driver_rec)
+        fleet = ServingFleet(
+            _chaos_factory, n_hosts=3, clock=fake, stale_after_s=5.0,
+            max_batch_size=1, warmup_request=_WARM_REQ,
+            flight_recorder_dir=str(dump_dir)).start()
+        gateway = hot = None
+        try:
+            rv = fleet.rendezvous
+            deadline = time.monotonic() + 30.0
+            while (time.monotonic() < deadline
+                   and not rv.fleet_health()["all_ready"]):
+                time.sleep(0.05)
+            assert rv.fleet_health()["all_ready"]
+
+            # the fourth pool member hosts the device-resident executor
+            # in-process (fleet workers are handler-based -> route=host)
+            hot = serve_model(
+                _train_hot_model(), ["x"], max_batch_size=1,
+                warmup_request=HTTPRequestData.from_json("/", {"x": 0.5}),
+                exemplars=True, flight_recorder_dir=str(dump_dir))
+            deadline = time.monotonic() + 120.0
+            while time.monotonic() < deadline and not hot.ready:
+                time.sleep(0.02)
+            assert hot.ready
+            assert hot.hot_path is not None and hot.hot_path.disabled is None
+            hot.hot_path.force_path = "resident"
+
+            gateway = ServingGateway(
+                strategy="round_robin", exemplars=True,
+                flight_recorder_dir=str(dump_dir)).attach_fleet(fleet)
+            gateway.admit(hot.url)
+            gateway.start()
+
+            engine = SLOEngine(
+                rv.aggregator,
+                slos=[availability_slo("availability", 0.99,
+                                       total=_SEEN, bad=_FAILED)],
+                clock=fake, windows={"short": 60.0, "long": 600.0},
+                burn_alert_threshold=10.0)
+            engine.attach_recorder(driver_rec)
+            rv.aggregator.scrape()
+            engine.evaluate()  # baseline at t=0
+
+            # the burn-rate dump broadcasts: every process writes its
+            # ring BEFORE the kill lands (a SIGKILLed replica cannot)
+            def _broadcast(trigger, _path):
+                fleet.dump_all(trigger)
+                gateway.recorder.trigger_dump(trigger, force=True)
+                hot.recorder.trigger_dump(trigger, force=True)
+
+            driver_rec.on_dump = _broadcast
+
+            # 16 round-robin requests over 4 targets: each chaos replica
+            # 500s exactly its first live batch, the resident server
+            # answers its 4 on device
+            statuses = []
+            with tracer.start_span("client.request"):
+                for i in range(16):
+                    resp = http_send(HTTPRequestData.from_json(
+                        gateway.url, {"x": float(i)}), retries=1)
+                    statuses.append(resp.status_code)
+            assert statuses.count(500) == 3
+            assert statuses.count(200) == 13
+
+            fake.advance(30.0)
+            rv.aggregator.scrape()
+            res = engine.evaluate()["availability"]
+            assert res["total"] == 12.0 and res["bad"] == 3.0
+            assert res["alerting"]  # 25x burn over the 1% budget
+            # the alert transition dumped the driver ring and fanned out
+            burn_dumps = [p for p in dump_dir.iterdir()
+                          if p.name.startswith("flight-")]
+            assert len(burn_dumps) >= 6  # driver + gateway + hot + 3 replicas
+
+            # -- unannounced kill: no drain, no final dump from replica-0
+            fleet.kill(0)
+            fake.advance(6.0)
+            driver_rec.trigger_dump("drain", force=True)  # holds the kill
+        finally:
+            if gateway is not None:
+                gateway.stop()
+            if hot is not None:
+                hot.stop()
+            fleet.stop()
+            set_default_recorder(old_rec)
+            set_default_tracer(old_tracer)
+
+        # -- one causally-ordered timeline from every process ----------- #
+        dumps = diagnose.load_postmortem_dir(str(dump_dir))
+        processes = {m.get("process") for m, _ in dumps}
+        assert "driver" in processes
+        assert {"replica-0", "replica-1", "replica-2"} <= processes
+        assert any(p.startswith("gateway-") for p in processes)
+        assert any(p.startswith("serving-") for p in processes)
+
+        merged = diagnose._merge_events(dumps)
+        keys = [(e["process"], e["pid"], e["seq"]) for e in merged]
+        assert len(keys) == len(set(keys))  # double dumps dedup
+        order = [(e["ts"], e["tier"], e["pid"], e["seq"]) for e in merged]
+        assert order == sorted(order)
+
+        # the killed replica's final events made it out via the earlier
+        # burn broadcast: its ring holds real scored requests
+        r0 = [e for e in merged if e["process"] == "replica-0"]
+        assert any(e["kind"] == "serving.request" for e in r0)
+        assert any(e["kind"] == "serving.request"
+                   and e["data"].get("status") == 500 for e in r0)
+        # ...and the driver ring holds the kill transition itself
+        assert any(e["kind"] == "transition"
+                   and e["data"].get("component") == "fleet"
+                   and e["data"].get("action") == "kill" for e in merged)
+
+        # -- exemplar attribution crosses gateway -> resident executor -- #
+        rows = diagnose._exemplar_traces(dumps)
+        chains = [r[3] for r in rows]
+        assert any("(gateway)" in c and "(resident)" in c for c in chains), \
+            chains
+        resident_reqs = [e for e in merged
+                         if e["kind"] == "serving.request"
+                         and e["data"].get("route") == "resident"]
+        assert resident_reqs
+        assert all(e["data"].get("trace_id") for e in resident_reqs)
+
+        # -- the human-facing report names the trigger and the casualty - #
+        report = diagnose.postmortem(str(dump_dir))
+        assert "trigger=slo_burn" in report
+        assert "trigger=drain" in report
+        assert "replica-0" in report

@@ -499,3 +499,222 @@ class TestStreamingSoak:
         finally:
             set_default_registry(old)
         assert get_registry() is not mine
+
+
+# --------------------------------------------------------------------- #
+# FlightRecorder: ring, triggers, dumps                                 #
+# --------------------------------------------------------------------- #
+
+
+class TestFlightRecorder:
+    def _rec(self, tmp_path=None, **kw):
+        from mmlspark_tpu.observability import FlightRecorder
+
+        kw.setdefault("clock", FakeClock())
+        if tmp_path is not None:
+            kw.setdefault("dump_dir", str(tmp_path))
+        return FlightRecorder(**kw)
+
+    def test_ring_bounds_and_drop_count(self):
+        rec = self._rec(capacity=4)
+        for i in range(10):
+            rec.record("e", i=i)
+        evs = rec.events()
+        assert [e["data"]["i"] for e in evs] == [6, 7, 8, 9]
+        assert rec.drop_count == 6
+        # seq stays monotone across evictions — the postmortem tiebreaker
+        assert [e["seq"] for e in evs] == [7, 8, 9, 10]
+
+    def test_disarmed_recorder_is_inert(self, tmp_path):
+        rec = self._rec(tmp_path, enabled=False)
+        rec.record("e")
+        rec.record_request(trace_id="t", route="host")
+        assert rec.events() == []
+        assert rec.trigger_dump("anything", force=True) is None
+        assert list(tmp_path.iterdir()) == []
+
+    def test_dump_round_trips_through_schema_load(self, tmp_path):
+        from mmlspark_tpu.observability import load_dump
+
+        reg = MetricsRegistry()
+        reg.counter("mmlspark_tpu_test_total", "t").inc(3)
+        rec = self._rec(tmp_path, registry=reg, process="unit")
+        rec.record_request(trace_id="ab" * 16, route="resident", bucket=8,
+                           queue_depth=2, latency_s=0.004, status=200)
+        rec.record_transition("breaker", "open", breaker="b0")
+        path = rec.dump("manual", note="unit")
+        meta, events = load_dump(path)
+        assert meta["process"] == "unit" and meta["trigger"] == "manual"
+        assert meta["detail"] == {"note": "unit"}
+        assert meta["events"] == 2 and meta["events_dropped"] == 0
+        kinds = [e["kind"] for e in events]
+        # line 2 carries the registry snapshot, then the ring
+        assert kinds == ["metrics.snapshot", "serving.request", "transition"]
+        snap = events[0]["data"]["snapshot"]
+        assert snap["mmlspark_tpu_test_total"]["samples"][0]["value"] == 3.0
+
+    def test_dump_cooldown_and_force(self, tmp_path):
+        clock = FakeClock()
+        rec = self._rec(tmp_path, clock=clock, dump_cooldown_s=30.0)
+        rec.record("e")
+        assert rec.trigger_dump("slo_burn") is not None
+        clock.advance(5.0)
+        assert rec.trigger_dump("slo_burn") is None  # inside the cooldown
+        assert rec.trigger_dump("sigterm", force=True) is not None
+        clock.advance(31.0)
+        assert rec.trigger_dump("slo_burn") is not None
+
+    def test_shed_spike_trigger(self, tmp_path):
+        clock = FakeClock()
+        rec = self._rec(tmp_path, clock=clock, spike_window_s=1.0,
+                        spike_threshold=3, dump_cooldown_s=0.0)
+        assert rec.note_shed() is None
+        clock.advance(2.0)  # the first shed ages out of the window
+        assert rec.note_shed() is None
+        assert rec.note_shed() is None
+        path = rec.note_shed()  # 3 sheds inside 1s -> dump
+        assert path is not None
+        from mmlspark_tpu.observability import load_dump
+
+        meta, events = load_dump(path)
+        assert meta["trigger"] == "shed_spike"
+        assert sum(1 for e in events if e["kind"] == "serving.shed") == 4
+
+    def test_slo_transition_dumps_once_per_alert(self, tmp_path):
+        rec = self._rec(tmp_path, dump_cooldown_s=0.0)
+        assert rec.note_slo([]) is None
+        first = rec.note_slo(["availability"])
+        assert first is not None
+        # still alerting: no new dump until a NEW name joins the set
+        assert rec.note_slo(["availability"]) is None
+        second = rec.note_slo(["availability", "latency"])
+        assert second is not None and second != first
+
+    def test_maybe_tick_records_counter_deltas(self):
+        clock = FakeClock()
+        reg = MetricsRegistry()
+        c = reg.counter("mmlspark_tpu_tick_total", "t")
+        rec = self._rec(clock=clock, tick_interval_s=5.0, registry=reg)
+        c.inc(2)
+        assert rec.maybe_tick()
+        clock.advance(1.0)
+        assert not rec.maybe_tick()  # between ticks: one clock compare
+        clock.advance(5.0)
+        c.inc(3)
+        assert rec.maybe_tick()
+        ticks = [e for e in rec.events() if e["kind"] == "metrics.tick"]
+        assert ticks[0]["data"]["deltas"]["mmlspark_tpu_tick_total"] == 2.0
+        assert ticks[1]["data"]["deltas"]["mmlspark_tpu_tick_total"] == 3.0
+
+    def test_on_dump_callback_and_failure_isolation(self, tmp_path):
+        rec = self._rec(tmp_path)
+        calls = []
+        rec.on_dump = lambda trigger, path: calls.append((trigger, path))
+        p1 = rec.dump("manual")
+        assert calls == [("manual", p1)]
+        rec.on_dump = lambda trigger, path: 1 / 0  # a broken hook
+        assert rec.dump("manual") is not None  # ...keeps the dump
+
+    def test_dump_header_discloses_ring_and_span_loss(self, tmp_path):
+        from mmlspark_tpu.observability import load_dump
+
+        tr = Tracer(clock=FakeClock(), max_spans=2)
+        old = set_default_tracer(tr)
+        try:
+            for i in range(5):
+                with tr.start_span(f"s{i}"):
+                    pass
+            rec = self._rec(tmp_path, capacity=2)
+            for i in range(5):
+                rec.record("e", i=i)
+            meta, _ = load_dump(rec.dump("manual"))
+        finally:
+            set_default_tracer(old)
+        assert meta["events_dropped"] == 3
+        assert meta["spans_lost"] == 3
+        # disclosed loss resets once dumped (the next dump reports fresh)
+        assert rec.drop_count == 0
+
+    def test_load_dump_rejects_bad_schema(self, tmp_path):
+        from mmlspark_tpu.observability import load_dump
+
+        p = tmp_path / "flight-x.jsonl"
+        p.write_text(json.dumps({"kind": "not-a-header"}) + "\n")
+        with pytest.raises(ValueError, match="recorder.meta"):
+            load_dump(str(p))
+        p.write_text(json.dumps(
+            {"kind": "recorder.meta", "schema": 99}) + "\n")
+        with pytest.raises(ValueError, match="unknown dump schema"):
+            load_dump(str(p))
+        p.write_text(json.dumps(
+            {"kind": "recorder.meta", "schema": 1}) + "\n"
+            + json.dumps({"ts": 0.0, "kind": "e"}) + "\n")
+        with pytest.raises(ValueError, match="missing keys"):
+            load_dump(str(p))
+
+
+# --------------------------------------------------------------------- #
+# OpenMetrics exemplars + tracer loss disclosure                        #
+# --------------------------------------------------------------------- #
+
+
+class TestExemplars:
+    def test_histogram_keeps_last_exemplar_per_bucket(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("mmlspark_tpu_lat_seconds", "l", exemplars=True)
+        h.observe(0.004, exemplar={"trace_id": "aa" * 16, "bucket": "8"})
+        h.observe(0.004, exemplar={"trace_id": "bb" * 16, "bucket": "8"})
+        text = reg.render_prometheus()
+        assert "bb" * 16 in text and "aa" * 16 not in text  # last wins
+        assert text.rstrip("\n").endswith("# EOF")
+
+    def test_exemplar_lines_survive_fleet_round_trip(self):
+        from mmlspark_tpu.observability.fleet import (parse_prometheus,
+                                                      render_families)
+
+        reg = MetricsRegistry()
+        h = reg.histogram("mmlspark_tpu_lat_seconds", "l",
+                          labels=("server",), exemplars=True)
+        h.labels(server="s0").observe(
+            0.004, exemplar={"trace_id": "cd" * 16, "route": "resident"})
+        text = reg.render_prometheus()
+        rendered = render_families(parse_prometheus(text))
+        assert rendered.rstrip("\n") == text.rstrip("\n")  # byte-identical
+
+    def test_exemplar_label_set_is_capped(self):
+        from mmlspark_tpu.observability.metrics import EXEMPLAR_LABEL_SET_MAX
+
+        reg = MetricsRegistry()
+        h = reg.histogram("mmlspark_tpu_lat_seconds", "l", exemplars=True)
+        h.observe(0.004, exemplar={"trace_id": "ab" * 16,
+                                   "huge": "x" * 300, "route": "host"})
+        text = reg.render_prometheus()
+        ex_lines = [ln for ln in text.splitlines() if " # {" in ln]
+        assert ex_lines
+        for ln in ex_lines:
+            body = ln.split(" # {", 1)[1].rsplit("}", 1)[0]
+            pairs = [p.split("=", 1) for p in body.split(",") if p]
+            total = sum(len(k) + len(v.strip('"')) for k, v in pairs)
+            assert total <= EXEMPLAR_LABEL_SET_MAX
+            assert "huge" not in body  # the oversized label was dropped
+
+    def test_disabled_exemplars_render_plain(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("mmlspark_tpu_lat_seconds", "l", exemplars=False)
+        h.observe(0.004, exemplar={"trace_id": "ab" * 16})
+        text = reg.render_prometheus()
+        assert " # {" not in text
+        assert not text.rstrip("\n").endswith("# EOF")
+
+    def test_tracer_export_discloses_span_loss(self, tmp_path):
+        tr = Tracer(clock=FakeClock(), max_spans=2)
+        for i in range(5):
+            with tr.start_span(f"s{i}"):
+                pass
+        assert tr.drop_count == 3
+        p = str(tmp_path / "t.jsonl")
+        tr.export_jsonl(p)
+        events = load_jsonl(p)
+        lost = [e for e in events if e["name"] == "tracer.spans_lost"]
+        assert len(lost) == 1
+        assert lost[0]["args"]["count"] == 3

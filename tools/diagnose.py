@@ -282,6 +282,276 @@ def diagnose_serving(url: str) -> str:
     return "\n".join(out)
 
 
+# -- postmortem --------------------------------------------------------- #
+
+# causal tiebreaker for FakeClock timelines: at an identical timestamp a
+# request is observed gateway -> replica -> stage/executor, so the merge
+# orders same-ts events by the dumping process's tier before pid/seq
+_TIER_PREFIXES = (("gateway", 0), ("serving", 1), ("replica", 1),
+                  ("stage", 2))
+
+
+def _process_tier(process: str) -> int:
+    for prefix, tier in _TIER_PREFIXES:
+        if process.startswith(prefix):
+            return tier
+    return 3
+
+
+def load_postmortem_dir(dump_dir: str) -> list[tuple[dict, list[dict]]]:
+    """Every flight-recorder dump in `dump_dir` (schema-validated),
+    sorted by filename so a process's dump_n sequence stays in order."""
+    from mmlspark_tpu.observability.recorder import DUMP_PREFIX, load_dump
+
+    out = []
+    for name in sorted(os.listdir(dump_dir)):
+        if name.startswith(DUMP_PREFIX) and name.endswith(".jsonl"):
+            out.append(load_dump(os.path.join(dump_dir, name)))
+    return out
+
+
+def _merge_events(dumps) -> list[dict]:
+    """One causally-ordered timeline from every process's dumps. A
+    process that dumped more than once repeats its ring contents, so
+    events dedup on (process, pid, seq); the sort key
+    (ts, tier, pid, seq) is FakeClock-safe — simulated clocks produce
+    ties, broken by causal tier then per-process monotone seq."""
+    seen = set()
+    merged = []
+    for meta, events in dumps:
+        process = meta.get("process", "proc")
+        tier = _process_tier(process)
+        for ev in events:
+            key = (process, ev["pid"], ev["seq"])
+            if key in seen:
+                continue
+            seen.add(key)
+            merged.append({**ev, "process": process, "tier": tier})
+    merged.sort(key=lambda e: (e["ts"], e["tier"], e["pid"], e["seq"]))
+    return merged
+
+
+def _event_summary(ev: dict) -> str:
+    d = ev.get("data", {})
+    kind = ev["kind"]
+    if kind == "serving.request":
+        parts = [f"trace={d.get('trace_id') or '-'}",
+                 f"route={d.get('route') or '-'}"]
+        if d.get("bucket") is not None:
+            parts.append(f"bucket={d['bucket']}")
+        if d.get("latency_s") is not None:
+            parts.append(f"lat={d['latency_s'] * 1e3:.2f}ms")
+        parts.append(f"status={d.get('status')}")
+        if d.get("readback_lag") is not None:
+            parts.append(f"readback_lag={d['readback_lag']}")
+        return " ".join(parts)
+    if kind == "transition":
+        extra = {k: v for k, v in d.items()
+                 if k not in ("component", "action") and v is not None}
+        tail = " " + " ".join(
+            f"{k}={v}" for k, v in sorted(extra.items())) if extra else ""
+        return f"{d.get('component')}:{d.get('action')}{tail}"
+    if kind == "metrics.tick":
+        deltas = d.get("deltas", {})
+        top = sorted(deltas.items(), key=lambda kv: -abs(kv[1]))[:4]
+        return "deltas " + " ".join(
+            f"{k.replace('mmlspark_tpu_', '')}+{_fmt(v)}" for k, v in top)
+    if kind == "metrics.snapshot":
+        return f"{len(d.get('snapshot', {}))} families"
+    return " ".join(f"{k}={v}" for k, v in sorted(d.items())
+                    if v is not None) or "-"
+
+
+def _exemplar_traces(dumps) -> list[list[str]]:
+    """The worst-p99 attribution table: highest-bucket latency exemplars
+    from every dump's metrics snapshot, joined through trace_id to the
+    processes whose rings saw that request — a fleet p99 bucket resolved
+    to one exact cross-process trace."""
+    # trace_id -> {process -> route} from the request events
+    routes: dict[str, dict[str, str]] = {}
+    for meta, events in dumps:
+        process = meta.get("process", "proc")
+        for ev in events:
+            if ev["kind"] != "serving.request":
+                continue
+            tid = ev.get("data", {}).get("trace_id")
+            if tid:
+                routes.setdefault(tid, {})[process] = \
+                    ev["data"].get("route") or "-"
+    best: dict[str, tuple[float, str, str]] = {}
+    for meta, events in dumps:
+        process = meta.get("process", "proc")
+        for ev in events:
+            if ev["kind"] != "metrics.snapshot":
+                continue
+            snap = ev.get("data", {}).get("snapshot", {})
+            for name, fam in snap.items():
+                if fam.get("kind") != "histogram":
+                    continue
+                for sample in fam.get("samples", []):
+                    for ex in (sample.get("exemplars") or {}).values():
+                        tid = (ex.get("labels") or {}).get("trace_id")
+                        if not tid:
+                            continue
+                        v = float(ex.get("value", 0.0))
+                        if tid not in best or v > best[tid][0]:
+                            best[tid] = (v, name, process)
+    rows = []
+    for tid, (v, name, process) in sorted(
+            best.items(), key=lambda kv: -kv[1][0]):
+        hops = routes.get(tid, {})
+        chain = " -> ".join(
+            f"{p}({r})" for p, r in sorted(
+                hops.items(),
+                key=lambda pr: (_process_tier(pr[0]), pr[0]))) or "-"
+        rows.append([tid, f"{v * 1e3:.2f}", name.replace(
+            "mmlspark_tpu_", ""), chain])
+    return rows
+
+
+def postmortem(dump_dir: str, tail: int = 200) -> str:
+    """Merge every flight-recorder dump under `dump_dir` into one
+    incident report: trigger matrix with the metric deltas around each
+    trigger, the worst-latency exemplar traces, and the causally-ordered
+    cross-process timeline."""
+    dumps = load_postmortem_dir(dump_dir)
+    if not dumps:
+        return f"(no flight-recorder dumps under {dump_dir})"
+    merged = _merge_events(dumps)
+    processes = sorted({m.get("process", "proc") for m, _ in dumps})
+    lost = sum(m.get("events_dropped", 0) for m, _ in dumps)
+    spans_lost = sum(m.get("spans_lost", 0) for m, _ in dumps)
+    out = [
+        f"postmortem: {len(dumps)} dumps from {len(processes)} processes "
+        f"({', '.join(processes)})",
+        f"{len(merged)} unique events; {lost} ring events lost, "
+        f"{spans_lost} spans lost (not captured below)",
+        "",
+        "triggers:",
+    ]
+    for meta, events in sorted(
+            dumps, key=lambda d: (d[0].get("ts", 0.0),
+                                  _process_tier(d[0].get("process", "")))):
+        detail = meta.get("detail") or {}
+        tail_s = " " + " ".join(
+            f"{k}={v}" for k, v in sorted(detail.items())) if detail else ""
+        out.append(
+            f"  ts={_fmt(meta.get('ts', 0.0), 3)} "
+            f"process={meta.get('process')} "
+            f"trigger={meta.get('trigger')} events={meta.get('events')}"
+            + tail_s)
+        ticks = [e for e in events if e["kind"] == "metrics.tick"]
+        if ticks:
+            out.append(f"      deltas at trigger: "
+                       f"{_event_summary(ticks[-1])}")
+    ex_rows = _exemplar_traces(dumps)
+    if ex_rows:
+        out.append("")
+        out.append("worst-latency exemplar traces:")
+        out.append(_render_table(
+            ex_rows[:8], ["trace_id", "value_ms", "metric", "path"]))
+    out.append("")
+    shown = merged[-tail:] if tail and len(merged) > tail else merged
+    skipped = len(merged) - len(shown)
+    head = "timeline (causally ordered"
+    out.append(head + (f"; first {skipped} events elided):"
+                       if skipped else "):"))
+    for ev in shown:
+        out.append(
+            f"  {_fmt(ev['ts'], 4):>10}  {ev['process']:<14} "
+            f"{ev['kind']:<18} {_event_summary(ev)}")
+    return "\n".join(out)
+
+
+def postmortem_selftest() -> int:
+    """Synthesize a 3-process incident (gateway + 2 replicas on one
+    FakeClock, one replica's final events only in its earlier burn dump),
+    run the postmortem over it, and assert the merged report holds: one
+    ordered timeline, dedup across double dumps, the exemplar trace
+    crossing gateway -> replica, and schema-validating loads."""
+    import tempfile
+
+    from mmlspark_tpu.observability.metrics import MetricsRegistry
+    from mmlspark_tpu.observability.recorder import (FlightRecorder,
+                                                     load_dump)
+    from mmlspark_tpu.resilience.policy import FakeClock
+
+    checks: dict[str, bool] = {}
+    with tempfile.TemporaryDirectory() as d:
+        clock = FakeClock()
+        tid = "cafe" * 8
+        reg = MetricsRegistry()
+        h = reg.histogram("mmlspark_tpu_serving_latency_seconds",
+                          "latency", labels=("server",), exemplars=True)
+        gw = FlightRecorder(dump_dir=d, process="gateway-gw0", clock=clock,
+                            tick_interval_s=0.0, registry=reg)
+        r0 = FlightRecorder(dump_dir=d, process="replica-0", clock=clock,
+                            tick_interval_s=0.0, registry=reg)
+        r1 = FlightRecorder(dump_dir=d, process="replica-1", clock=clock,
+                            tick_interval_s=0.0, registry=reg)
+        clock.advance(1.0)
+        # one request crosses gateway -> replica-0 at the SAME fake ts
+        gw.record_request(trace_id=tid, route="gateway", latency_s=0.2,
+                          status=200)
+        r0.record_request(trace_id=tid, route="resident", bucket=8,
+                          latency_s=0.19, status=200, readback_lag=1)
+        h.labels(server="srv0").observe(0.19, exemplar={"trace_id": tid})
+        r1.record_request(trace_id="beef" * 8, route="host", bucket=1,
+                          latency_s=0.01, status=200)
+        for rec in (gw, r0, r1):
+            rec.maybe_tick(reg)
+        clock.advance(1.0)
+        gw.record_transition("gateway", "eject", url="http://x:1/",
+                             reason="connect")
+        # burn-rate trigger: EVERY process dumps (the broadcast)
+        for rec in (gw, r0, r1):
+            rec.note_slo(["latency"])
+        # replica-1 dies unannounced here (hard kill: no further dump);
+        # its final events exist only in the burn dump above. The rest
+        # drain-dump later, repeating ring contents the merge must dedup.
+        clock.advance(2.0)
+        gw.record_transition("gateway", "eject",
+                             url="http://replica-1.dead/", reason="connect")
+        gw.trigger_dump("drain", force=True)
+        r0.trigger_dump("drain", force=True)
+
+        dumps = load_postmortem_dir(d)
+        checks["5 dumps load (schema-valid)"] = len(dumps) == 5
+        for m, _ in dumps:
+            load_dump(os.path.join(
+                d, f"flight-{m['process']}-{m['pid']}-"
+                   f"{m['dump_n']:03d}.jsonl"))
+        report = postmortem(d)
+        print(report)
+        print()
+        merged = _merge_events(dumps)
+        ts_keys = [(e["ts"], e["tier"], e["pid"], e["seq"]) for e in merged]
+        checks["timeline is ordered"] = ts_keys == sorted(ts_keys)
+        reqs = [e for e in merged if e["kind"] == "serving.request"]
+        checks["dedup across double dumps"] = (
+            len(reqs) == 3 and len(merged) == len({
+                (e["process"], e["pid"], e["seq"]) for e in merged}))
+        gw_i = next(i for i, e in enumerate(merged)
+                    if e["process"].startswith("gateway")
+                    and e["kind"] == "serving.request")
+        rep_i = next(i for i, e in enumerate(merged)
+                     if e["process"] == "replica-0"
+                     and e["kind"] == "serving.request")
+        checks["same-ts gateway precedes replica"] = gw_i < rep_i
+        checks["killed replica's final events present"] = any(
+            e["process"] == "replica-1" for e in merged)
+        checks["exemplar trace crosses gateway->replica"] = (
+            f"gateway-gw0(gateway) -> replica-0(resident)" in report
+            and tid in report)
+        checks["burn trigger in report"] = "trigger=slo_burn" in report
+    failed = [name for name, ok in checks.items() if not ok]
+    if failed:
+        print(f"postmortem selftest FAILED: {failed}", file=sys.stderr)
+        return 1
+    print(f"postmortem selftest OK ({len(checks)} checks)")
+    return 0
+
+
 # -- selftest ----------------------------------------------------------- #
 
 def _selftest_handler(table):
@@ -383,15 +653,36 @@ def selftest() -> int:
 
 def main(argv: "list[str] | None" = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    g = ap.add_mutually_exclusive_group(required=True)
+    g = ap.add_mutually_exclusive_group()
     g.add_argument("--rendezvous", help="FleetRendezvous base URL")
     g.add_argument("--urls", nargs="+", help="replica /metrics URLs")
     g.add_argument("--gateway", help="ServingGateway base URL")
     g.add_argument("--serving", help="ServingServer base URL (hot-path "
                                      "snapshot)")
-    g.add_argument("--selftest", action="store_true",
-                   help="run a 2-replica fleet and diagnose it")
+    # outside the group: `--postmortem --selftest` is the CI smoke for
+    # the postmortem path, `--postmortem DIR` the incident report
+    ap.add_argument("--postmortem", nargs="?", const="", metavar="DIR",
+                    help="merge the flight-recorder dumps under DIR into "
+                         "one incident timeline")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run a 2-replica fleet and diagnose it (with "
+                         "--postmortem: synthetic-incident selftest)")
+    ap.add_argument("--tail", type=int, default=200,
+                    help="timeline events shown by --postmortem DIR")
     args = ap.parse_args(argv)
+    modes = [args.rendezvous, args.urls, args.gateway, args.serving,
+             args.postmortem, args.selftest or None]
+    if not any(m for m in modes):
+        ap.error("pick a mode: --rendezvous/--urls/--gateway/--serving/"
+                 "--postmortem/--selftest")
+    if args.postmortem is not None:
+        if args.selftest:
+            return postmortem_selftest()
+        if not args.postmortem:
+            ap.error("--postmortem needs a dump directory "
+                     "(or --selftest)")
+        print(postmortem(args.postmortem, tail=args.tail))
+        return 0
     if args.selftest:
         return selftest()
     if args.rendezvous:

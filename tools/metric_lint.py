@@ -32,6 +32,15 @@ the static skeleton), and enforces:
      defaults (``_count`` → sum) would multiply them by the number of
      scrape sources. Counters and ``_seconds`` histogram families are
      exempt — both genuinely sum.
+  6. OpenMetrics exemplar syntax (checked against a LIVE exposition the
+     lint renders from an exemplar-enabled registry, then again after a
+     fleet merge): every exemplar rides a ``_bucket`` sample as
+     ``# {labels} value``, its combined label-set length stays within
+     ``EXEMPLAR_LABEL_SET_MAX`` (the OpenMetrics 128-char cap), the
+     exposition ends with the ``# EOF`` terminator whenever exemplars
+     are present, and ``fleet.parse_prometheus`` →
+     ``fleet.render_families`` round-trips the text byte-identically —
+     a renderer drift here would corrupt exemplars at the aggregator.
 
 Usage: python tools/metric_lint.py    # exit 1 with a report if any fail
 """
@@ -141,6 +150,91 @@ def lint_file(path: str) -> list[str]:
     return problems
 
 
+# -- rule 6: OpenMetrics exemplar syntax -------------------------------- #
+
+# `name{labels} value # {exemplar-labels} exemplar-value`
+_EXEMPLAR_LINE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)(?P<labels>\{[^}]*\})? "
+    r"(?P<value>\S+) # \{(?P<ex>[^}]*)\} (?P<ex_value>\S+)$")
+_EX_PAIR_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def lint_exposition(text: str, where: str = "exposition") -> list[str]:
+    """Rule 6 over one rendered exposition: exemplar syntax, the
+    128-char label-set cap, the `# EOF` terminator, and a byte-identical
+    fleet parse -> render round trip."""
+    sys.path.insert(0, ROOT)
+    try:
+        from mmlspark_tpu.observability.fleet import (parse_prometheus,
+                                                      render_families)
+        from mmlspark_tpu.observability.metrics import \
+            EXEMPLAR_LABEL_SET_MAX
+    finally:
+        sys.path.pop(0)
+    problems = []
+    lines = text.splitlines()
+    any_exemplar = False
+    for lineno, line in enumerate(lines, 1):
+        if " # " not in line or line.startswith("#"):
+            continue
+        any_exemplar = True
+        m = _EXEMPLAR_LINE_RE.match(line)
+        if m is None:
+            problems.append(
+                f"{where}:{lineno}: malformed exemplar line {line!r}")
+            continue
+        if "_bucket" not in m.group("name"):
+            problems.append(
+                f"{where}:{lineno}: exemplar on non-bucket sample "
+                f"{m.group('name')!r}")
+        pairs = _EX_PAIR_RE.findall(m.group("ex"))
+        total = sum(len(n) + len(v) for n, v in pairs)
+        if total > EXEMPLAR_LABEL_SET_MAX:
+            problems.append(
+                f"{where}:{lineno}: exemplar label set is {total} chars "
+                f"(cap {EXEMPLAR_LABEL_SET_MAX})")
+        try:
+            float(m.group("ex_value"))
+        except ValueError:
+            problems.append(
+                f"{where}:{lineno}: exemplar value "
+                f"{m.group('ex_value')!r} is not a number")
+    if any_exemplar and (not lines or lines[-1].strip() != "# EOF"):
+        problems.append(
+            f"{where}: exemplars present but no `# EOF` terminator")
+    rendered = render_families(parse_prometheus(text))
+    if rendered.rstrip("\n") != text.rstrip("\n"):
+        problems.append(
+            f"{where}: fleet parse -> render round trip is not "
+            "byte-identical")
+    return problems
+
+
+def lint_exemplars() -> list[str]:
+    """Render a live exemplar-enabled exposition (and its fleet-merged
+    re-render) and run rule 6 over both."""
+    sys.path.insert(0, ROOT)
+    try:
+        from mmlspark_tpu.observability.fleet import (parse_prometheus,
+                                                      render_families)
+        from mmlspark_tpu.observability.metrics import MetricsRegistry
+    finally:
+        sys.path.pop(0)
+    reg = MetricsRegistry()
+    h = reg.histogram("mmlspark_tpu_serving_latency_seconds", "latency",
+                      labels=("server",), exemplars=True)
+    h.labels(server="srv0").observe(
+        0.004, exemplar={"trace_id": "ab" * 16, "route": "resident",
+                         "bucket": "8"})
+    h.labels(server="srv0").observe(
+        2.5, exemplar={"trace_id": "cd" * 16, "route": "host"})
+    text = reg.render_prometheus()
+    problems = lint_exposition(text, where="registry render")
+    merged = render_families(parse_prometheus(text))
+    problems.extend(lint_exposition(merged, where="fleet re-render"))
+    return problems
+
+
 def main() -> None:
     checked = 0
     problems: list[str] = []
@@ -150,12 +244,14 @@ def main() -> None:
         with open(path) as fh:
             checked += sum(1 for line in fh
                            for _ in LITERAL_RE.finditer(line))
+    problems.extend(lint_exemplars())
     if problems:
         print(f"metric_lint: {len(problems)} problem(s):")
         for p in problems:
             print(f"  {p}")
         raise SystemExit(1)
-    print(f"metric_lint: {checked} metric-name literal(s) OK")
+    print(f"metric_lint: {checked} metric-name literal(s) OK; "
+          "exemplar exposition OK (rule 6)")
 
 
 if __name__ == "__main__":
