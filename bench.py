@@ -1654,6 +1654,150 @@ def bench_serving_hot_path() -> dict:
             "crossover": servers["hot"].hot_path.snapshot()["crossover"]}
 
 
+def bench_recommendation_topk() -> dict:
+    """Device-resident SAR top-k serving vs the handler path, PAIRED: the
+    same fitted model served twice (`hot_path=False` is exactly the
+    handler-only server), 32 keep-alive clients posting user ids, the hot
+    server forced onto the `sar_resident` route. Reports requests/sec and
+    client RTT p50/p99 per server, the offline
+    `recommend_for_all_users` sweep as the batch-throughput ceiling, and
+    warmup's paired per-rung timings (the byte-compare pass times BOTH
+    engines on every ladder rung) as resident-vs-host ratios."""
+    import http.client
+
+    from mmlspark_tpu.core.schema import Table
+    from mmlspark_tpu.recommendation import SAR
+    from mmlspark_tpu.recommendation.resident import serve_recommender
+
+    rng = np.random.default_rng(11)
+    n_users, n_items, per_user, k = 512, 256, 24, 10
+    users = np.repeat(np.arange(n_users, dtype=np.float64), per_user)
+    items = np.concatenate([
+        rng.choice(n_items, size=per_user, replace=False)
+        for _ in range(n_users)]).astype(np.float64)
+    model = SAR(support_threshold=1).fit(Table({
+        "user": users, "item": items, "rating": np.ones_like(users)}))
+
+    model.recommend_for_all_users(k=k)         # compile + device upload
+    t0 = time.perf_counter()
+    model.recommend_for_all_users(k=k)
+    offline_rows_per_sec = n_users / (time.perf_counter() - t0)
+
+    bodies = [json.dumps({"user": i % n_users}).encode() for i in range(64)]
+
+    def wait_ready(srv, timeout_s=180.0):
+        deadline = time.monotonic() + timeout_s
+        while not srv.ready:
+            if time.monotonic() > deadline:
+                raise TimeoutError("recommender server never became ready")
+            time.sleep(0.02)
+
+    def drive(srv, n_clients, per_client):
+        rtt, errors = [], []
+        barrier = threading.Barrier(n_clients)
+
+        def client(kk):
+            conn = http.client.HTTPConnection(srv.host, srv.port,
+                                              timeout=60)
+            try:
+                conn.connect()
+                barrier.wait()
+                for i in range(per_client):
+                    body = bodies[(kk * per_client + i) % len(bodies)]
+                    t0 = time.perf_counter()
+                    for attempt in (0, 1):
+                        try:
+                            conn.request("POST", srv.api_path, body=body,
+                                         headers={"Content-Type":
+                                                  "application/json"})
+                            r = conn.getresponse()
+                            r.read()
+                            break
+                        except (OSError, http.client.HTTPException):
+                            conn.close()
+                            conn = http.client.HTTPConnection(
+                                srv.host, srv.port, timeout=60)
+                            if attempt:
+                                raise
+                    if r.status != 200:
+                        errors.append(r.status)
+                    rtt.append(time.perf_counter() - t0)
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(repr(e))
+            finally:
+                conn.close()
+
+        threads = [threading.Thread(target=client, args=(kk,))
+                   for kk in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        if errors:
+            raise RuntimeError(f"recommendation bench clients failed: "
+                               f"{errors[:3]} (+{max(len(errors)-3, 0)})")
+        return rtt, wall
+
+    servers = {
+        "handler": serve_recommender(model, k=k, hot_path=False,
+                                     max_batch_size=256),
+        "hot": serve_recommender(model, k=k, max_batch_size=256),
+    }
+    out = {"offline_rows_per_sec": offline_rows_per_sec}
+    try:
+        for srv in servers.values():
+            wait_ready(srv)
+        hp = servers["hot"].hot_path
+        if hp is None or hp.disabled is not None:
+            raise RuntimeError(
+                "sar hot path unavailable: "
+                + (hp.disabled if hp else "no resident executor"))
+        hp.force_path = "sar_resident"
+        for name, srv in servers.items():
+            drive(srv, 8, 3)                    # warm the connections
+            rtt, wall = drive(srv, 32, 16)
+            rtt_ms = np.asarray(rtt) * 1e3
+            out[f"{name}_rows_per_sec"] = len(rtt) / wall
+            out[f"{name}_rtt_p50_ms"] = float(np.percentile(rtt_ms, 50))
+            out[f"{name}_rtt_p99_ms"] = float(np.percentile(rtt_ms, 99))
+        out["resident_vs_handler_rtt_p50"] = (
+            out["handler_rtt_p50_ms"] / max(out["hot_rtt_p50_ms"], 1e-9))
+        snap = hp.snapshot()
+        assert snap["paths"]["sar_resident"] >= 512, snap["paths"]
+        # paired per-rung ladder: the SAME decoded batch scored through
+        # the full handler path and through the resident executor,
+        # best-of-3 each — the rung-resolution view behind the RTT medians
+        from mmlspark_tpu.core.schema import Table as _T
+        from mmlspark_tpu.io_http.schema import HTTPRequestData
+
+        hot = servers["hot"]
+        req0 = HTTPRequestData.from_json("/", {"user": 0})
+
+        def best_of(fn, reps=3):
+            best = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                fn()
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        by_rung = {}
+        for rung in hot.bucketer.ladder:
+            reqs = [req0] * rung
+            feats = hp.decoder.decode(reqs, rung)
+            t_host = best_of(lambda: hot.handler(_T({"request": reqs})))
+            t_res = best_of(lambda: hp.resident_values(feats, rung))
+            by_rung[str(rung)] = round(t_host / max(t_res, 1e-9), 3)
+        out["resident_vs_host_by_rung"] = by_rung
+        out["crossover"] = snap["crossover"]
+    finally:
+        for srv in servers.values():
+            srv.stop()
+    return out
+
+
 def _write_metrics_snapshot() -> None:
     """Dump the process-default registry next to the bench output so the
     run's counters (executable-cache hits, serving counts, streaming rows)
@@ -1877,6 +2021,12 @@ def _run_suite(platform: str) -> dict:
         print(f"bench: serving hot path bench failed ({e!r})",
               file=sys.stderr)
         hot_serving = None
+    try:
+        rec_topk = bench_recommendation_topk()
+    except Exception as e:  # noqa: BLE001 — recommender row is auxiliary
+        print(f"bench: recommendation topk bench failed ({e!r})",
+              file=sys.stderr)
+        rec_topk = None
     _write_metrics_snapshot()
 
     resident = runner.get("resident_images_per_sec", 0.0)
@@ -2010,6 +2160,23 @@ def _run_suite(platform: str) -> dict:
                 if hot_serving else None),
             "serving_hot_path_crossover": (
                 hot_serving["crossover"] if hot_serving else None),
+            "recommendation_topk_rows_per_sec": _r1(
+                rec_topk, "hot_rows_per_sec"),
+            "recommendation_topk_client_rtt_p50_ms": round(
+                rec_topk["hot_rtt_p50_ms"], 3) if rec_topk else None,
+            "recommendation_topk_client_rtt_p99_ms": round(
+                rec_topk["hot_rtt_p99_ms"], 3) if rec_topk else None,
+            "recommendation_topk_handler_rows_per_sec": _r1(
+                rec_topk, "handler_rows_per_sec"),
+            "recommendation_topk_handler_rtt_p50_ms": round(
+                rec_topk["handler_rtt_p50_ms"], 3) if rec_topk else None,
+            "recommendation_topk_resident_vs_handler_rtt_p50": round(
+                rec_topk["resident_vs_handler_rtt_p50"], 3)
+                if rec_topk else None,
+            "recommendation_topk_offline_rows_per_sec": _r1(
+                rec_topk, "offline_rows_per_sec"),
+            "recommendation_topk_resident_vs_host_by_rung": (
+                rec_topk["resident_vs_host_by_rung"] if rec_topk else None),
             "headroom_note": (
                 "gbdt fit is HBM-bound (see gbdt_modeled_hbm_* vs chip peak); "
                 "end-to-end runner throughput is host->device transfer bound: "

@@ -44,10 +44,14 @@ class MetricConstants:
     ACCURACY = "accuracy"
     PRECISION = "precision"
     RECALL = "recall"
+    NDCG = "ndcgAt"
+    MAP = "map"
+    MRR = "mrr"
     ALL = "all"
 
     CLASSIFICATION_METRICS = [AUC, ACCURACY, PRECISION, RECALL]
     REGRESSION_METRICS = [MSE, RMSE, R2, MAE]
+    RANKING_METRICS = [NDCG, MAP, MRR, "precisionAtk", "recallAtK"]
 
 
 @partial(jax.jit, static_argnames=("num_classes",))
@@ -104,18 +108,57 @@ class ComputeModelStatistics(Transformer):
     label_col = Param("label", "true-label column", ptype=str)
     scores_col = Param(None, "raw score / probability column (binary)", ptype=str)
     scored_labels_col = Param("scored_labels", "predicted-label column", ptype=str)
-    evaluation_metric = Param("all", "classification | regression | all | <metric>", ptype=str)
+    evaluation_metric = Param("all", "classification | regression | ranking "
+                              "| all | <metric>", ptype=str)
+    k = Param(10, "ranking cutoff for the @k metrics", ptype=int)
 
     # most recent confusion matrix (reference keeps it as a side output)
     confusion_matrix: np.ndarray | None = None
 
     def _transform(self, table: Table) -> Table:
-        labels = np.asarray(table[self.get("label_col")], np.float64)
         metric = self.get("evaluation_metric")
+        # ranking tables carry RAGGED per-user id lists in the label
+        # column — they must branch BEFORE the dense float64 label cast
+        if (metric in MetricConstants.RANKING_METRICS + ["ranking"]
+                or self._is_ranking(table)):
+            return self._ranking(table)
+        labels = np.asarray(table[self.get("label_col")], np.float64)
         is_classification = self._infer_is_classification(table, labels, metric)
         if is_classification:
             return self._classification(table, labels)
         return self._regression(table, labels)
+
+    def _is_ranking(self, table: Table) -> bool:
+        """Auto-detect a RankingAdapterModel-shaped table: the label
+        column holds per-user item-id LISTS, not scalars."""
+        if self.get("evaluation_metric") not in ("all", "ranking"):
+            return False
+        col = self.get("label_col")
+        if col not in table:
+            return False
+        vals = table[col]
+        if isinstance(vals, np.ndarray) and vals.ndim >= 2:
+            return True
+        head = next(iter(vals), None)
+        return isinstance(head, (list, tuple, np.ndarray))
+
+    def _ranking(self, table: Table) -> Table:
+        """NDCG/MAP@k/MRR (+precision/recall@k, fcp) over per-user
+        recommendation lists, via `recommendation.ranking_metrics` —
+        consumes RankingAdapterModel output (`prediction`/`label` id
+        lists) directly."""
+        from ..recommendation.ranking import ranking_metrics
+
+        pred_col = self.get("scores_col") or self.get("scored_labels_col")
+        if pred_col not in table and "prediction" in table:
+            pred_col = "prediction"
+        preds = [list(np.asarray(p).astype(np.int64))
+                 for p in table[pred_col]]
+        labels = [list(np.asarray(v).astype(np.int64))
+                  for v in table[self.get("label_col")]]
+        row = {name: float(v) for name, v in ranking_metrics(
+            preds, labels, k=int(self.get("k"))).items()}
+        return Table.from_rows([row])
 
     def _infer_is_classification(self, table: Table, labels: np.ndarray, metric: str) -> bool:
         if metric in MetricConstants.CLASSIFICATION_METRICS + ["classification"]:

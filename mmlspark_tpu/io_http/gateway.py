@@ -152,6 +152,13 @@ class ServingGateway:
             "mmlspark_tpu_gateway_admissions_total",
             "replicas (re)admitted into rotation",
             labels=("server",)).labels(**lbl)
+        # which routing strategy placed each request — "hash" counts the
+        # sticky (x-routing-key) traffic, e.g. SAR consistent-hash-by-user,
+        # separately from the default strategy's
+        self._c_routed = self.metrics.counter(
+            "mmlspark_tpu_gateway_routed_total",
+            "requests placed on a replica, by routing strategy",
+            labels=("server", "strategy"))
         self._g_live = self.metrics.gauge(
             "mmlspark_tpu_gateway_replicas_live_count",
             "replicas currently in rotation",
@@ -298,6 +305,8 @@ class ServingGateway:
         live replica could take answers 503; both attempts dying on
         connection errors answers 502."""
         strategy = "hash" if key is not None else self.strategy
+        self._c_routed.labels(server=self.server_label,
+                              strategy=strategy).inc()
 
         def _on_failover(url: str, _resp) -> None:
             self._c_hedges.inc()
@@ -327,6 +336,10 @@ class ServingGateway:
             "hedge": self.hedge,
             "n_targets": len(states),
             "n_live": sum(1 for s in states.values() if s["live"]),
+            "strategy_requests": {
+                vals[1]: int(c.value)
+                for vals, c in self._c_routed.children()
+                if vals[0] == self.server_label},
             "targets": states,
         }
 

@@ -129,6 +129,11 @@ class _HotPath:
     # timing repetitions per rung when measuring the crossover
     WARM_REPS = 3
 
+    # the route label this hot path's resident lane reports under —
+    # subclasses serving other workloads (recommendation.resident's
+    # SARHotPath) override it so serving_path_total separates workloads
+    resident_label = "resident"
+
     def __init__(self, executor, decoder: RequestDecoder, feature_col: str,
                  output_col: str, native_fn=None, readback_lag: int = 1):
         self.executor = executor
@@ -137,14 +142,14 @@ class _HotPath:
         self.output_col = output_col
         self.native_fn = native_fn
         self.readback_lag = max(int(readback_lag), 0)
-        # bucket rung -> "native" | "resident", learned by warm_rung
+        # bucket rung -> resident_label | "native", learned by warm_rung
         self.crossover: dict[int, str] = {}
         self.timings_ms: dict[int, dict[str, float]] = {}
         self.disabled: "str | None" = None
-        # test hook: pin every batch to one route ("resident"/"native"/
-        # "host") regardless of the crossover
+        # test hook: pin every batch to one route (resident_label/
+        # "native"/"host") regardless of the crossover
         self.force_path: "str | None" = None
-        self.path_requests = {"resident": 0, "native": 0, "host": 0}
+        self.path_requests = {self.resident_label: 0, "native": 0, "host": 0}
         self.resident_batches = 0
 
     def route_for(self, bucket: int) -> str:
@@ -170,9 +175,15 @@ class _HotPath:
     def native_values(self, feats: np.ndarray) -> np.ndarray:
         return np.asarray(self.native_fn(feats), np.float64)
 
-    def resident_values(self, feats: np.ndarray, n_valid: int) -> np.ndarray:
-        outs = self.executor.dispatch({self.feature_col: feats})
+    def fetch_values(self, outs, n_valid: int):
+        """Block on one in-flight batch's device results and return
+        whatever `replies_for` consumes — subclasses with a different
+        reply schema override both as a pair."""
         return self.executor.fetch(outs, n_valid)[self.output_col]
+
+    def resident_values(self, feats: np.ndarray, n_valid: int):
+        outs = self.executor.dispatch({self.feature_col: feats})
+        return self.fetch_values(outs, n_valid)
 
     def warm_rung(self, handler, request: HTTPRequestData, rung: int,
                   expect_entities: list) -> None:
@@ -216,7 +227,7 @@ class _HotPath:
         if [r.entity for r in self.replies_for(vals)] != expect:
             self.disabled = f"resident replies diverge at rung {rung}"
             return
-        t = {"resident": self._time(
+        t = {self.resident_label: self._time(
             lambda: self.resident_values(feats, rung))}
         if self.native_fn is not None:
             try:
@@ -251,10 +262,11 @@ class _HotPath:
         trip-per-request bar is `round_trips_per_resident_request` (each
         resident BATCH costs exactly one upload+readback pair, shared by
         every request coalesced into it)."""
-        res_req = self.path_requests.get("resident", 0)
+        res_req = self.path_requests.get(self.resident_label, 0)
         return {
             "enabled": self.disabled is None,
             "disabled_reason": self.disabled,
+            "resident_label": self.resident_label,
             "crossover": {str(b): p
                           for b, p in sorted(self.crossover.items())},
             "timings_ms": {str(b): {k: round(v, 4) for k, v in t.items()}
@@ -1033,7 +1045,7 @@ class ServingServer:
             if hp is not None:
                 route = hp.route_for(target)
                 self._stamp_route(batch, route, target)
-                if route == "resident" and not self._score_resident(
+                if route == hp.resident_label and not self._score_resident(
                         batch, target, readback):
                     # batch outside the cached schema or the device
                     # precondition — the native walk is exact for ANY
@@ -1100,8 +1112,7 @@ class ServingServer:
         outs, batch = item
         hp = self.hot_path
         try:
-            vals = hp.executor.fetch(outs, len(batch))[hp.output_col]
-            replies = hp.replies_for(vals)
+            replies = hp.replies_for(hp.fetch_values(outs, len(batch)))
         except Exception as e:  # noqa: BLE001 — batch failure -> 500s
             self._c_failed.inc(len(batch))
             replies = [_handler_error_response(e)] * len(batch)
@@ -1308,7 +1319,7 @@ def _build_hot_path(model, decoder: RequestDecoder,
 
 def serve_model(
     model,
-    input_cols: list[str],
+    input_cols: "list[str] | None" = None,
     output_col: str = "prediction",
     host: str = "127.0.0.1",
     port: int = 0,
@@ -1333,9 +1344,24 @@ def serve_model(
     executor and the native tree walk per the bucket crossover measured
     at warmup — byte-identical replies with no per-request re-staging.
     It silently stays on the handler path whenever the model cannot host
-    a resident session."""
+    a resident session.
+
+    A fitted `SARModel` delegates to `recommendation.resident
+    .serve_recommender` — same warmup/byte-identity/readback contract,
+    top-k reply schema (`input_cols`/`output_col` are implied by the
+    model and ignored)."""
     from ..core.fusion import FusedPipelineModel
     from ..core.pipeline import PipelineModel
+    from ..recommendation.sar import SARModel
+
+    if isinstance(model, SARModel):
+        from ..recommendation.resident import serve_recommender
+
+        return serve_recommender(model, host=host, port=port, mesh=mesh,
+                                 hot_path=hot_path, **server_kw)
+
+    if input_cols is None:
+        raise TypeError("serve_model requires input_cols for this model")
 
     if (fuse_pipeline and isinstance(model, PipelineModel)
             and not isinstance(model, FusedPipelineModel)):

@@ -306,12 +306,21 @@ class FlightRecorder:
             dropped, self._dropped = self._dropped, 0
             self._dump_count += 1
             n = self._dump_count
+        # per-route request breakdown of the dumped ring (resident /
+        # sar_resident / native / host): the postmortem can attribute an
+        # incident to one serving route without re-scanning every event
+        route_counts: dict[str, int] = {}
+        for ev in events:
+            if ev["kind"] == "serving.request":
+                r = ev["data"].get("route") or "-"
+                route_counts[r] = route_counts.get(r, 0) + 1
         meta = {"kind": "recorder.meta", "schema": DUMP_SCHEMA_VERSION,
                 "trigger": trigger, "detail": detail,
                 "process": self.process, "pid": pid,
                 "ts": self._clock.monotonic(),
                 "events": len(events), "events_dropped": dropped,
-                "spans_lost": spans_lost, "dump_n": n}
+                "spans_lost": spans_lost, "dump_n": n,
+                "route_counts": route_counts}
         os.makedirs(self.dump_dir, exist_ok=True)
         path = os.path.join(
             self.dump_dir, f"{DUMP_PREFIX}{self.process}-{pid}-{n:03d}.jsonl")

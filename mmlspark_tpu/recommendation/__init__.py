@@ -16,14 +16,17 @@ from .ranking import (
     RankingTrainValidationSplit,
     ranking_metrics,
 )
+from .resident import SARTopKScorer, serve_recommender
 
 __all__ = [
     "RecommendationIndexer",
     "RecommendationIndexerModel",
     "SAR",
     "SARModel",
+    "SARTopKScorer",
     "RankingAdapter",
     "RankingEvaluator",
     "RankingTrainValidationSplit",
     "ranking_metrics",
+    "serve_recommender",
 ]

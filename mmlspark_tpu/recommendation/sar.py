@@ -18,6 +18,7 @@ recommendations.
 from __future__ import annotations
 
 from datetime import datetime
+from functools import partial
 from typing import Any
 
 import jax
@@ -30,6 +31,24 @@ from ..core.schema import Table
 from ..core.serialize import register_stage
 
 __all__ = ["SAR", "SARModel"]
+
+
+# Module-level jitted scoring programs: jax.jit caches the executable per
+# input shape, so repeated SARModel calls neither re-trace nor recompile.
+@jax.jit
+def _affinity_scores(affinity, similarity):
+    return affinity @ similarity
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _block_topk(affinity_rows, similarity, k):
+    return jax.lax.top_k(affinity_rows @ similarity, k)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _block_topk_unseen(affinity_rows, similarity, seen_rows, k):
+    scores = affinity_rows @ similarity
+    return jax.lax.top_k(jnp.where(seen_rows, -jnp.inf, scores), k)
 
 
 def _to_minutes(values, fmt: str | None) -> np.ndarray:
@@ -159,32 +178,76 @@ class SARModel(Model):
     item_similarity: np.ndarray | None = None  # (I, I) float32
     seen: np.ndarray | None = None             # (U, I) bool
 
+    # device copies of the host arrays, uploaded once and reused across
+    # calls; None until first use and after _load_state
+    _device_cache: "dict[str, Any] | None" = None
+
+    # rows per device block in recommend_for_all_users: bounds peak device
+    # memory at block×I instead of U×I
+    USER_BLOCK = 4096
+
+    def _device_arrays(self) -> dict[str, Any]:
+        if self._device_cache is None:
+            self._device_cache = {
+                "affinity": jnp.asarray(self.user_affinity),
+                "similarity": jnp.asarray(self.item_similarity),
+                "seen": (jnp.asarray(self.seen)
+                         if self.seen is not None else None),
+            }
+        return self._device_cache
+
+    def invalidate_device_cache(self) -> None:
+        self._device_cache = None
+
     def _scores(self) -> jnp.ndarray:
-        return jax.jit(lambda a, s: a @ s)(
-            jnp.asarray(self.user_affinity), jnp.asarray(self.item_similarity)
-        )
+        dev = self._device_arrays()
+        return _affinity_scores(dev["affinity"], dev["similarity"])
 
     def _transform(self, table: Table) -> Table:
-        """Per (user, item) row: predicted affinity score."""
+        """Per (user, item) row: predicted affinity score. Gathers only the
+        requested users' affinity rows — one (n_requested × I) matmul, never
+        the full U×I score matrix."""
         u = np.asarray(table[self.get("user_col")], np.int64)
         it = np.asarray(table[self.get("item_col")], np.int64)
-        scores = np.asarray(self._scores())
-        n_u, n_i = scores.shape
+        n_u, n_i = self.user_affinity.shape
         valid = (u >= 0) & (u < n_u) & (it >= 0) & (it < n_i)
         pred = np.zeros(len(u), np.float64)
-        pred[valid] = scores[u[valid], it[valid]]
+        if valid.any():
+            dev = self._device_arrays()
+            users, pos = np.unique(u[valid], return_inverse=True)
+            rows = np.asarray(_affinity_scores(
+                dev["affinity"][jnp.asarray(users)], dev["similarity"]))
+            pred[valid] = rows[pos, it[valid]]
         return table.with_column(self.get("prediction_col"), pred)
 
-    def recommend_for_all_users(self, k: int, remove_seen: bool = True) -> Table:
+    def recommend_for_all_users(self, k: int, remove_seen: bool = True,
+                                user_block: int | None = None) -> Table:
         """Reference: SARModel.recommendForAllUsers (SARModel.scala:95-130).
-        Returns Table{user, recommendations, ratings} with top-k item ids."""
-        scores = self._scores()
-        if remove_seen and self.seen is not None:
-            scores = jnp.where(jnp.asarray(self.seen), -jnp.inf, scores)
-        k = min(k, scores.shape[1])
-        vals, idx = jax.jit(lambda s: jax.lax.top_k(s, k))(scores)
-        vals = np.asarray(vals, np.float64)
-        idx = np.asarray(idx, np.int64)
+        Returns Table{user, recommendations, ratings} with top-k item ids.
+
+        Scores `user_block` users at a time so peak device memory is
+        block×I rather than U×I; matmul rows and top_k are row-independent,
+        so the blocked result is byte-identical to the single big matmul."""
+        dev = self._device_arrays()
+        n_users, n_items = self.user_affinity.shape
+        k = min(k, n_items)
+        block = user_block or self.USER_BLOCK
+        mask_seen = remove_seen and dev["seen"] is not None
+        vals_parts, idx_parts = [], []
+        for lo in range(0, n_users, block):
+            hi = min(lo + block, n_users)
+            aff = dev["affinity"][lo:hi]
+            if mask_seen:
+                v, i = _block_topk_unseen(
+                    aff, dev["similarity"], dev["seen"][lo:hi], k)
+            else:
+                v, i = _block_topk(aff, dev["similarity"], k)
+            vals_parts.append(np.asarray(v, np.float64))
+            idx_parts.append(np.asarray(i, np.int64))
+        vals = (np.concatenate(vals_parts) if vals_parts
+                else np.zeros((0, k), np.float64))
+        idx = (np.concatenate(idx_parts) if idx_parts
+               else np.zeros((0, k), np.int64))
         # users with fewer than k unseen items: top_k still returns the
         # -inf (seen) entries — mark them invalid (id -1) instead of
         # leaking seen items back as 0-rated recommendations
@@ -192,7 +255,7 @@ class SARModel(Model):
         idx = np.where(invalid, -1, idx)
         vals = np.where(invalid, 0.0, vals)
         return Table({
-            self.get("user_col"): np.arange(scores.shape[0], dtype=np.float64),
+            self.get("user_col"): np.arange(n_users, dtype=np.float64),
             "recommendations": idx,
             "ratings": vals,
         })
@@ -209,3 +272,4 @@ class SARModel(Model):
         self.item_similarity = np.asarray(state["item_similarity"], np.float32)
         seen = state.get("seen")
         self.seen = None if seen is None else np.asarray(seen, bool)
+        self.invalidate_device_cache()

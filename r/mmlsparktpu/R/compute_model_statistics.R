@@ -6,14 +6,16 @@
 #' @param label_col true-label column
 #' @param scores_col raw score / probability column (binary)
 #' @param scored_labels_col predicted-label column
-#' @param evaluation_metric classification | regression | all | <metric>
+#' @param evaluation_metric classification | regression | ranking | all | <metric>
+#' @param k ranking cutoff for the @k metrics
 #' @export
-ml_compute_model_statistics <- function(x, label_col = "label", scores_col = NULL, scored_labels_col = "scored_labels", evaluation_metric = "all")
+ml_compute_model_statistics <- function(x, label_col = "label", scores_col = NULL, scored_labels_col = "scored_labels", evaluation_metric = "all", k = 10L)
 {
   params <- list()
   if (!is.null(label_col)) params$label_col <- as.character(label_col)
   if (!is.null(scores_col)) params$scores_col <- as.character(scores_col)
   if (!is.null(scored_labels_col)) params$scored_labels_col <- as.character(scored_labels_col)
   if (!is.null(evaluation_metric)) params$evaluation_metric <- as.character(evaluation_metric)
+  if (!is.null(k)) params$k <- as.integer(k)
   .tpu_apply_stage("mmlspark_tpu.automl.metrics.ComputeModelStatistics", params, x, is_estimator = FALSE)
 }

@@ -1,0 +1,17 @@
+#' SARTopKScorer (Model)
+#'
+#' Top-k recommendation scoring as a fusable pipeline stage.
+#'
+#' @param x a data.frame or tpu_table
+#' @param user_col request field carrying the user id
+#' @param k recommendations per user
+#' @param remove_seen mask items the user already interacted with
+#' @export
+ml_sar_top_k_scorer <- function(x, user_col = "user", k = 10L, remove_seen = TRUE)
+{
+  params <- list()
+  if (!is.null(user_col)) params$user_col <- as.character(user_col)
+  if (!is.null(k)) params$k <- as.integer(k)
+  if (!is.null(remove_seen)) params$remove_seen <- as.logical(remove_seen)
+  .tpu_apply_stage("mmlspark_tpu.recommendation.resident.SARTopKScorer", params, x, is_estimator = FALSE)
+}

@@ -513,6 +513,7 @@ def _recommendation_objects(ctx) -> dict[str, list[TestObject]]:
         RankingEvaluator,
         RankingTrainValidationSplit,
         RecommendationIndexer,
+        SARTopKScorer,
     )
 
     inter = _interactions()
@@ -538,6 +539,12 @@ def _recommendation_objects(ctx) -> dict[str, list[TestObject]]:
             SAR(support_threshold=1),
             fit_table=inter,
             model_class="mmlspark_tpu.recommendation.sar.SARModel",
+        )],
+        "mmlspark_tpu.recommendation.resident.SARTopKScorer": [TestObject(
+            SARTopKScorer.from_model(
+                SAR(support_threshold=1).fit(inter), k=3,
+            ),
+            transform_table=Table({"user": np.asarray([0.0, 1.0, 5.0])}),
         )],
         "mmlspark_tpu.recommendation.ranking.RankingAdapter": [TestObject(
             RankingAdapter(recommender=SAR(support_threshold=1), k=3),

@@ -311,6 +311,69 @@ class TestMetrics:
         row = next(out.rows())
         assert row[MetricConstants.ACCURACY] == 0.75
 
+    def test_ranking_metrics_hand_computed(self):
+        """Ranking branch pinned against hand-computed values @k=3.
+
+        user A: preds [1, 9, 8], labels [1]    -> hit at rank 1:
+                p=1/3, r=1/3, ndcg=1, ap=1, mrr=1
+        user B: preds [5, 2, 7], labels [2, 5] -> hits at ranks 1, 2:
+                p=2/3, r=2/3, ndcg=1, ap=1, mrr=1 (fcp 0: order flipped)
+        user C: preds [4, 6, 0], labels [9]    -> no hits: all 0
+        """
+        t = Table({
+            "prediction": [[1, 9, 8], [5, 2, 7], [4, 6, 0]],
+            "label": [[1], [2, 5], [9]],
+        })
+        cms = ComputeModelStatistics(evaluation_metric="ranking", k=3)
+        row = next(cms.transform(t).rows())
+        assert row["precisionAtk"] == pytest.approx(1 / 3)
+        assert row["recallAtK"] == pytest.approx(1 / 3)
+        assert row[MetricConstants.NDCG] == pytest.approx(2 / 3)
+        assert row[MetricConstants.MAP] == pytest.approx(2 / 3)
+        assert row[MetricConstants.MRR] == pytest.approx(2 / 3)
+        assert row["fcp"] == 0.0
+
+    def test_ranking_auto_detected_from_ragged_labels(self):
+        """evaluation_metric='all' on a RankingAdapter-shaped table (id
+        LISTS in the label column) must branch to ranking, not crash on
+        the dense float64 label cast."""
+        t = Table({
+            "prediction": [[1, 9, 8], [5, 2, 7], [4, 6, 0]],
+            "label": [[1], [2, 5], [9]],
+        })
+        row = next(ComputeModelStatistics(k=3).transform(t).rows())
+        assert row[MetricConstants.MRR] == pytest.approx(2 / 3)
+
+    def test_ranking_single_metric_name_selects_branch(self):
+        t = Table({
+            "prediction": [[1, 9, 8]],
+            "label": [[1]],
+        })
+        cms = ComputeModelStatistics(
+            evaluation_metric=MetricConstants.NDCG, k=3)
+        row = next(cms.transform(t).rows())
+        assert row[MetricConstants.NDCG] == 1.0
+
+    def test_ranking_end_to_end_through_adapter(self):
+        """The notebook flow: RankingAdapter scores held-out users, CMS
+        consumes its output directly and agrees with RankingEvaluator."""
+        from mmlspark_tpu.recommendation import (SAR, RankingAdapter,
+                                                 RankingEvaluator)
+
+        rng = np.random.default_rng(4)
+        rows = [(float(u), float(i), 1.0)
+                for u in range(12)
+                for i in rng.choice(10, size=5, replace=False)]
+        arr = np.asarray(rows, np.float64)
+        t = Table({"user": arr[:, 0], "item": arr[:, 1],
+                   "rating": arr[:, 2]})
+        scored = RankingAdapter(
+            recommender=SAR(support_threshold=1), k=3).fit(t).transform(t)
+        row = next(ComputeModelStatistics(
+            evaluation_metric="ranking", k=3).transform(scored).rows())
+        want = RankingEvaluator(k=3, metric_name="ndcgAt").evaluate(scored)
+        assert row[MetricConstants.NDCG] == pytest.approx(want)
+
 
 class TestReviewRegressions:
     def test_interval_zero_rejected(self):

@@ -186,6 +186,54 @@ def serving_resident_build(n, n_data=0):
     return rex.aot_args({"features": np.zeros((1, 8), np.float64)}, n)
 
 
+def _sar_resident_executor(n_data=0):
+    """A ResidentExecutor over a tiny fitted SAR top-k scorer, fused under
+    a `n_data x 1` mesh (0 = single device). Cached per mesh shape."""
+    key = ("sar", n_data)
+    if key in _RESIDENT:
+        return _RESIDENT[key]
+    import numpy as np
+
+    from mmlspark_tpu.core.fusion import fuse
+    from mmlspark_tpu.core.pipeline import PipelineModel
+    from mmlspark_tpu.core.schema import Table
+    from mmlspark_tpu.recommendation import SAR, SARTopKScorer
+
+    if "sar_model" not in _RESIDENT:
+        rng = np.random.default_rng(5)
+        rows = [(float(u), float(i), 1.0)
+                for u in range(32) for i in rng.choice(24, 6, replace=False)]
+        arr = np.asarray(rows, np.float64)
+        _RESIDENT["sar_model"] = SAR(support_threshold=1).fit(Table({
+            "user": arr[:, 0], "item": arr[:, 1], "rating": arr[:, 2]}))
+    mesh = None
+    if n_data:
+        from mmlspark_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(n_data=n_data, n_model=1,
+                         devices=jax.devices()[:n_data])
+    scorer = SARTopKScorer.from_model(_RESIDENT["sar_model"], k=10)
+    fused = fuse(PipelineModel([scorer]), mesh=mesh)
+    rex = fused.resident_executor()
+    if isinstance(rex, str):
+        raise RuntimeError(f"no resident executor: {rex}")
+    _RESIDENT[key] = rex
+    return rex
+
+
+def sar_resident_build(n, n_data=0):
+    """The SAR recommender hot path's resident executable at ONE rung.
+
+    serve_recommender pins user-affinity and item-similarity on device and
+    routes decoded user-id batches onto these fused
+    gather -> matmul -> seen-mask -> top_k programs; warmup compiles the
+    full ladder before /readyz flips, so every rung must AOT-compile."""
+    import numpy as np
+
+    rex = _sar_resident_executor(n_data)
+    return rex.aot_args({"features": np.zeros((1, 1), np.float64)}, n)
+
+
 def main():
     dev = jax.devices()[0]
     print(f"device: {dev.platform} {getattr(dev, 'device_kind', '?')}",
@@ -236,6 +284,19 @@ def main():
         for bucket in ShapeBucketer(64, multiple_of=n_data).ladder:
             gate(f"serving_resident_b{bucket}_mesh{n_data}x1",
                  lambda n=bucket, d=n_data: serving_resident_build(n, d))
+
+    # SAR recommender hot path: the device-resident top-k ladder
+    # (recommendation/resident.py), single-device and sharded over each
+    # pure-data mesh — same contract as the GBDT rungs above
+    for bucket in ShapeBucketer(64).ladder:
+        gate(f"sar_resident_b{bucket}",
+             lambda n=bucket: sar_resident_build(n))
+    for n_data, n_model in mesh_shapes:
+        if n_model != 1:
+            continue  # the SAR kernel shards rows over data only
+        for bucket in ShapeBucketer(64, multiple_of=n_data).ladder:
+            gate(f"sar_resident_b{bucket}_mesh{n_data}x1",
+                 lambda n=bucket, d=n_data: sar_resident_build(n, d))
 
     n_fail = sum(1 for _, v, _, _ in VERDICTS if v == "FAIL")
     print(f"\nAOT GATE SUMMARY: {len(VERDICTS) - n_fail}/{len(VERDICTS)} "

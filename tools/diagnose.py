@@ -254,7 +254,11 @@ def diagnose_serving(url: str) -> str:
         return "\n".join(out)
     state = ("enabled" if hp.get("enabled")
              else f"DISABLED ({hp.get('disabled_reason')})")
-    out.append(f"hot path: {state} readback_lag={hp.get('readback_lag')}")
+    # the resident lane's route label: "resident" for the GBDT walk,
+    # "sar_resident" for the recommendation top-k path
+    label = hp.get("resident_label") or "resident"
+    out.append(f"hot path: {state} resident_label={label} "
+               f"readback_lag={hp.get('readback_lag')}")
     timings = hp.get("timings_ms") or {}
     rows = []
     for bucket, route in sorted((hp.get("crossover") or {}).items(),
@@ -262,7 +266,7 @@ def diagnose_serving(url: str) -> str:
         t = timings.get(bucket, {})
         rows.append([bucket, route,
                      _fmt(t.get("native", float("nan")), 3),
-                     _fmt(t.get("resident", float("nan")), 3)])
+                     _fmt(t.get(label, float("nan")), 3)])
     if rows:
         out.append(_render_table(
             rows, ["bucket", "route", "native_ms", "resident_ms"]))
@@ -435,11 +439,14 @@ def postmortem(dump_dir: str, tail: int = 200) -> str:
         detail = meta.get("detail") or {}
         tail_s = " " + " ".join(
             f"{k}={v}" for k, v in sorted(detail.items())) if detail else ""
+        rc = meta.get("route_counts") or {}
+        routes_s = (" routes[" + " ".join(
+            f"{k}={v}" for k, v in sorted(rc.items())) + "]") if rc else ""
         out.append(
             f"  ts={_fmt(meta.get('ts', 0.0), 3)} "
             f"process={meta.get('process')} "
             f"trigger={meta.get('trigger')} events={meta.get('events')}"
-            + tail_s)
+            + routes_s + tail_s)
         ticks = [e for e in events if e["kind"] == "metrics.tick"]
         if ticks:
             out.append(f"      deltas at trigger: "
@@ -619,6 +626,47 @@ def _hot_path_selftest(checks: dict) -> None:
         srv.stop()
 
 
+def _sar_serving_selftest(checks: dict) -> None:
+    """Stand up a resident SAR recommender and assert the --serving
+    report carries the sar_resident route: its label on the hot-path
+    line and its per-path request counter."""
+    import time
+
+    import numpy as np
+
+    from mmlspark_tpu.core.schema import Table
+    from mmlspark_tpu.recommendation import SAR, serve_recommender
+
+    rng = np.random.default_rng(11)
+    n = 400
+    t = Table({"user": rng.integers(0, 40, n).astype(np.float64),
+               "item": rng.integers(0, 30, n).astype(np.float64)})
+    model = SAR(support_threshold=1).fit(t)
+    srv = serve_recommender(model, k=5, max_batch_size=16)
+    try:
+        deadline = time.monotonic() + 60
+        while not srv.ready and time.monotonic() < deadline:
+            time.sleep(0.05)
+        checks["sar server warmed"] = srv.ready
+        checks["sar hot path enabled"] = (
+            srv.hot_path is not None and srv.hot_path.disabled is None)
+        for uid in range(6):
+            req = urllib.request.Request(
+                srv.url, data=json.dumps({"user": uid}).encode(),
+                headers={"Content-Type": "application/json"}, method="POST")
+            urllib.request.urlopen(req, timeout=10).read()
+        report = diagnose_serving(srv.url)
+        print()
+        print(report)
+        checks["report labels sar route"] = (
+            "resident_label=sar_resident" in report)
+        snap = srv.hot_path.snapshot()
+        checks["sar resident requests counted"] = (
+            snap["paths"].get("sar_resident", 0) >= 1)
+    finally:
+        srv.stop()
+
+
 def selftest() -> int:
     from mmlspark_tpu.io_http.serving import ServingFleet
 
@@ -643,6 +691,7 @@ def selftest() -> int:
     finally:
         fleet.stop()
     _hot_path_selftest(checks)
+    _sar_serving_selftest(checks)
     failed = [name for name, ok in checks.items() if not ok]
     if failed:
         print(f"selftest FAILED: {failed}", file=sys.stderr)
