@@ -1897,6 +1897,116 @@ def _transformer_extra(transformer: "dict | None") -> dict:
     }
 
 
+def bench_streaming_parallel() -> dict:
+    """Partition-parallel streaming speedup: the SAME keyed stateful
+    pipeline run at P=1 (plain StreamingQuery) and P=2/P=4
+    (ParallelStreamingQuery, thread workers), paired over identical
+    batches, plus the shuffle split+merge overhead as a fraction of P=4
+    wall time. The chain models an external per-batch call (feature-store
+    enrichment) with a GIL-releasing block proportional to rows — the
+    speedup is honest LATENCY HIDING of that blocking work across
+    partitions, which is the single-host analogue of fleet workers; it is
+    NOT a CPU-parallelism claim (this runs on however many cores the host
+    has, including one). Byte-identity of all three outputs is asserted,
+    and a stream-stream join at P=4 is checked against its P=1 oracle."""
+    from mmlspark_tpu.core.params import Param
+    from mmlspark_tpu.core.pipeline import Transformer, pipeline_model
+    from mmlspark_tpu.core.schema import Table
+    from mmlspark_tpu.streaming import (
+        GroupedAggregator, KeyedShuffle, MemorySink, MemorySource,
+        ParallelStreamingQuery, StreamingQuery, StreamStreamJoin)
+
+    class IoBoundEnrichment(Transformer):
+        """Stand-in for a per-batch external call: blocks (releasing the
+        GIL) for seconds_per_row * num_rows, passes rows through."""
+
+        seconds_per_row = Param(5e-5, "simulated external-call latency "
+                                "per row", ptype=float)
+
+        def _transform(self, table):
+            time.sleep(self.get("seconds_per_row") * table.num_rows)
+            return table
+
+    rows_per_batch, n_batches, n_keys = 256, 30, 32
+    rng = np.random.default_rng(23)
+    batches = [
+        Table({"key": [f"k{int(i)}" for i in
+                       rng.integers(0, n_keys, rows_per_batch)],
+               "value": rng.normal(size=rows_per_batch)})
+        for _ in range(n_batches)]
+
+    def run(P):
+        src, sink = MemorySource(), MemorySink()
+        chain = [IoBoundEnrichment(),
+                 GroupedAggregator(group_col="key", value_col="value",
+                                   agg="sum", output_col="total")]
+        if P == 1:
+            q = StreamingQuery(src, pipeline_model(*chain), sink,
+                               name="par1")
+        else:
+            q = ParallelStreamingQuery(
+                src, pipeline_model(
+                    KeyedShuffle(key_col="key", num_partitions=P),
+                    *chain),
+                sink, name=f"par{P}")
+        src.add_rows(batches[0])
+        q.process_next()        # warm-up: spin up workers untimed
+        t0 = time.perf_counter()
+        for b in batches[1:]:
+            src.add_rows(b)
+            q.process_next()
+        elapsed = time.perf_counter() - t0
+        out = sink.table()
+        shuffle_s = getattr(q, "shuffle_seconds", 0.0)
+        q.stop()
+        return elapsed, out, shuffle_s
+
+    e1, t1, _ = run(1)
+    e2, t2, _ = run(2)
+    e4, t4, sh4 = run(4)
+    identical = t1.equals(t2) and t1.equals(t4)
+    assert identical, "partitioned output diverged from the P=1 run"
+
+    # stream-stream join: P=4 output must match the single-partition oracle
+    jdata = [
+        Table({"key": [f"k{int(i)}" for i in rng.integers(0, 8, 64)],
+               "time": np.round(rng.uniform(b * 10, b * 10 + 12, 64), 3),
+               "side": [("left" if x < 0.5 else "right")
+                        for x in rng.random(64)],
+               "value": np.round(rng.uniform(0, 10, 64), 3)})
+        for b in range(4)]
+
+    def run_join(P):
+        src, sink = MemorySource(), MemorySink()
+        join = StreamStreamJoin(key_col="key", join_window_s=5.0,
+                                watermark_delay_s=2.0)
+        if P == 1:
+            q = StreamingQuery(src, join, sink, name="join1")
+        else:
+            q = ParallelStreamingQuery(
+                src, pipeline_model(
+                    KeyedShuffle(key_col="key", num_partitions=P), join),
+                sink, name=f"join{P}")
+        for b in jdata:
+            src.add_rows(b)
+            q.process_all_available()
+        q.stop()
+        return sink.table()
+
+    join_ok = run_join(1).equals(run_join(4))
+    timed_rows = (n_batches - 1) * rows_per_batch
+    return {
+        "p1_rows_per_sec": timed_rows / e1,
+        "p2_rows_per_sec": timed_rows / e2,
+        "p4_rows_per_sec": timed_rows / e4,
+        "speedup_p2_vs_p1": e1 / e2,
+        "speedup_p4_vs_p1": e1 / e4,
+        "shuffle_overhead_fraction": sh4 / e4 if e4 else 0.0,
+        "outputs_identical": bool(identical),
+        "join_matches_oracle": bool(join_ok),
+    }
+
+
 def _streaming_extra(streaming: "dict | None") -> dict:
     """Streaming-engine fields of the JSON line. The micro-batch driver is
     host-side Python: these are CPU numbers on every platform (the label
@@ -1908,6 +2018,28 @@ def _streaming_extra(streaming: "dict | None") -> dict:
         "streaming_rows_per_batch": g("rows_per_batch"),
         "streaming_backend": "cpu (host-side driver, non-TPU)"
         if streaming else None,
+    }
+
+
+def _streaming_parallel_extra(par: "dict | None") -> dict:
+    """Partition-parallel streaming fields. The speedup is latency
+    hiding of a GIL-releasing external-call model across partitions —
+    a host-side concurrency number on every platform, never TPU work."""
+    g = (par or {}).get
+    return {
+        "streaming_parallel_p1_rows_per_sec": _r1(par, "p1_rows_per_sec"),
+        "streaming_parallel_p2_rows_per_sec": _r1(par, "p2_rows_per_sec"),
+        "streaming_parallel_p4_rows_per_sec": _r1(par, "p4_rows_per_sec"),
+        "streaming_parallel_speedup_p2_vs_p1": round(
+            par["speedup_p2_vs_p1"], 3) if par else None,
+        "streaming_parallel_speedup_p4_vs_p1": round(
+            par["speedup_p4_vs_p1"], 3) if par else None,
+        "streaming_parallel_shuffle_overhead_fraction": round(
+            par["shuffle_overhead_fraction"], 4) if par else None,
+        "streaming_parallel_outputs_identical": g("outputs_identical"),
+        "streaming_parallel_join_matches_oracle": g("join_matches_oracle"),
+        "streaming_parallel_backend": "cpu (io-overlap across thread "
+        "partitions, non-TPU)" if par else None,
     }
 
 
@@ -1988,6 +2120,12 @@ def _run_suite(platform: str) -> dict:
     except Exception as e:  # noqa: BLE001 — engine overhead is auxiliary
         print(f"bench: streaming bench failed ({e!r})", file=sys.stderr)
         streaming = None
+    try:
+        streaming_parallel = bench_streaming_parallel()
+    except Exception as e:  # noqa: BLE001 — parallel row is auxiliary
+        print(f"bench: streaming parallel bench failed ({e!r})",
+              file=sys.stderr)
+        streaming_parallel = None
     try:
         fusion = bench_pipeline_fusion()
     except Exception as e:  # noqa: BLE001 — fusion row is auxiliary
@@ -2094,6 +2232,7 @@ def _run_suite(platform: str) -> dict:
             "serving_degraded_error_rate": round(
                 degraded["error_rate"], 4) if degraded else None,
             **_streaming_extra(streaming),
+            **_streaming_parallel_extra(streaming_parallel),
             # paired per-pass median, like runner_pipelined_vs_sequential
             "pipeline_fused_vs_staged": round(
                 fusion["fused_vs_staged"], 3) if fusion else None,

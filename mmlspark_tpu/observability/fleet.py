@@ -329,6 +329,18 @@ GAUGE_MERGE_POLICIES: dict[str, str] = {
     # fetch genuinely add across replicas (rule 5: write the intent
     # down, don't inherit it from the _depth suffix default)
     "mmlspark_tpu_serving_readback_inflight_depth": "sum",
+    # partition-parallel streaming (streaming/partition.py): the series
+    # are per (query, partition), so fleet-level merges must respect the
+    # partitioned meaning, not the _seconds suffix default ("last")
+    "mmlspark_tpu_streaming_partition_queue_depth": "sum",
+    # the slowest partition gates the batch barrier — worst lag is the
+    # actionable signal
+    "mmlspark_tpu_streaming_partition_lag_seconds": "max",
+    # the query's effective watermark is the MINIMUM over partitions:
+    # no operator may finalize past the slowest partition's clock
+    "mmlspark_tpu_streaming_partition_watermark_seconds": "min",
+    # spill files are disjoint per partition, so bytes genuinely add
+    "mmlspark_tpu_streaming_state_spill_bytes": "sum",
 }
 
 _SUFFIX_POLICIES: tuple[tuple[str, str], ...] = (

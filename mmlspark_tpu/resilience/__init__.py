@@ -29,7 +29,8 @@ from .breaker import (
     CircuitOpenError,
 )
 from .chaos import ChaosError, ChaosTransformer, FaultInjector
-from .supervisor import QuerySupervisor, RestartPolicy
+from .supervisor import (PartitionSupervisor, QuerySupervisor,
+                         RestartPolicy)
 
 __all__ = [
     "Clock",
@@ -50,5 +51,6 @@ __all__ = [
     "ChaosError",
     "ChaosTransformer",
     "QuerySupervisor",
+    "PartitionSupervisor",
     "RestartPolicy",
 ]

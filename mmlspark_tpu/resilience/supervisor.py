@@ -27,7 +27,7 @@ from typing import Any, Callable
 
 from .policy import (Clock, RetryPolicy, SYSTEM_CLOCK, is_fatal_exception)
 
-__all__ = ["RestartPolicy", "QuerySupervisor"]
+__all__ = ["RestartPolicy", "QuerySupervisor", "PartitionSupervisor"]
 
 
 class RestartPolicy:
@@ -213,4 +213,134 @@ class QuerySupervisor:
             if self.on_restart is not None:
                 self.on_restart(self.query, exc, self.restarts)
             self.query.start()
+        self.state = "stopped"
+
+
+class PartitionSupervisor:
+    """Monitor thread over a partition-worker fleet: respawn dead worker
+    processes within the RestartPolicy budget, escalate when it runs dry.
+
+    The driver loop already heals lazily (a send hitting a dead worker
+    triggers respawn + state re-push), but that only fires when a batch
+    is in flight — this supervisor closes the gap for idle streams, so a
+    worker that dies between batches is back before the next one needs
+    it. Restart safety is the same argument as QuerySupervisor's: a
+    respawned worker holds NO state and answers `need_state`, the driver
+    re-pushes the last committed snapshot, and exactly-once holds.
+
+    Only needs `dead_slots()/respawn(slot)` from the fleet, so it
+    supervises ServingFleet or anything shaped like it."""
+
+    def __init__(
+        self,
+        fleet: Any,
+        policy: "RestartPolicy | None" = None,
+        *,
+        name: str = "partitions",
+        on_respawn: "Callable | None" = None,
+        on_failure: "Callable | None" = None,
+        poll_interval_s: float = 0.2,
+        clock: Clock = SYSTEM_CLOCK,
+        metrics: Any = None,
+    ):
+        self.fleet = fleet
+        self.policy = policy if policy is not None else RestartPolicy()
+        self.name = name
+        self.on_respawn = on_respawn
+        self.on_failure = on_failure
+        self.poll_interval_s = poll_interval_s
+        self.clock = clock
+        self._metrics = metrics
+        self.state = "initialized"
+        self.respawns = 0
+        self.last_exception: "BaseException | None" = None
+        self._respawn_times: collections.deque[float] = collections.deque()
+        self._stop = threading.Event()
+        self._thread: "threading.Thread | None" = None
+
+    def _count_respawn(self) -> None:
+        try:
+            from ..observability.metrics import get_registry
+
+            reg = self._metrics if self._metrics is not None \
+                else get_registry()
+            reg.counter(
+                "mmlspark_tpu_streaming_partition_respawns_total",
+                "supervised partition-worker respawns",
+                labels=("query",)).labels(query=self.name).inc()
+        except Exception:  # noqa: BLE001 — telemetry never blocks recovery
+            pass
+
+    def _flight_record(self, action: str, slot: "int | None" = None,
+                       exc: "BaseException | None" = None,
+                       dump_trigger: "str | None" = None,
+                       force: bool = False) -> None:
+        try:
+            from ..observability.recorder import get_recorder
+
+            rec = get_recorder()
+            rec.record_transition(
+                "partition-supervisor", action, query=self.name,
+                slot=slot, respawns=self.respawns,
+                error=(f"{type(exc).__name__}: {exc}" if exc else None))
+            if dump_trigger is not None:
+                rec.trigger_dump(dump_trigger, force=force)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _respawn_allowed(self) -> bool:
+        now = self.clock.monotonic()
+        while self._respawn_times and \
+                now - self._respawn_times[0] > self.policy.window_s:
+            self._respawn_times.popleft()
+        return len(self._respawn_times) < self.policy.max_restarts
+
+    def start(self) -> "PartitionSupervisor":
+        if self._thread is not None and self._thread.is_alive():
+            raise RuntimeError("supervisor is already running")
+        self._stop.clear()
+        self.state = "running"
+        self._thread = threading.Thread(
+            target=self._monitor, name=f"partition-supervisor-{self.name}",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        if self.state == "running":
+            self.state = "stopped"
+
+    def _monitor(self) -> None:
+        while not self._stop.is_set():
+            try:
+                dead = list(self.fleet.dead_slots())
+            except Exception:  # noqa: BLE001 — fleet mid-stop
+                dead = []
+            for slot in dead:
+                if self._stop.is_set():
+                    break
+                if not self._respawn_allowed():
+                    self.state = "failed"
+                    self._flight_record("escalate", slot=slot,
+                                        exc=self.last_exception,
+                                        dump_trigger="restart", force=True)
+                    if self.on_failure is not None:
+                        self.on_failure(self.fleet, slot)
+                    return
+                try:
+                    self.fleet.respawn(slot)
+                except Exception as e:  # noqa: BLE001 — retried next poll
+                    self.last_exception = e
+                    continue
+                self._respawn_times.append(self.clock.monotonic())
+                self.respawns += 1
+                self._count_respawn()
+                self._flight_record("respawn", slot=slot,
+                                    dump_trigger="restart")
+                if self.on_respawn is not None:
+                    self.on_respawn(self.fleet, slot, self.respawns)
+            self._stop.wait(self.poll_interval_s)
         self.state = "stopped"

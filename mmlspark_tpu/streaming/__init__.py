@@ -25,10 +25,30 @@ A killed query restarts from the last committed batch, replays the
 in-flight batch against the exact planned offsets, and the sink skips
 anything it already wrote — output is identical to a one-shot batch
 `Pipeline.transform` over the same input.
+
+Distributed execution (shuffle.py / partition.py): a `KeyedShuffle`
+stage splits the pipeline, `ParallelStreamingQuery` runs the stateful
+chain over P key-partitions — on driver threads or across a fleet of
+worker processes — with per-partition incremental checkpoints, and the
+kill-restart byte-identity guarantee holds at any P. `StreamStreamJoin`
+and `StreamTableJoin` are the first operators requiring the shuffle.
 """
 
 from .checkpoint import CommitLog
+from .joins import StreamStreamJoin, StreamTableJoin
+from .partition import (
+    ParallelStreamingQuery,
+    PartitionWorkerFactory,
+    ThreadPartitionWorker,
+    split_pipeline_at_shuffle,
+)
 from .query import StreamingQuery
+from .shuffle import (
+    KeyedShuffle,
+    partition_of,
+    split_by_partition,
+    stable_hash,
+)
 from .sinks import (
     ForeachBatchSink,
     MemorySink,
@@ -44,11 +64,31 @@ from .sources import (
     SocketSource,
     Source,
 )
-from .state import GroupedAggregator, StatefulOperator, WindowedAggregator
+from .state import (
+    GroupedAggregator,
+    MemoryStateBackend,
+    SpillingStateBackend,
+    StateBackend,
+    StatefulOperator,
+    WindowedAggregator,
+)
 
 __all__ = [
     "CommitLog",
     "StreamingQuery",
+    "ParallelStreamingQuery",
+    "KeyedShuffle",
+    "stable_hash",
+    "partition_of",
+    "split_by_partition",
+    "split_pipeline_at_shuffle",
+    "ThreadPartitionWorker",
+    "PartitionWorkerFactory",
+    "StreamStreamJoin",
+    "StreamTableJoin",
+    "StateBackend",
+    "MemoryStateBackend",
+    "SpillingStateBackend",
     "Source",
     "DirectorySource",
     "MemorySource",

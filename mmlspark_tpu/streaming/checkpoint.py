@@ -134,17 +134,98 @@ class CommitLog:
         except (FileNotFoundError, json.JSONDecodeError):
             return None
 
+    # -- per-partition incremental snapshots ------------------------------- #
+    #
+    # A P-way query (streaming/partition.py) checkpoints each partition's
+    # operator state in its own file, and only for batches where that
+    # partition's state CHANGED — so a batch touching 1 of 64 partitions
+    # writes one small file, not the whole state. Recovery reads, per
+    # partition, the newest snapshot at or before the last committed
+    # batch. The same plan/commit records gate replay; only the snapshot
+    # layout is partition-aware.
+
+    _PSTATE_FMT = "state-p{:04d}-{:09d}.json"
+
+    def _pstate_path(self, partition: int, batch_id: int) -> str:
+        return os.path.join(self.dir,
+                            self._PSTATE_FMT.format(partition, batch_id))
+
+    @staticmethod
+    def _parse_pstate(name: str) -> "tuple[int, int] | None":
+        """(partition, batch_id) from a per-partition snapshot filename."""
+        if not (name.startswith("state-p") and name.endswith(".json")):
+            return None
+        body = name[len("state-p"):-len(".json")]
+        part, sep, bid = body.partition("-")
+        if not sep:
+            return None
+        try:
+            return int(part), int(bid)
+        except ValueError:
+            return None
+
+    def write_partition_state(self, partition: int, batch_id: int,
+                              doc: dict) -> None:
+        """Atomically snapshot ONE partition's operator state as of after
+        `batch_id` (same tmp + rename durability as `write_state`)."""
+        path = self._pstate_path(partition, batch_id)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, sort_keys=True)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+
+    def read_partition_state(self, partition: int,
+                             batch_id: int) -> dict | None:
+        """Newest snapshot of `partition` at or before `batch_id` — the
+        incremental layout means the partition may not have written at
+        `batch_id` itself if nothing changed since an earlier batch."""
+        best = -1
+        for name in os.listdir(self.dir):
+            parsed = self._parse_pstate(name)
+            if parsed is None or parsed[0] != partition:
+                continue
+            if best < parsed[1] <= batch_id:
+                best = parsed[1]
+        if best < 0:
+            return None
+        try:
+            with open(self._pstate_path(partition, best),
+                      encoding="utf-8") as fh:
+                return json.load(fh)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return None
+
     def prune_state(self, keep_from: int) -> None:
-        """Drop snapshots older than `keep_from` (recovery only ever needs
-        the last committed batch's state)."""
+        """Drop snapshots recovery can no longer need: whole-query
+        snapshots older than `keep_from`, and per-partition snapshots
+        superseded by a newer one still at or before `keep_from` (each
+        partition's newest <= keep_from file must SURVIVE — with
+        incremental writes it may be arbitrarily old)."""
+        newest: dict[int, int] = {}     # partition -> newest bid <= keep
+        pstates: list[tuple[int, int, str]] = []
         for name in os.listdir(self.dir):
             if not (name.startswith("state-") and name.endswith(".json")):
+                continue
+            parsed = self._parse_pstate(name)
+            if parsed is not None:
+                part, bid = parsed
+                pstates.append((part, bid, name))
+                if bid <= keep_from:
+                    newest[part] = max(newest.get(part, -1), bid)
                 continue
             try:
                 bid = int(name[len("state-"):-len(".json")])
             except ValueError:
                 continue
             if bid < keep_from:
+                try:
+                    os.unlink(os.path.join(self.dir, name))
+                except OSError:
+                    pass
+        for part, bid, name in pstates:
+            if bid < newest.get(part, -1):
                 try:
                     os.unlink(os.path.join(self.dir, name))
                 except OSError:

@@ -182,11 +182,17 @@ class StreamingQuery:
         # compacted pre-upgrade log might not — start over in that case
         self._last_end = plan["end"] if plan else None
         self._next_id = last + 1
-        if self._ops:
-            doc = self._log.read_state(last)
-            if doc:
-                for op, op_doc in zip(self._ops, doc.get("ops", [])):
-                    op.load_state_doc(op_doc)
+        self._recover_state(last)
+
+    def _recover_state(self, last: int) -> None:
+        """Restore stateful-operator state to the last committed batch
+        (overridden by ParallelStreamingQuery for per-partition docs)."""
+        if not self._ops:
+            return
+        doc = self._log.read_state(last)
+        if doc:
+            for op, op_doc in zip(self._ops, doc.get("ops", [])):
+                op.load_state_doc(op_doc)
 
     # -- one micro-batch --------------------------------------------------- #
 
@@ -196,6 +202,34 @@ class StreamingQuery:
         if hasattr(self.transform, "transform"):
             return self.transform.transform(batch)
         return self.transform(batch)
+
+    # The four state/apply hooks factor everything a partition-parallel
+    # subclass must change out of process_next, which keeps the WAL
+    # ordering (plan -> snapshot -> apply -> state write -> sink ->
+    # commit, rollback on any failure) in exactly one place.
+
+    def _snapshot_state(self):
+        """Pre-batch state capture, restored by `_restore_state` if the
+        attempt fails."""
+        return [op.state_doc() for op in self._ops]
+
+    def _restore_state(self, saved) -> None:
+        for op, doc in zip(self._ops, saved):
+            op.load_state_doc(doc)
+
+    def _apply_batch(self, bid: int, batch: Table) -> Table:
+        return self._apply(batch)
+
+    def _write_state(self, bid: int) -> None:
+        """Persist post-fold state BEFORE the sink write, so a replayed
+        batch restores its operators to the state that preceded the
+        crashed attempt."""
+        if self._log is not None and self._ops:
+            self._log.write_state(
+                bid, {"ops": [op.state_doc() for op in self._ops]})
+
+    def _post_commit(self, bid: int) -> None:
+        """Commit-time hook (after the WAL commit record)."""
 
     def _read_ahead(self, start: "dict | None"):
         """Background source read for the batch AFTER the current one:
@@ -241,7 +275,7 @@ class StreamingQuery:
                 if self._log is not None:
                     with self._m_wal_plan.time():
                         self._log.plan(bid, start, end)
-            saved = [op.state_doc() for op in self._ops]
+            saved = self._snapshot_state()
             t0 = time.monotonic()
             tr = self.tracer if self.tracer is not None else get_tracer()
             with tr.start_span("streaming.batch", query=self.name,
@@ -256,16 +290,13 @@ class StreamingQuery:
                         nxt = end
                         self._lookahead.submit(
                             nxt, lambda: self._read_ahead(nxt))
-                    out = self._apply(batch)
-                    if self._log is not None and self._ops:
-                        self._log.write_state(
-                            bid, {"ops": [op.state_doc() for op in self._ops]})
+                    out = self._apply_batch(bid, batch)
+                    self._write_state(bid)
                     self.sink.add_batch(bid, out)
                 except BaseException:
                     # a failed attempt must not leak half-folded state into
                     # the retry: restore the pre-batch snapshots
-                    for op, doc in zip(self._ops, saved):
-                        op.load_state_doc(doc)
+                    self._restore_state(saved)
                     raise
                 span.set(rows=batch.num_rows)
                 self._commit(bid, end, rows=batch.num_rows,
@@ -281,6 +312,7 @@ class StreamingQuery:
                 self._log.prune_state(keep_from=bid)
             if self.compact_every and (bid + 1) % self.compact_every == 0:
                 self._log.compact()
+        self._post_commit(bid)
         self.source.commit(end)
         self._last_end = end
         self._next_id = bid + 1

@@ -7,13 +7,19 @@
 #' @param value_col numeric column to aggregate; None counts rows
 #' @param agg one of count|sum|mean|min|max
 #' @param output_col output column holding the aggregate
+#' @param state_backend accumulator storage: 'memory' (one dict) or 'spill' (bounded hot set + parquet spill file)
+#' @param spill_dir spill-file directory (required by the 'spill' backend)
+#' @param spill_hot_keys max in-memory keys before the 'spill' backend evicts cold keys to parquet
 #' @export
-ml_grouped_aggregator <- function(x, group_col = "key", value_col = NULL, agg = "count", output_col = "aggregate")
+ml_grouped_aggregator <- function(x, group_col = "key", value_col = NULL, agg = "count", output_col = "aggregate", state_backend = "memory", spill_dir = NULL, spill_hot_keys = 1024L)
 {
   params <- list()
   if (!is.null(group_col)) params$group_col <- as.character(group_col)
   if (!is.null(value_col)) params$value_col <- as.character(value_col)
   if (!is.null(agg)) params$agg <- as.character(agg)
   if (!is.null(output_col)) params$output_col <- as.character(output_col)
+  if (!is.null(state_backend)) params$state_backend <- as.character(state_backend)
+  if (!is.null(spill_dir)) params$spill_dir <- as.character(spill_dir)
+  if (!is.null(spill_hot_keys)) params$spill_hot_keys <- as.integer(spill_hot_keys)
   .tpu_apply_stage("mmlspark_tpu.streaming.state.GroupedAggregator", params, x, is_estimator = FALSE)
 }

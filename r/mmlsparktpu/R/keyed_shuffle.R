@@ -1,0 +1,17 @@
+#' KeyedShuffle (Transformer)
+#'
+#' The exchange boundary as a registered pipeline stage.
+#'
+#' @param x a data.frame or tpu_table
+#' @param key_col column whose hash routes each row to a partition
+#' @param num_partitions number of parallel partitions (P)
+#' @param partition_col output column holding the routed partition id (standalone transform only)
+#' @export
+ml_keyed_shuffle <- function(x, key_col = "key", num_partitions = 2L, partition_col = "partition")
+{
+  params <- list()
+  if (!is.null(key_col)) params$key_col <- as.character(key_col)
+  if (!is.null(num_partitions)) params$num_partitions <- as.integer(num_partitions)
+  if (!is.null(partition_col)) params$partition_col <- as.character(partition_col)
+  .tpu_apply_stage("mmlspark_tpu.streaming.shuffle.KeyedShuffle", params, x, is_estimator = FALSE)
+}

@@ -1,0 +1,27 @@
+#' StreamStreamJoin (Transformer)
+#'
+#' Inner interval join of two event streams multiplexed in one table.
+#'
+#' @param x a data.frame or tpu_table
+#' @param key_col join key; rows sharing a value can match
+#' @param time_col event-time column, in seconds
+#' @param side_col column tagging each row's stream
+#' @param left_tag side_col value marking left-stream rows
+#' @param right_tag side_col value marking right-stream rows
+#' @param value_col numeric payload column carried through the join
+#' @param join_window_s max |left_time - right_time| for a match
+#' @param watermark_delay_s how long to admit out-of-order rows past the max event time seen
+#' @export
+ml_stream_stream_join <- function(x, key_col = "key", time_col = "time", side_col = "side", left_tag = "left", right_tag = "right", value_col = "value", join_window_s = 60.0, watermark_delay_s = 0.0)
+{
+  params <- list()
+  if (!is.null(key_col)) params$key_col <- as.character(key_col)
+  if (!is.null(time_col)) params$time_col <- as.character(time_col)
+  if (!is.null(side_col)) params$side_col <- as.character(side_col)
+  if (!is.null(left_tag)) params$left_tag <- as.character(left_tag)
+  if (!is.null(right_tag)) params$right_tag <- as.character(right_tag)
+  if (!is.null(value_col)) params$value_col <- as.character(value_col)
+  if (!is.null(join_window_s)) params$join_window_s <- as.double(join_window_s)
+  if (!is.null(watermark_delay_s)) params$watermark_delay_s <- as.double(watermark_delay_s)
+  .tpu_apply_stage("mmlspark_tpu.streaming.joins.StreamStreamJoin", params, x, is_estimator = FALSE)
+}

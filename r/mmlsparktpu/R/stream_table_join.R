@@ -1,0 +1,17 @@
+#' StreamTableJoin (Transformer)
+#'
+#' Broadcast join of a stream against a static table on disk.
+#'
+#' @param x a data.frame or tpu_table
+#' @param key_col join key present in both sides
+#' @param table_path csv or parquet file holding the static side
+#' @param how 'left' keeps unmatched stream rows, 'inner' drops them
+#' @export
+ml_stream_table_join <- function(x, key_col = "key", table_path = NULL, how = "left")
+{
+  params <- list()
+  if (!is.null(key_col)) params$key_col <- as.character(key_col)
+  if (!is.null(table_path)) params$table_path <- as.character(table_path)
+  if (!is.null(how)) params$how <- as.character(how)
+  .tpu_apply_stage("mmlspark_tpu.streaming.joins.StreamTableJoin", params, x, is_estimator = FALSE)
+}

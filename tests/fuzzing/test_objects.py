@@ -808,7 +808,9 @@ def _io_http_objects(ctx) -> dict[str, list[TestObject]]:
 
 
 def _streaming_objects(ctx) -> dict[str, list[TestObject]]:
-    from mmlspark_tpu.streaming import GroupedAggregator, WindowedAggregator
+    from mmlspark_tpu.streaming import (GroupedAggregator, KeyedShuffle,
+                                        StreamStreamJoin, StreamTableJoin,
+                                        WindowedAggregator)
 
     # event times span five 10s windows; with a 5s watermark delay the
     # max time (47) finalizes everything through [30,40) in one batch,
@@ -818,15 +820,52 @@ def _streaming_objects(ctx) -> dict[str, list[TestObject]]:
         "value": np.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0]),
         "time": np.array([1.0, 5.0, 12.0, 18.0, 23.0, 47.0]),
     })
+    # two-sided stream: close left/right times per key so the interval
+    # join emits pairs, not an empty table
+    sided = Table({
+        "key": ["a", "a", "b", "b", "a", "c"],
+        "time": np.array([1.0, 2.0, 3.0, 4.5, 6.0, 7.0]),
+        "side": ["left", "right", "left", "right", "right", "left"],
+        "value": np.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0]),
+    })
+    static_path = str(ctx["tmpdir"] / "dim_table.csv")
+    with open(static_path, "w", encoding="utf-8") as fh:
+        fh.write("key,weight\na,1.5\nb,2.5\nc,3.5\n")
     return {
-        "mmlspark_tpu.streaming.state.GroupedAggregator": [TestObject(
-            GroupedAggregator(group_col="key", value_col="value", agg="sum"),
-            transform_table=events,
-        )],
+        "mmlspark_tpu.streaming.state.GroupedAggregator": [
+            TestObject(
+                GroupedAggregator(group_col="key", value_col="value",
+                                  agg="sum"),
+                transform_table=events,
+            ),
+            # the spill backend through the Param surface: tiny hot set
+            # forces real parquet eviction during the fuzz transform
+            TestObject(
+                GroupedAggregator(group_col="key", value_col="value",
+                                  agg="sum", state_backend="spill",
+                                  spill_dir=str(ctx["tmpdir"] / "spill"),
+                                  spill_hot_keys=1),
+                transform_table=events,
+            ),
+        ],
         "mmlspark_tpu.streaming.state.WindowedAggregator": [TestObject(
             WindowedAggregator(time_col="time", window_s=10.0,
                                group_col="key", value_col="value",
                                agg="mean", watermark_delay_s=5.0),
+            transform_table=events,
+        )],
+        "mmlspark_tpu.streaming.shuffle.KeyedShuffle": [TestObject(
+            KeyedShuffle(key_col="key", num_partitions=4),
+            transform_table=events,
+        )],
+        "mmlspark_tpu.streaming.joins.StreamStreamJoin": [TestObject(
+            StreamStreamJoin(key_col="key", join_window_s=3.0,
+                             watermark_delay_s=2.0),
+            transform_table=sided,
+        )],
+        "mmlspark_tpu.streaming.joins.StreamTableJoin": [TestObject(
+            StreamTableJoin(key_col="key", table_path=static_path,
+                            how="left"),
             transform_table=events,
         )],
     }

@@ -1,0 +1,892 @@
+"""Distributed streaming tests: keyed shuffle, partition-parallel
+stateful execution, streaming joins, state backends, per-partition
+incremental checkpoints, and the fleet partition workers.
+
+The load-bearing invariant everywhere: a `ParallelStreamingQuery` run at
+any P is BYTE-identical to the P=1 `StreamingQuery` run over the same
+batches — including across driver SIGKILL and partition-worker kill
+(the slow tier), which is the exactly-once gate extended to P > 1.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.pipeline import pipeline_model
+from mmlspark_tpu.core.schema import Table
+from mmlspark_tpu.core.table_io import write_csv
+from mmlspark_tpu.streaming import (
+    CommitLog,
+    DirectorySource,
+    GroupedAggregator,
+    KeyedShuffle,
+    MemorySink,
+    MemorySource,
+    ParallelStreamingQuery,
+    PartitionWorkerFactory,
+    SpillingStateBackend,
+    StreamingQuery,
+    StreamStreamJoin,
+    StreamTableJoin,
+    WindowedAggregator,
+    partition_of,
+    split_by_partition,
+    split_pipeline_at_shuffle,
+    stable_hash,
+)
+
+
+def _assert_byte_identical(a: Table, b: Table) -> None:
+    """Exact equality — not Table.equals' tolerant compare. Identical
+    fold order must give bitwise-identical floats."""
+    assert sorted(a.columns) == sorted(b.columns)
+    assert a.num_rows == b.num_rows
+    for c in a.columns:
+        ca, cb = a[c], b[c]
+        if isinstance(ca, np.ndarray) or isinstance(cb, np.ndarray):
+            np.testing.assert_array_equal(np.asarray(ca), np.asarray(cb))
+        else:
+            assert list(ca) == list(cb)
+
+
+def _key_for_partition(p: int, num_partitions: int, prefix: str = "k") -> str:
+    for i in range(1000):
+        k = f"{prefix}{i}"
+        if partition_of(k, num_partitions) == p:
+            return k
+    raise AssertionError("no key found")
+
+
+def _grouped_batches(seed: int = 3, n_batches: int = 5, rows: int = 40,
+                     keys: int = 16) -> "list[Table]":
+    rng = np.random.default_rng(seed)
+    return [Table({"k": [f"k{int(i)}" for i in rng.integers(0, keys, rows)],
+                   "v": rng.normal(size=rows)})
+            for _ in range(n_batches)]
+
+
+def _drive(q, src, batches) -> None:
+    for b in batches:
+        src.add_rows(b)
+        q.process_all_available()
+
+
+# --------------------------------------------------------------------------- #
+# shuffle primitives
+
+
+class TestShuffle:
+    def test_stable_hash_is_process_stable(self):
+        """Python's builtin hash is salted per process; routing must not
+        be. A fresh interpreter computes the same digests."""
+        from tests.conftest import subprocess_env
+
+        local = [stable_hash("alpha"), stable_hash(7), stable_hash(2.5)]
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "from mmlspark_tpu.streaming import stable_hash\n"
+             "print(stable_hash('alpha'), stable_hash(7), "
+             "stable_hash(2.5))"],
+            env=subprocess_env(), capture_output=True, text=True,
+            timeout=120)
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert [int(x) for x in out.stdout.split()] == local
+
+    def test_split_is_disjoint_and_order_preserving(self):
+        rng = np.random.default_rng(0)
+        t = Table({"k": [f"k{int(i)}" for i in rng.integers(0, 9, 60)],
+                   "v": np.arange(60.0)})
+        parts = split_by_partition(t, "k", 4)
+        assert sum(p.num_rows for p in parts) == 60
+        for pid, part in enumerate(parts):
+            for k in part["k"]:
+                assert partition_of(k, 4) == pid       # disjoint keys
+            # within-partition order == input order (the v column is the
+            # input row index, so it must be strictly increasing)
+            vs = list(part["v"])
+            assert vs == sorted(vs)
+        # per-key row sequence is exactly the key's input subsequence
+        for key in set(t["k"]):
+            pid = partition_of(key, 4)
+            got = [v for k, v in zip(parts[pid]["k"], parts[pid]["v"])
+                   if k == key]
+            want = [v for k, v in zip(t["k"], t["v"]) if k == key]
+            assert got == want
+
+    def test_split_empty_and_p1(self):
+        t = Table({"k": ["a"], "v": np.array([1.0])})
+        assert split_by_partition(t, "k", 1) == [t]
+        parts = split_by_partition(Table({"k": [], "v": np.zeros(0)}),
+                                   "k", 3)
+        assert len(parts) == 3
+        assert all(p.num_rows == 0 for p in parts)
+        assert all("v" in p.columns for p in parts)    # schema survives
+
+    def test_keyed_shuffle_standalone_annotates(self):
+        t = Table({"k": ["a", "b", "c"], "v": np.arange(3.0)})
+        out = KeyedShuffle(key_col="k", num_partitions=3).transform(t)
+        assert list(out["partition"]) == [partition_of(k, 3)
+                                          for k in ("a", "b", "c")]
+        assert list(out["k"]) == ["a", "b", "c"]
+
+
+class TestSplitPipeline:
+    def test_marker_splits_pre_and_chain(self):
+        pre = StreamTableJoin(key_col="k", table_path="x.csv")
+        sh = KeyedShuffle(key_col="k", num_partitions=2)
+        agg = GroupedAggregator(group_col="k")
+        p, s, c = split_pipeline_at_shuffle(pipeline_model(pre, sh, agg))
+        assert p == [pre] and s is sh and c == [agg]
+
+    def test_no_marker_is_all_chain(self):
+        agg = GroupedAggregator(group_col="k")
+        p, s, c = split_pipeline_at_shuffle(agg)
+        assert p == [] and s is None and c == [agg]
+
+    def test_two_shuffles_rejected(self):
+        pm = pipeline_model(KeyedShuffle(key_col="k"),
+                            KeyedShuffle(key_col="k"))
+        with pytest.raises(ValueError, match="at most one"):
+            split_pipeline_at_shuffle(pm)
+
+    def test_plain_callable_rejected(self):
+        with pytest.raises(TypeError, match="Transformer"):
+            split_pipeline_at_shuffle(lambda t: t)
+
+    def test_stateful_before_shuffle_rejected(self):
+        pm = pipeline_model(GroupedAggregator(group_col="k"),
+                            KeyedShuffle(key_col="k", num_partitions=2))
+        with pytest.raises(ValueError, match="AFTER the KeyedShuffle"):
+            ParallelStreamingQuery(MemorySource(), pm, MemorySink())
+
+    def test_state_key_must_match_shuffle_key(self):
+        pm = pipeline_model(KeyedShuffle(key_col="k", num_partitions=2),
+                            GroupedAggregator(group_col="other"))
+        with pytest.raises(ValueError, match="must match"):
+            ParallelStreamingQuery(MemorySource(), pm, MemorySink())
+
+    def test_key_col_required_without_marker(self):
+        with pytest.raises(ValueError, match="key_col"):
+            ParallelStreamingQuery(MemorySource(),
+                                   GroupedAggregator(group_col="k"),
+                                   MemorySink())
+
+
+# --------------------------------------------------------------------------- #
+# state backends
+
+
+class TestStateBackends:
+    def test_spill_equals_memory(self, tmp_path):
+        mem = GroupedAggregator(group_col="k", value_col="v", agg="mean")
+        spl = GroupedAggregator(group_col="k", value_col="v", agg="mean",
+                                state_backend="spill",
+                                spill_dir=str(tmp_path), spill_hot_keys=2)
+        rng = np.random.default_rng(1)
+        for _ in range(4):
+            t = Table({"k": [f"k{int(i)}" for i in rng.integers(0, 12, 30)],
+                       "v": rng.normal(size=30)})
+            _assert_byte_identical(mem.transform(t), spl.transform(t))
+        assert spl.spilled_bytes > 0          # 12 keys, 2 hot: cold file
+        assert mem.spilled_bytes == 0
+
+    def test_spill_state_doc_roundtrip(self, tmp_path):
+        spl = GroupedAggregator(group_col="k", value_col="v", agg="sum",
+                                state_backend="spill",
+                                spill_dir=str(tmp_path / "a"),
+                                spill_hot_keys=1)
+        spl.transform(Table({"k": ["a", "b", "c"],
+                             "v": np.array([1.0, 2.0, 3.0])}))
+        doc = json.loads(json.dumps(spl.state_doc()))
+        spl2 = GroupedAggregator(group_col="k", value_col="v", agg="sum",
+                                 state_backend="spill",
+                                 spill_dir=str(tmp_path / "b"),
+                                 spill_hot_keys=1)
+        spl2.load_state_doc(doc)
+        nxt = Table({"k": ["a"], "v": np.array([10.0])})
+        _assert_byte_identical(spl.transform(nxt), spl2.transform(nxt))
+
+    def test_state_doc_is_arrival_order_invariant(self):
+        """Sorted-key state docs: the same per-key history serializes to
+        the same BYTES regardless of which order keys first appeared —
+        the property the incremental-checkpoint diff depends on."""
+        a = GroupedAggregator(group_col="k", value_col="v", agg="sum")
+        b = GroupedAggregator(group_col="k", value_col="v", agg="sum")
+        a.transform(Table({"k": ["x", "y"], "v": np.array([1.0, 2.0])}))
+        b.transform(Table({"k": ["y", "x"], "v": np.array([2.0, 1.0])}))
+        assert json.dumps(a.state_doc()) == json.dumps(b.state_doc())
+
+    def test_spilling_backend_faults_cold_keys_back(self, tmp_path):
+        b = SpillingStateBackend(str(tmp_path), hot_keys=1)
+        b.acc("a")[0] += 1
+        b.acc("b")[0] += 1
+        b.end_batch()                          # evicts "a" to parquet
+        assert b.spilled_bytes > 0 and len(b) == 2
+        acc = b.acc("a")                       # fault back
+        assert b.faults == 1 and acc[0] == 1
+
+
+# --------------------------------------------------------------------------- #
+# per-partition checkpoint files
+
+
+class TestPartitionCheckpoints:
+    def test_write_read_newest_at_or_before(self, tmp_path):
+        log = CommitLog(str(tmp_path))
+        log.write_partition_state(0, 0, {"n": 0})
+        log.write_partition_state(0, 3, {"n": 3})
+        log.write_partition_state(1, 1, {"n": 10})
+        assert log.read_partition_state(0, 5) == {"n": 3}
+        assert log.read_partition_state(0, 2) == {"n": 0}
+        # incremental layout: partition 1 wrote nothing at bid 4, its
+        # bid-1 snapshot IS its state as of bid 4
+        assert log.read_partition_state(1, 4) == {"n": 10}
+        assert log.read_partition_state(2, 5) is None
+        log.close()
+
+    def test_prune_keeps_each_partitions_newest(self, tmp_path):
+        log = CommitLog(str(tmp_path))
+        log.write_partition_state(0, 0, {"n": 0})
+        log.write_partition_state(0, 4, {"n": 4})
+        log.write_partition_state(1, 1, {"n": 10})    # old but current
+        log.prune_state(keep_from=4)
+        names = sorted(n for n in os.listdir(str(tmp_path))
+                       if n.startswith("state-p"))
+        assert names == ["state-p0000-000000004.json",
+                         "state-p0001-000000001.json"]
+        assert log.read_partition_state(1, 4) == {"n": 10}
+        log.close()
+
+
+# --------------------------------------------------------------------------- #
+# streaming joins
+
+
+class TestStreamStreamJoin:
+    def test_pairs_within_window_across_batches(self):
+        j = StreamStreamJoin(join_window_s=5.0)
+        out1 = j.transform(Table({
+            "key": ["a", "a"], "time": np.array([1.0, 3.0]),
+            "side": ["left", "right"], "value": np.array([10.0, 20.0])}))
+        # same batch: left buffered first, right row probes it
+        assert list(out1["key"]) == ["a"]
+        assert list(out1["left_value"]) == [10.0]
+        assert list(out1["right_value"]) == [20.0]
+        out2 = j.transform(Table({
+            "key": ["a"], "time": np.array([7.0]),
+            "side": ["left"], "value": np.array([30.0])}))
+        # crosses batches: the buffered right row at t=3 matches |7-3|<=5
+        assert list(out2["left_time"]) == [7.0]
+        assert list(out2["right_time"]) == [3.0]
+        out3 = j.transform(Table({
+            "key": ["a"], "time": np.array([20.0]),
+            "side": ["right"], "value": np.array([40.0])}))
+        assert out3.num_rows == 0             # outside every window
+
+    def test_no_match_across_keys(self):
+        j = StreamStreamJoin(join_window_s=10.0)
+        out = j.transform(Table({
+            "key": ["a", "b"], "time": np.array([1.0, 1.0]),
+            "side": ["left", "right"], "value": np.array([1.0, 2.0])}))
+        assert out.num_rows == 0
+
+    def test_watermark_drops_late_and_evicts_buffers(self):
+        j = StreamStreamJoin(join_window_s=2.0, watermark_delay_s=1.0)
+        j.transform(Table({
+            "key": ["a"], "time": np.array([100.0]),
+            "side": ["left"], "value": np.array([1.0])}))
+        assert j.watermark() == 99.0
+        out = j.transform(Table({
+            "key": ["a"], "time": np.array([50.0]),
+            "side": ["right"], "value": np.array([2.0])}))
+        assert out.num_rows == 0 and j.late_rows_dropped == 1
+        j.transform(Table({
+            "key": ["b"], "time": np.array([200.0]),
+            "side": ["left"], "value": np.array([3.0])}))
+        assert j.buffered_rows == 2           # "a"@100 still within horizon
+        # eviction uses the watermark as of batch START: the next batch
+        # sees watermark 199, horizon 197, and drops the stale "a"@100
+        j.transform(Table({"key": [], "time": np.zeros(0),
+                           "side": [], "value": np.zeros(0)}))
+        assert j.buffered_rows == 1           # only "b"@200 survives
+
+    def test_state_doc_roundtrip_continues_identically(self):
+        a = StreamStreamJoin(join_window_s=5.0)
+        a.transform(Table({
+            "key": ["a", "b"], "time": np.array([1.0, 2.0]),
+            "side": ["left", "left"], "value": np.array([1.0, 2.0])}))
+        b = StreamStreamJoin(join_window_s=5.0)
+        b.load_state_doc(json.loads(json.dumps(a.state_doc())))
+        nxt = Table({"key": ["a"], "time": np.array([4.0]),
+                     "side": ["right"], "value": np.array([9.0])})
+        _assert_byte_identical(a.transform(nxt), b.transform(nxt))
+
+
+class TestStreamTableJoin:
+    def _static(self, tmp_path) -> str:
+        path = str(tmp_path / "dim.csv")
+        write_csv(Table({"key": ["a", "b"],
+                         "weight": np.array([1.5, 2.5])}), path)
+        return path
+
+    def test_left_fills_unmatched(self, tmp_path):
+        j = StreamTableJoin(table_path=self._static(tmp_path))
+        out = j.transform(Table({"key": ["a", "zz", "b"],
+                                 "v": np.arange(3.0)}))
+        assert list(out["key"]) == ["a", "zz", "b"]
+        w = np.asarray(out["weight"])
+        assert w[0] == 1.5 and np.isnan(w[1]) and w[2] == 2.5
+
+    def test_inner_drops_unmatched(self, tmp_path):
+        j = StreamTableJoin(table_path=self._static(tmp_path), how="inner")
+        out = j.transform(Table({"key": ["zz", "a"], "v": np.arange(2.0)}))
+        assert list(out["key"]) == ["a"]
+        assert list(out["v"]) == [1.0]
+
+    def test_duplicate_static_key_rejected(self, tmp_path):
+        path = str(tmp_path / "dup.csv")
+        write_csv(Table({"key": ["a", "a"], "w": np.zeros(2)}), path)
+        j = StreamTableJoin(table_path=path)
+        with pytest.raises(ValueError, match="duplicate"):
+            j.transform(Table({"key": ["a"]}))
+
+    def test_colliding_column_prefixed(self, tmp_path):
+        path = str(tmp_path / "dim.csv")
+        write_csv(Table({"key": ["a"], "v": np.array([9.0])}), path)
+        out = StreamTableJoin(table_path=path).transform(
+            Table({"key": ["a"], "v": np.array([1.0])}))
+        assert list(out["v"]) == [1.0]
+        assert list(out["right_v"]) == [9.0]
+
+
+# --------------------------------------------------------------------------- #
+# the parallel query, thread mode: byte identity with P=1
+
+
+class TestParallelThreadMode:
+    def _parallel(self, P: int, stage, src, sink, **kw):
+        pm = pipeline_model(
+            KeyedShuffle(key_col=stage.partition_key_col(),
+                         num_partitions=P), stage)
+        return ParallelStreamingQuery(src, pm, sink, workers="thread", **kw)
+
+    def test_grouped_matches_p1_at_p2_and_p4(self):
+        batches = _grouped_batches()
+        oracle_src, oracle_sink = MemorySource(), MemorySink()
+        oracle = StreamingQuery(
+            oracle_src, GroupedAggregator(group_col="k", value_col="v",
+                                          agg="sum"), oracle_sink)
+        _drive(oracle, oracle_src, batches)
+        oracle.stop()
+        for P in (2, 4):
+            src, sink = MemorySource(), MemorySink()
+            q = self._parallel(P, GroupedAggregator(group_col="k",
+                                                    value_col="v",
+                                                    agg="sum"), src, sink)
+            _drive(q, src, batches)
+            q.stop()
+            _assert_byte_identical(sink.table(), oracle_sink.table())
+            assert q.last_progress["num_partitions"] == P
+            assert q.last_progress["workers"] == "thread"
+
+    def test_join_matches_p1_at_p4_with_late_rows(self):
+        rng = np.random.default_rng(7)
+        batches = []
+        t = 0.0
+        for _ in range(6):
+            n = 24
+            times = t + rng.uniform(0, 8, n)
+            times[0] = max(0.0, t - 30.0)      # a late straggler
+            batches.append(Table({
+                "key": [f"k{int(i)}" for i in rng.integers(0, 6, n)],
+                "time": times,
+                "side": [["left", "right"][int(s)]
+                         for s in rng.integers(0, 2, n)],
+                "value": rng.normal(size=n)}))
+            t += 8.0
+        mk = lambda: StreamStreamJoin(join_window_s=4.0,  # noqa: E731
+                                      watermark_delay_s=5.0)
+        oracle_src, oracle_sink = MemorySource(), MemorySink()
+        oracle = StreamingQuery(oracle_src, mk(), oracle_sink)
+        _drive(oracle, oracle_src, batches)
+        oracle.stop()
+        src, sink = MemorySource(), MemorySink()
+        q = self._parallel(4, mk(), src, sink)
+        _drive(q, src, batches)
+        q.stop()
+        assert oracle_sink.table().num_rows > 0
+        _assert_byte_identical(sink.table(), oracle_sink.table())
+
+    def test_windowed_emission_needs_global_time_hints(self):
+        """One partition's slice carries the max event time; the OTHER
+        partition's window must still finalize. Byte identity with P=1
+        proves the driver's global hint reached every partition."""
+        ka = _key_for_partition(0, 2)
+        kb = _key_for_partition(1, 2)
+        batches = [
+            Table({"g": [ka, kb], "t": np.array([5.0, 6.0]),
+                   "v": np.array([1.0, 2.0])}),
+            # only kb advances event time past the [0, 10) window end
+            Table({"g": [kb], "t": np.array([25.0]),
+                   "v": np.array([3.0])}),
+        ]
+        mk = lambda: WindowedAggregator(  # noqa: E731
+            time_col="t", window_s=10.0, group_col="g", value_col="v",
+            agg="sum", watermark_delay_s=0.0)
+        oracle_src, oracle_sink = MemorySource(), MemorySink()
+        oracle = StreamingQuery(oracle_src, mk(), oracle_sink)
+        _drive(oracle, oracle_src, batches)
+        oracle.stop()
+        # the P=1 run emitted ka's bucket — if the hint machinery were
+        # broken, ka's partition (which saw no row of batch 2) would not
+        out = oracle_sink.table()
+        assert ka in list(out["g"]) and kb in list(out["g"])
+        src, sink = MemorySource(), MemorySink()
+        q = ParallelStreamingQuery(
+            src, pipeline_model(KeyedShuffle(key_col="g",
+                                             num_partitions=2), mk()),
+            sink, workers="thread")
+        _drive(q, src, batches)
+        q.stop()
+        _assert_byte_identical(sink.table(), oracle_sink.table())
+
+    def test_stateless_chain_restores_source_order(self, tmp_path):
+        path = str(tmp_path / "dim.csv")
+        write_csv(Table({"key": ["a", "b", "c"],
+                         "weight": np.array([1.0, 2.0, 3.0])}), path)
+        rng = np.random.default_rng(5)
+        batches = [Table({"key": [f"{c}" for c in
+                          rng.choice(list("abcdz"), 20)],
+                          "v": rng.normal(size=20)}) for _ in range(3)]
+        oracle_src, oracle_sink = MemorySource(), MemorySink()
+        oracle = StreamingQuery(oracle_src,
+                                StreamTableJoin(table_path=path),
+                                oracle_sink)
+        _drive(oracle, oracle_src, batches)
+        oracle.stop()
+        src, sink = MemorySource(), MemorySink()
+        q = ParallelStreamingQuery(
+            src, pipeline_model(KeyedShuffle(key_col="key",
+                                             num_partitions=3),
+                                StreamTableJoin(table_path=path)),
+            sink, workers="thread")
+        _drive(q, src, batches)
+        q.stop()
+        # row ORDER matters here: the hidden row tag must put the merged
+        # output back in source order, and the tag must not leak
+        _assert_byte_identical(sink.table(), oracle_sink.table())
+
+    def test_incremental_checkpoints_and_prune(self, tmp_path):
+        ka = _key_for_partition(0, 2)
+        kb = _key_for_partition(1, 2)
+        src, sink = MemorySource(), MemorySink()
+        q = ParallelStreamingQuery(
+            src, pipeline_model(
+                KeyedShuffle(key_col="k", num_partitions=2),
+                GroupedAggregator(group_col="k", agg="count")),
+            sink, workers="thread", checkpoint_dir=str(tmp_path))
+        src.add_rows(Table({"k": [ka, kb]}))
+        q.process_all_available()
+        assert q.last_progress["partition_states_written"] == 2
+        src.add_rows(Table({"k": [ka]}))      # partition 1 untouched
+        q.process_all_available()
+        assert q.last_progress["partition_states_written"] == 1
+        q.stop()
+        names = sorted(n for n in os.listdir(str(tmp_path))
+                       if n.startswith("state-p"))
+        # prune kept partition 0's bid-1 snapshot and partition 1's
+        # bid-0 one (its newest — incremental writes leave it old)
+        assert names == ["state-p0000-000000001.json",
+                         "state-p0001-000000000.json"]
+
+    def test_restart_recovery_matches_p1_restart(self, tmp_path):
+        d = str(tmp_path / "in")
+        os.makedirs(d)
+        rng = np.random.default_rng(9)
+
+        def add_file(i):
+            write_csv(Table({"k": [f"k{int(x)}" for x in
+                                   rng.integers(0, 8, 10)],
+                             "v": rng.normal(size=10)}),
+                      os.path.join(d, f"f-{i:03d}.csv"))
+
+        def run(ck, sink, parallel):
+            agg = GroupedAggregator(group_col="k", value_col="v",
+                                    agg="mean")
+            src = DirectorySource(d, "*.csv", max_files_per_trigger=1)
+            if parallel:
+                q = ParallelStreamingQuery(
+                    src, pipeline_model(
+                        KeyedShuffle(key_col="k", num_partitions=2), agg),
+                    sink, workers="thread", checkpoint_dir=ck)
+            else:
+                q = StreamingQuery(src, agg, sink, checkpoint_dir=ck)
+            q.process_all_available()
+            q.stop()
+
+        for i in range(2):
+            add_file(i)
+        ck1, ck2 = str(tmp_path / "ck1"), str(tmp_path / "ck2")
+        s1a, s2a = MemorySink(), MemorySink()
+        run(ck1, s1a, parallel=False)
+        run(ck2, s2a, parallel=True)
+        for i in range(2, 4):
+            add_file(i)
+        # restart both from their checkpoints: fresh operator instances,
+        # state recovered from (per-partition) snapshots
+        s1b, s2b = MemorySink(), MemorySink()
+        run(ck1, s1b, parallel=False)
+        run(ck2, s2b, parallel=True)
+        _assert_byte_identical(s2a.table(), s1a.table())
+        _assert_byte_identical(s2b.table(), s1b.table())
+
+    def test_sink_failure_rolls_back_every_partition(self, tmp_path):
+        class FlakySink(MemorySink):
+            def __init__(self):
+                super().__init__()
+                self.failures_left = 1
+
+            def add_batch(self, batch_id, table):
+                if self.failures_left > 0:
+                    self.failures_left -= 1
+                    raise OSError("sink hiccup")
+                super().add_batch(batch_id, table)
+
+        src, sink = MemorySource(), FlakySink()
+        q = ParallelStreamingQuery(
+            src, pipeline_model(
+                KeyedShuffle(key_col="k", num_partitions=2),
+                GroupedAggregator(group_col="k", agg="count")),
+            sink, workers="thread", checkpoint_dir=str(tmp_path))
+        src.add_rows(Table({"k": ["a", "a", "b"]}))
+        with pytest.raises(OSError):
+            q.process_next()
+        assert q.process_next()               # retry of the same plan
+        q.stop()
+        out = sink.table()
+        got = dict(zip(out["k"], out["aggregate"]))
+        assert got == {"a": 2.0, "b": 1.0}    # no double-fold anywhere
+
+
+# --------------------------------------------------------------------------- #
+# the fleet worker protocol (in-process, no processes)
+
+
+def _call(handler, body: dict):
+    from mmlspark_tpu.io_http.schema import HTTPRequestData
+
+    out = handler(Table({"request": [HTTPRequestData.from_json("/", body)]}))
+    resp = out["reply"][0]
+    return resp.status_code, json.loads(resp.entity)
+
+
+class TestPartitionWorkerProtocol:
+    def _handler(self):
+        from mmlspark_tpu.core.serialize import stage_to_blob
+
+        blob = stage_to_blob(pipeline_model(
+            GroupedAggregator(group_col="k", agg="count")))
+        return PartitionWorkerFactory(blob, "q")()
+
+    def _apply(self, p, bid, keys):
+        from mmlspark_tpu.streaming.partition import _encode_rows
+
+        return {"op": "apply", "partition": p, "batch_id": bid,
+                "rows": _encode_rows(Table({"k": keys})), "hints": {}}
+
+    def test_apply_fold_and_idempotent_replay(self):
+        h = self._handler()
+        code, doc = _call(h, self._apply(0, 0, ["a", "a", "b"]))
+        assert code == 200
+        assert doc["rows"]["columns"]["aggregate"]["values"] == [2.0, 1.0]
+        # a re-sent apply for the SAME batch returns the cached reply —
+        # no second fold (counts would read 4/2 if it folded again)
+        code2, doc2 = _call(h, self._apply(0, 0, ["a", "a", "b"]))
+        assert (code2, doc2) == (200, doc)
+
+    def test_fresh_partition_past_bid0_needs_state(self):
+        h = self._handler()
+        code, doc = _call(h, self._apply(1, 5, ["a"]))
+        assert code == 200 and doc.get("need_state")
+        code, doc = _call(h, {"op": "load_state", "partition": 1,
+                              "batch_id": 4,
+                              "state": {"ops": [{"groups":
+                                                 {"a": [3, 3.0, 1.0,
+                                                        1.0]}}]}})
+        assert doc == {"ok": True}
+        code, doc = _call(h, self._apply(1, 5, ["a"]))
+        assert code == 200
+        assert doc["rows"]["columns"]["aggregate"]["values"] == [4.0]
+
+    def test_gap_in_batch_ids_needs_state(self):
+        h = self._handler()
+        _call(h, self._apply(0, 0, ["a"]))
+        code, doc = _call(h, self._apply(0, 2, ["a"]))   # skipped bid 1
+        assert code == 200 and doc.get("need_state") and doc["have"] == 0
+
+    def test_status_and_unknown_op(self):
+        h = self._handler()
+        _call(h, self._apply(0, 0, ["a"]))
+        code, doc = _call(h, {"op": "status"})
+        assert code == 200
+        assert doc["partitions"] == [0] and doc["last"] == {"0": 0}
+        code, doc = _call(h, {"op": "bogus"})
+        assert code == 500 and "error" in doc
+
+
+# --------------------------------------------------------------------------- #
+# PartitionSupervisor (stub fleet — real-fleet coverage is in the slow tier)
+
+
+class _StubFleet:
+    def __init__(self):
+        self.dead: list[int] = []
+        self.respawned: list[int] = []
+
+    def dead_slots(self):
+        return list(self.dead)
+
+    def respawn(self, slot):
+        self.dead.remove(slot)
+        self.respawned.append(slot)
+        return f"http://respawned-{slot}/"
+
+
+class TestPartitionSupervisor:
+    def test_respawns_dead_slots(self):
+        from mmlspark_tpu.resilience import PartitionSupervisor
+
+        fleet = _StubFleet()
+        sup = PartitionSupervisor(fleet, poll_interval_s=0.01).start()
+        try:
+            fleet.dead.append(1)
+            deadline = time.monotonic() + 5
+            while not fleet.respawned and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert fleet.respawned == [1] and fleet.dead == []
+            assert sup.respawns == 1 and sup.state == "running"
+        finally:
+            sup.stop()
+        assert sup.state == "stopped"
+
+    def test_escalates_when_budget_runs_dry(self):
+        from mmlspark_tpu.resilience import (PartitionSupervisor,
+                                             RestartPolicy)
+
+        fleet = _StubFleet()
+        failures = []
+        sup = PartitionSupervisor(
+            fleet, RestartPolicy(max_restarts=1, window_s=300.0),
+            poll_interval_s=0.01,
+            on_failure=lambda f, slot: failures.append(slot)).start()
+        try:
+            fleet.dead.append(0)
+            deadline = time.monotonic() + 5
+            while not fleet.respawned and time.monotonic() < deadline:
+                time.sleep(0.01)
+            fleet.dead.append(0)              # second death inside window
+            deadline = time.monotonic() + 5
+            while sup.state != "failed" and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert sup.state == "failed"
+            assert failures == [0]
+            assert fleet.respawned == [0]     # budget spent on the first
+        finally:
+            sup.stop()
+
+
+# --------------------------------------------------------------------------- #
+# slow tier: fleet worker processes + kill/restart byte identity
+
+
+def _oracle_grouped(batches):
+    src, sink = MemorySource(), MemorySink()
+    q = StreamingQuery(src, GroupedAggregator(group_col="k", value_col="v",
+                                              agg="sum"), sink)
+    _drive(q, src, batches)
+    q.stop()
+    return sink.table()
+
+
+@pytest.mark.slow
+class TestFleetMode:
+    def test_fleet_matches_p1_and_survives_worker_kill(self, tmp_path):
+        """P=2 fleet workers; one is killed while a batch streams. The
+        driver heals (respawn -> need_state -> state re-push -> re-send)
+        and the final output is byte-identical to the P=1 run."""
+        batches = _grouped_batches(seed=21, n_batches=6, rows=400, keys=24)
+        expected = _oracle_grouped(batches)
+        src, sink = MemorySource(), MemorySink()
+        q = ParallelStreamingQuery(
+            src, pipeline_model(
+                KeyedShuffle(key_col="k", num_partitions=2),
+                GroupedAggregator(group_col="k", value_col="v",
+                                  agg="sum")),
+            sink, workers="fleet", checkpoint_dir=str(tmp_path / "ck"))
+        try:
+            _drive(q, src, batches[:2])       # workers spawned + warm
+            assert q._fleet is not None and q._fleet.n_live == 2
+            # kill BOTH workers while batch 2 is in flight (consistent-
+            # hash routing might dodge a single corpse): every apply must
+            # fail mid-batch, heal, answer need_state, and re-fold from
+            # the committed state
+
+            def _kill_all():
+                for slot in range(2):
+                    try:
+                        q._fleet.kill(slot)
+                    except Exception:  # noqa: BLE001 — already dead
+                        pass
+
+            src.add_rows(batches[2])
+            killer = threading.Timer(0.05, _kill_all)
+            killer.start()
+            q.process_all_available()
+            killer.join()
+            _drive(q, src, batches[3:])
+            assert q._fleet.dead_slots() == []     # healed
+        finally:
+            q.stop()
+        _assert_byte_identical(sink.table(), expected)
+
+    def test_chaos_soak_repeated_kills_under_supervision(self, tmp_path):
+        """P=4 partitions hashed onto 2 worker processes (multi-partition
+        workers), a PartitionSupervisor patrolling between batches, and a
+        worker killed every few batches — output stays byte-identical."""
+        from mmlspark_tpu.resilience import (PartitionSupervisor,
+                                             RestartPolicy)
+
+        batches = _grouped_batches(seed=33, n_batches=9, rows=60, keys=32)
+        expected = _oracle_grouped(batches)
+        src, sink = MemorySource(), MemorySink()
+        q = ParallelStreamingQuery(
+            src, pipeline_model(
+                KeyedShuffle(key_col="k", num_partitions=4),
+                GroupedAggregator(group_col="k", value_col="v",
+                                  agg="sum")),
+            sink, workers="fleet", num_workers=2,
+            checkpoint_dir=str(tmp_path / "ck"))
+        sup = None
+        kills = 0
+        try:
+            for i, b in enumerate(batches):
+                src.add_rows(b)
+                q.process_all_available()
+                if sup is None:               # fleet exists after batch 0
+                    sup = PartitionSupervisor(
+                        q._fleet, RestartPolicy(max_restarts=100,
+                                                window_s=300.0),
+                        poll_interval_s=0.05).start()
+                if i in (2, 5, 7):
+                    q._fleet.kill(i % 2)
+                    kills += 1
+            deadline = time.monotonic() + 30
+            while q._fleet.dead_slots() and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert q._fleet.dead_slots() == []
+        finally:
+            if sup is not None:
+                sup.stop()
+            q.stop()
+        assert kills == 3
+        _assert_byte_identical(sink.table(), expected)
+        # every kill was healed by SOMEONE — the supervisor between
+        # batches or the driver's lazy heal inside an apply retry
+        assert sup is not None and sup.state in ("running", "stopped")
+
+
+_DRIVER = """\
+import sys, time
+import numpy as np
+from mmlspark_tpu.core.pipeline import Transformer, pipeline_model
+from mmlspark_tpu.streaming import (DirectorySource, GroupedAggregator,
+    KeyedShuffle, ParallelStreamingQuery, ParquetSink)
+
+d, out, ck, slow = sys.argv[1:5]
+
+class SlowDown(Transformer):          # driver-side: widens the kill window
+    def _transform(self, t):
+        time.sleep(float(slow))
+        return t
+
+pm = pipeline_model(
+    SlowDown(),
+    KeyedShuffle(key_col="k", num_partitions=4),
+    GroupedAggregator(group_col="k", value_col="v", agg="sum"))
+src = DirectorySource(d, "*.csv", max_files_per_trigger=1)
+q = ParallelStreamingQuery(src, pm, ParquetSink(out), checkpoint_dir=ck,
+                           workers="thread")
+q.process_all_available()
+q.stop()
+print("DONE", q.batches_processed, flush=True)
+"""
+
+
+@pytest.mark.slow
+class TestDriverKillAtP4:
+    def test_sigkill_mid_stream_byte_identical_to_p1(self, tmp_path):
+        """SIGKILL the P=4 driver mid-batch, restart from the checkpoint:
+        the parquet output equals the P=1 no-kill run byte for byte —
+        per-partition recovery replays the in-flight batch exactly."""
+        pytest.importorskip("pyarrow")
+        from tests.conftest import subprocess_env
+
+        d = str(tmp_path / "in")
+        os.makedirs(d)
+        rng = np.random.default_rng(41)
+        for i in range(6):
+            write_csv(Table({"k": [f"k{int(x)}" for x in
+                                   rng.integers(0, 10, 20)],
+                             "v": rng.normal(size=20)}),
+                      os.path.join(d, f"f-{i:03d}.csv"))
+        driver = os.path.join(str(tmp_path), "driver.py")
+        with open(driver, "w") as fh:
+            fh.write(_DRIVER)
+        out = str(tmp_path / "out")
+        ck = str(tmp_path / "ck")
+        env = subprocess_env()
+        env["JAX_PLATFORMS"] = "cpu"
+        p1 = subprocess.Popen([sys.executable, driver, d, out, ck, "0.3"],
+                              env=env, stdout=subprocess.PIPE)
+        try:
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                parts = [n for n in os.listdir(out)
+                         if n.startswith("part-")] \
+                    if os.path.isdir(out) else []
+                if len(parts) >= 2:
+                    break
+                if p1.poll() is not None:
+                    break
+                time.sleep(0.02)
+            assert p1.poll() is None, "driver finished before the kill"
+            p1.send_signal(signal.SIGKILL)
+        finally:
+            p1.wait(timeout=30)
+        p2 = subprocess.run([sys.executable, driver, d, out, ck, "0"],
+                            env=env, capture_output=True, text=True,
+                            timeout=300)
+        assert p2.returncode == 0, p2.stderr[-2000:]
+        assert "DONE" in p2.stdout
+        # P=1 oracle over the same files, no kill
+        from mmlspark_tpu.streaming import ParquetSink
+
+        oracle_out = str(tmp_path / "oracle")
+        oracle_sink = ParquetSink(oracle_out)
+        q = StreamingQuery(
+            DirectorySource(d, "*.csv", max_files_per_trigger=1),
+            GroupedAggregator(group_col="k", value_col="v", agg="sum"),
+            oracle_sink, checkpoint_dir=str(tmp_path / "ock"))
+        assert q.process_all_available() == 6
+        q.stop()
+        _assert_byte_identical(ParquetSink(out).table(),
+                               oracle_sink.table())

@@ -3,6 +3,8 @@
 #   1. metric-name lint (static: catches bad names on rarely-taken paths)
 #   2. fleet-observability smoke (2 real replicas scraped + aggregated)
 #      + flight-recorder postmortem smoke (synthetic 3-process incident)
+#      + distributed-streaming smoke (real P=2 partition-parallel query
+#        diagnosed from its checkpoint dir)
 #   3. pipeline-fusion segment report (fails if an exemplar stops fusing)
 #   4. full test suite on the 8-virtual-device CPU mesh
 #   5. multi-chip dryrun (sharding compiles + replicated-model check)
@@ -12,6 +14,7 @@ cd "$(dirname "$0")/.."
 python tools/metric_lint.py
 python tools/diagnose.py --selftest
 python tools/diagnose.py --postmortem --selftest
+python tools/diagnose.py --streaming --selftest
 python tools/fusion_report.py
 python -m pytest tests/ -q
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"

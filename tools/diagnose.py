@@ -22,6 +22,14 @@ Four entry modes:
       snapshot: per-bucket crossover routes with their measured timings,
       path counters, readback lag, and host round-trips per request.
 
+  python tools/diagnose.py --streaming CHECKPOINT_DIR
+      Read a partition-parallel streaming query's checkpoint directory
+      (commits.jsonl + status.json + per-partition snapshots) and print
+      the partition table: rows, queue depths, lag, watermarks,
+      state-backend spill bytes, and each partition's last snapshot
+      batch. `--streaming --selftest` runs a real P=2 query in-process
+      and asserts the snapshot against it.
+
   python tools/diagnose.py --selftest
       Spin up a real 2-replica ServingFleet in-process, push traffic
       through it, diagnose it, then stand up a hot-path serve_model
@@ -559,6 +567,171 @@ def postmortem_selftest() -> int:
     return 0
 
 
+# -- streaming ---------------------------------------------------------- #
+
+def diagnose_streaming(ckpt_dir: str) -> str:
+    """Partition table for one streaming checkpoint directory. Built only
+    from what the query durably wrote (commits.jsonl, status.json, the
+    per-partition snapshot files) — the same sources recovery reads, so
+    what it prints is exactly what a restart would see."""
+    from mmlspark_tpu.streaming.checkpoint import CommitLog
+
+    if not os.path.isdir(ckpt_dir):
+        return f"(no checkpoint directory at {ckpt_dir})"
+    plans, commits = 0, []
+    log_path = os.path.join(ckpt_dir, CommitLog.FILENAME)
+    if os.path.exists(log_path):
+        with open(log_path, encoding="utf-8") as fh:
+            for line in fh:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    break                       # torn tail
+                if rec.get("t") == "plan":
+                    plans += 1
+                elif rec.get("t") == "commit":
+                    commits.append(int(rec["batch_id"]))
+    last = max(commits, default=-1)
+
+    # newest snapshot per partition, straight off the filenames
+    snap_bid: dict[int, int] = {}
+    snap_bytes: dict[int, int] = {}
+    for name in os.listdir(ckpt_dir):
+        parsed = CommitLog._parse_pstate(name)
+        if parsed is None:
+            continue
+        part, bid = parsed
+        if bid >= snap_bid.get(part, -1):
+            snap_bid[part] = bid
+            snap_bytes[part] = os.path.getsize(
+                os.path.join(ckpt_dir, name))
+
+    status = {}
+    try:
+        with open(os.path.join(ckpt_dir, "status.json"),
+                  encoding="utf-8") as fh:
+            status = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        pass
+    pstats = status.get("partitions", {})
+    nparts = int(status.get("num_partitions") or 0)
+    parts = sorted(set(snap_bid)
+                   | {int(p) for p in pstats}
+                   | set(range(nparts)))
+
+    out = [
+        f"query: {status.get('query', '?')} "
+        f"mode={status.get('mode', '?')} "
+        f"key_col={status.get('key_col', '?')} "
+        f"partitions={nparts or len(parts)} "
+        f"last_commit={last} wal_records={plans}+{len(commits)}"
+    ]
+    rows = []
+    for p in parts:
+        st = pstats.get(str(p), {})
+        wm = st.get("watermark")
+        lag = st.get("lag_s")
+        rows.append([
+            str(p),
+            _fmt(st.get("rows_in", float("nan"))),
+            _fmt(st.get("rows_out", float("nan"))),
+            _fmt(st.get("queue_depth", float("nan"))),
+            _fmt(lag * 1e3, 2) if lag is not None else "-",
+            _fmt(wm, 3) if wm is not None else "-",
+            _fmt(st.get("spilled_bytes", 0)),
+            (str(snap_bid[p]) if p in snap_bid else "-"),
+            _fmt(snap_bytes.get(p, float("nan"))),
+        ])
+    if rows:
+        out.append(_render_table(rows, [
+            "partition", "rows_in", "rows_out", "queue", "lag_ms",
+            "watermark", "spill_bytes", "snapshot", "snap_bytes"]))
+    else:
+        out.append("(no partition snapshots or status)")
+    return "\n".join(out)
+
+
+def streaming_selftest() -> int:
+    """Run a real P=2 partition-parallel query in-process (spilling state
+    backend, incremental checkpoints), diagnose its checkpoint dir, and
+    assert the snapshot against the query's own truth plus a P=1 oracle."""
+    import tempfile
+
+    import numpy as np
+
+    from mmlspark_tpu.core.pipeline import pipeline_model
+    from mmlspark_tpu.core.schema import Table
+    from mmlspark_tpu.streaming import (
+        GroupedAggregator, KeyedShuffle, MemorySink, MemorySource,
+        ParallelStreamingQuery, StreamingQuery)
+
+    checks: dict[str, bool] = {}
+    rng = np.random.default_rng(7)
+    data = [Table({"key": [f"k{int(i)}" for i in rng.integers(0, 6, 32)],
+                   "value": np.round(rng.uniform(0, 10, 32), 3)})
+            for _ in range(3)]
+    # one batch whose keys all land in a single partition: the other
+    # partition's state doc is unchanged and must NOT write a snapshot
+    from mmlspark_tpu.streaming import partition_of
+    k_one = next(f"s{i}" for i in range(100)
+                 if partition_of(f"s{i}", 2) == 0)
+    data.append(Table({"key": [k_one] * 8,
+                       "value": np.ones(8, dtype=np.float64)}))
+
+    def stage(spill_dir=None):
+        kw = {}
+        if spill_dir:
+            kw = dict(state_backend="spill", spill_dir=spill_dir,
+                      spill_hot_keys=2)
+        return GroupedAggregator(group_col="key", value_col="value",
+                                 agg="sum", output_col="total", **kw)
+
+    src, sink = MemorySource(), MemorySink()
+    oracle_q = StreamingQuery(src, stage(), sink, name="oracle")
+    for b in data:
+        src.add_rows(b)
+        oracle_q.process_all_available()
+    oracle_q.stop()
+    oracle = sink.table()
+
+    with tempfile.TemporaryDirectory() as d:
+        ckpt = os.path.join(d, "ckpt")
+        src, sink = MemorySource(), MemorySink()
+        q = ParallelStreamingQuery(
+            src,
+            pipeline_model(KeyedShuffle(key_col="key", num_partitions=2),
+                           stage(spill_dir=os.path.join(d, "spill"))),
+            sink, name="diagq", checkpoint_dir=ckpt)
+        incr = []
+        for b in data:
+            src.add_rows(b)
+            q.process_all_available()
+            incr.append(q.last_progress.get("partition_states_written"))
+        q.stop()
+        report = diagnose_streaming(ckpt)
+        print(report)
+        checks["P=2 output matches P=1 oracle"] = oracle.equals(
+            sink.table())
+        checks["status.json snapshot read"] = "mode=thread" in report
+        checks["both partitions in table"] = all(
+            f"\n{p} " in report for p in "01")
+        from mmlspark_tpu.streaming.checkpoint import CommitLog
+
+        checks["per-partition snapshots on disk"] = any(
+            CommitLog._parse_pstate(n) for n in os.listdir(ckpt))
+        checks["single-partition batch writes one snapshot"] = (
+            incr[-1] == 1)
+        checks["spill bytes surfaced"] = (
+            q._pinfo[0].get("spilled_bytes", 0) > 0
+            or q._pinfo[1].get("spilled_bytes", 0) > 0)
+    failed = [name for name, ok in checks.items() if not ok]
+    if failed:
+        print(f"streaming selftest FAILED: {failed}", file=sys.stderr)
+        return 1
+    print(f"streaming selftest OK ({len(checks)} checks)")
+    return 0
+
+
 # -- selftest ----------------------------------------------------------- #
 
 def _selftest_handler(table):
@@ -713,17 +886,30 @@ def main(argv: "list[str] | None" = None) -> int:
     ap.add_argument("--postmortem", nargs="?", const="", metavar="DIR",
                     help="merge the flight-recorder dumps under DIR into "
                          "one incident timeline")
+    ap.add_argument("--streaming", nargs="?", const="", metavar="DIR",
+                    help="partition table for a streaming checkpoint "
+                         "directory (with --selftest: run a real P=2 "
+                         "query and assert the snapshot)")
     ap.add_argument("--selftest", action="store_true",
                     help="run a 2-replica fleet and diagnose it (with "
-                         "--postmortem: synthetic-incident selftest)")
+                         "--postmortem/--streaming: the matching "
+                         "selftest)")
     ap.add_argument("--tail", type=int, default=200,
                     help="timeline events shown by --postmortem DIR")
     args = ap.parse_args(argv)
     modes = [args.rendezvous, args.urls, args.gateway, args.serving,
-             args.postmortem, args.selftest or None]
+             args.postmortem, args.streaming, args.selftest or None]
     if not any(m for m in modes):
         ap.error("pick a mode: --rendezvous/--urls/--gateway/--serving/"
-                 "--postmortem/--selftest")
+                 "--postmortem/--streaming/--selftest")
+    if args.streaming is not None:
+        if args.selftest:
+            return streaming_selftest()
+        if not args.streaming:
+            ap.error("--streaming needs a checkpoint directory "
+                     "(or --selftest)")
+        print(diagnose_streaming(args.streaming))
+        return 0
     if args.postmortem is not None:
         if args.selftest:
             return postmortem_selftest()
