@@ -264,6 +264,41 @@ class ShapeBucketer:
         if not ladder or ladder[-1] != self.max_size:
             ladder.append(self.max_size)
         self.ladder: tuple[int, ...] = tuple(ladder)
+        # padded-vs-real row accounting per rung: at multiple_of=8 mesh
+        # padding a small batch can be MOSTLY padding, and before this
+        # nothing reported it — rung -> [rows_real, rows_padded]
+        self._pad_rows: dict[int, list] = {}
+        self._waste_gauge: Any = None
+
+    def note_pad(self, n_real: int, n_target: int) -> None:
+        """Account one padded dispatch (`pad` calls this itself; callers
+        that pad by hand — fusion's column stack, the serving batcher —
+        call it explicitly). Publishes the per-rung pad_waste_ratio
+        gauge, fail-soft like every dataplane telemetry hook."""
+        ent = self._pad_rows.setdefault(int(n_target), [0, 0])
+        ent[0] += int(n_real)
+        ent[1] += max(int(n_target) - int(n_real), 0)
+        if self._waste_gauge is None:
+            try:
+                from ..observability.metrics import get_registry
+
+                self._waste_gauge = get_registry().gauge(
+                    "mmlspark_tpu_dataplane_pad_waste_ratio",
+                    "fraction of dispatched rows that were bucket padding",
+                    labels=("rung",))
+            except Exception:
+                self._waste_gauge = False
+        if self._waste_gauge:
+            total = ent[0] + ent[1]
+            if total:
+                self._waste_gauge.labels(rung=str(int(n_target))).set(
+                    ent[1] / total)
+
+    def pad_waste(self) -> dict[int, dict]:
+        """{rung: {rows_real, rows_padded, ratio}} since construction."""
+        return {rung: {"rows_real": real, "rows_padded": padded,
+                       "ratio": padded / max(real + padded, 1)}
+                for rung, (real, padded) in sorted(self._pad_rows.items())}
 
     def bucket_for(self, n: int) -> int:
         """Smallest ladder bucket >= n (n must fit the ladder)."""
@@ -287,6 +322,7 @@ class ShapeBucketer:
             raise ValueError(f"cannot pad {n} rows down to {target}")
         mask = np.zeros(target, dtype=bool)
         mask[:n] = True
+        self.note_pad(n, target)
         if target == n:
             return x, mask
         if n == 0:
@@ -303,10 +339,11 @@ class ShapeBucketer:
 # serving info endpoint reports without having to find each model's
 # private cache instance
 _GLOBAL_STATS_LOCK = threading.Lock()
-_GLOBAL_STATS = {"hits": 0, "misses": 0, "recompiles": 0}
+_GLOBAL_STATS = {"hits": 0, "misses": 0, "recompiles": 0,
+                 "compile_seconds": 0.0}
 
 
-def cache_stats() -> dict[str, int]:
+def cache_stats() -> dict[str, float]:
     """Process-wide executable-cache counters (sum over all caches)."""
     with _GLOBAL_STATS_LOCK:
         return dict(_GLOBAL_STATS)
@@ -333,6 +370,12 @@ def ensure_cache_metrics(registry=None) -> None:
             reg.register_callback(
                 name, f"executable-cache {key} across all caches",
                 (lambda k=key: cache_stats()[k]), kind="counter")
+    if not reg.has("mmlspark_tpu_compile_seconds_total"):
+        reg.register_callback(
+            "mmlspark_tpu_compile_seconds_total",
+            "wall-clock seconds spent inside executable builders (XLA "
+            "compiles) across all caches",
+            (lambda: cache_stats()["compile_seconds"]), kind="counter")
 
 
 class ExecutableCache:
@@ -357,6 +400,11 @@ class ExecutableCache:
         self.hits = 0
         self.misses = 0
         self.recompiles = 0
+        # wall-clock seconds inside `builder()` per (family, shape) —
+        # the compile-time ledger that makes warmup cost and recompile
+        # spikes a number instead of an inference from `recompiles`
+        self.compile_seconds = 0.0
+        self._compile_log: dict[tuple, float] = {}
 
     @staticmethod
     def family_key(base: Any, mesh_shape: Any = None,
@@ -396,7 +444,12 @@ class ExecutableCache:
                 self.recompiles += 1
                 deltas["recompiles"] = 1
             self._bump(**deltas)
+            t0 = time.perf_counter()
             value = builder()
+            dt = time.perf_counter() - t0
+            self.compile_seconds += dt
+            self._compile_log[key] = self._compile_log.get(key, 0.0) + dt
+            self._bump(compile_seconds=dt)
             self._entries[key] = value
             seen.add(shape)
             return value
@@ -413,7 +466,21 @@ class ExecutableCache:
     def stats(self) -> dict[str, int]:
         with self._lock:
             return {"hits": self.hits, "misses": self.misses,
-                    "recompiles": self.recompiles, "entries": len(self._entries)}
+                    "recompiles": self.recompiles, "entries": len(self._entries),
+                    "compile_seconds": self.compile_seconds}
+
+    def compile_ledger(self, top: int = 0) -> list[dict]:
+        """Per-(family, bucket) compile seconds, most expensive first —
+        the serving `info()` block that answers "what did warmup cost,
+        and which bucket keeps recompiling". Family keys are repr'd and
+        truncated: they identify, they don't round-trip."""
+        with self._lock:
+            items = sorted(self._compile_log.items(), key=lambda kv: kv[1],
+                           reverse=True)
+        if top:
+            items = items[:int(top)]
+        return [{"family": repr(family)[:120], "shape": repr(shape),
+                 "seconds": dt} for (family, shape), dt in items]
 
 
 # --------------------------------------------------------------------- #

@@ -428,25 +428,39 @@ class _FusedSegment:
             "mesh_shape": mesh_label,
             "uploads": 0, "downloads": 0,
             "prepare_seconds": 0.0, "fetch_seconds": 0.0,
+            "pad_seconds": 0.0, "h2d_seconds": 0.0,
+            "dispatch_seconds": 0.0,
+            "rows_real": 0, "rows_padded": 0,
         }
         if mesh is not None:
             stats["param_placements"] = list(self._param_placements)
         shard_seconds: dict[str, float] = {}
+        shard_rows: dict[str, int] = {}
 
         def prepare(start: int):
             stop = min(start + bs, n)
             m = stop - start
             target = bucketer.bucket_for(m) if bucketer is not None else bs
             cols = {}
+            t_pad = 0.0
             for c in self.upload_cols:
                 chunk = ins[c][start:stop]
                 if target > m:
+                    t0 = time.perf_counter()
                     chunk = np.concatenate(
                         [chunk, np.repeat(chunk[-1:], target - m, axis=0)])
+                    t_pad += time.perf_counter() - t0
                 cols[c] = chunk
+            stats["pad_seconds"] += t_pad
+            stats["rows_real"] += m
+            stats["rows_padded"] += target - m
+            if bucketer is not None and target > m:
+                bucketer.note_pad(m, target)
             # one upload per input column; under a mesh the chunk commits
             # row-sharded, so the transfer lands per-shard on each chip
+            t0 = time.perf_counter()
             dt = DeviceTable.from_host(cols, shardings=in_shardings)
+            stats["h2d_seconds"] += time.perf_counter() - t0
             stats["uploads"] += len(self.upload_cols)
             return dt, m, target
 
@@ -460,7 +474,8 @@ class _FusedSegment:
                 # timing the copies — the spread between the slowest and
                 # fastest chip is the shard-skew gauge
                 host = tuple(
-                    _fetch_sharded(o, m, shard_seconds) for o in outs)
+                    _fetch_sharded(o, m, shard_seconds, shard_rows)
+                    for o in outs)
             stats["fetch_seconds"] += time.perf_counter() - t0
             stats["downloads"] += len(host)
             return host
@@ -470,9 +485,12 @@ class _FusedSegment:
                               name=f"fused-seg{self.index}")
         readback = AsyncReadback(fetch, lag=max(int(readback_lag), 0))
         chunks: list[tuple[np.ndarray, ...]] = []
+        t_run0 = time.perf_counter()
         with tracer.start_span("pipeline.fused_segment", segment=self.index,
                                stages=",".join(self.stage_names), rows=n,
-                               mesh_shape=mesh_label):
+                               mesh_shape=mesh_label) as span:
+            ledger = _ledger("fused", f"seg{self.index}", span=span,
+                             mesh_shape=mesh_label)
             for dt, m, target in prefetch:
                 shape_key = (target, tuple(
                     (str(dt[c].dtype), tuple(dt[c].shape[1:]))
@@ -482,7 +500,17 @@ class _FusedSegment:
                 # observable (steady-state recompiles == 0 is the bar)
                 fn = self._exec_cache.get_or_build(
                     family, shape_key, lambda: jitted)
-                outs = fn(params, tuple(dt[c] for c in self.upload_cols))
+                args = tuple(dt[c] for c in self.upload_cols)
+                ledger.cost((family, shape_key), fn, params, args)
+                t0 = time.perf_counter()
+                outs = fn(params, args)
+                stats["dispatch_seconds"] += time.perf_counter() - t0
+                if ledger.armed:
+                    # attribution mode trades the dispatch->dispatch
+                    # overlap for a visible compute phase: the bracket
+                    # serializes on THIS batch's device results
+                    with ledger.phase("compute"):
+                        _block_ready(outs)
                 chunks.extend(readback.push((outs, m)))
             chunks.extend(readback.drain())
         stats["prepare_seconds"] = prefetch.stats["prepare_seconds"]
@@ -493,6 +521,19 @@ class _FusedSegment:
             skew = per_shard[-1] / max(per_shard[0], 1e-9)
             stats["shard_skew_ratio"] = skew
             _set_shard_skew_gauge(fused_label, mesh_label, skew)
+        if ledger.armed:
+            host_prep = max(stats["prepare_seconds"] - stats["h2d_seconds"]
+                            - stats["pad_seconds"], 0.0)
+            ledger.add("prepare", host_prep)
+            ledger.add("pad", stats["pad_seconds"])
+            ledger.add("h2d", stats["h2d_seconds"])
+            ledger.add("dispatch", stats["dispatch_seconds"])
+            ledger.add("d2h", stats["fetch_seconds"])
+            ledger.note_pad(stats["rows_real"],
+                            stats["rows_real"] + stats["rows_padded"])
+            for dev, sec in shard_seconds.items():
+                ledger.note_shard(dev, sec, rows=shard_rows.get(dev))
+            ledger.done(rtt_s=time.perf_counter() - t_run0)
 
         out = table
         for j, c in enumerate(self.download_cols):
@@ -509,11 +550,14 @@ class _FusedSegment:
         return out, stats
 
 
-def _fetch_sharded(arr: Any, m: int, shard_seconds: dict) -> np.ndarray:
+def _fetch_sharded(arr: Any, m: int, shard_seconds: dict,
+                   shard_rows: "dict | None" = None) -> np.ndarray:
     """Read a device array back shard by shard, accumulating per-device
-    copy seconds into `shard_seconds` (feeds the shard-skew gauge).  Whole
-    -array copy for replicated/single-shard outputs (one transfer suffices
-    and there is no per-chip spread to measure)."""
+    copy seconds into `shard_seconds` (feeds the shard-skew gauge) and,
+    when `shard_rows` is given, per-device row counts (the profiler's
+    shard-attribution table pairs slow shards with how many rows they
+    held).  Whole-array copy for replicated/single-shard outputs (one
+    transfer suffices and there is no per-chip spread to measure)."""
     sharding = getattr(arr, "sharding", None)
     if sharding is not None and getattr(sharding, "is_fully_replicated", False):
         return np.asarray(arr)[:m]
@@ -527,6 +571,8 @@ def _fetch_sharded(arr: Any, m: int, shard_seconds: dict) -> np.ndarray:
         key = str(sh.device)
         shard_seconds[key] = (shard_seconds.get(key, 0.0)
                               + time.perf_counter() - t0)
+        if shard_rows is not None:
+            shard_rows[key] = shard_rows.get(key, 0) + int(piece.shape[0])
         out[sh.index] = piece
     return out[:m]
 
@@ -617,34 +663,52 @@ class ResidentExecutor:
             out[c] = s
         return out
 
-    def dispatch(self, cols: dict) -> tuple:
+    def dispatch(self, cols: dict, ledger: Any = None) -> tuple:
         """Upload one padded batch and launch the resident executable.
         Returns the still-in-flight device outputs (async dispatch): the
-        caller is free to assemble the next batch before `fetch`ing."""
-        ins = {c: np.asarray(cols[c]) for c in self.upload_cols}
-        rows = next(iter(ins.values())).shape[0] if ins else 0
-        family = self._family_for(ins)
-        shape_key = (rows, self._signature(ins))
-        fn = self.segment._exec_cache.get_or_build(
-            family, shape_key, lambda: self._jitted)
-        dt = DeviceTable.from_host(ins, shardings=self._shardings_for(ins))
-        outs = fn(self._params, tuple(dt[c] for c in self.upload_cols))
+        caller is free to assemble the next batch before `fetch`ing.
+        An armed profiler ledger brackets the h2d upload and the XLA
+        dispatch call (serving threads one through per scored batch)."""
+        if ledger is None:
+            ledger = _LEDGER_FALLBACK
+        with ledger.phase("prepare"):
+            ins = {c: np.asarray(cols[c]) for c in self.upload_cols}
+            rows = next(iter(ins.values())).shape[0] if ins else 0
+            family = self._family_for(ins)
+            shape_key = (rows, self._signature(ins))
+            fn = self.segment._exec_cache.get_or_build(
+                family, shape_key, lambda: self._jitted)
+        with ledger.phase("h2d"):
+            dt = DeviceTable.from_host(ins, shardings=self._shardings_for(ins))
+        args = tuple(dt[c] for c in self.upload_cols)
+        ledger.cost((id(self), family, shape_key), fn, self._params, args)
+        with ledger.phase("dispatch"):
+            outs = fn(self._params, args)
         self.dispatches += 1
         self.round_trips += 1
         return outs
 
-    def fetch(self, outs: tuple, n_valid: int) -> dict:
+    def fetch(self, outs: tuple, n_valid: int, ledger: Any = None) -> dict:
         """Block on the device results, slice padding off, and apply the
         staged path's host dtype casts — the columns a `transform` of the
-        same batch would have produced, bit for bit."""
+        same batch would have produced, bit for bit.  When the ledger is
+        armed, the device wait is bracketed separately (`compute`) from
+        the host copy/cast (`d2h`) so the attribution table can split
+        time-on-device from readback bandwidth."""
+        if ledger is None:
+            ledger = _LEDGER_FALLBACK
+        if ledger.armed:
+            with ledger.phase("compute"):
+                _block_ready(outs)
         result: dict[str, np.ndarray] = {}
-        for j, c in enumerate(self.download_cols):
-            arr = np.asarray(outs[j])[:n_valid]
-            kern = self.segment._last_producer[c]
-            want = kern.out_dtypes.get(c)
-            if want is not None and arr.dtype != np.dtype(want):
-                arr = arr.astype(want)
-            result[c] = arr
+        with ledger.phase("d2h"):
+            for j, c in enumerate(self.download_cols):
+                arr = np.asarray(outs[j])[:n_valid]
+                kern = self.segment._last_producer[c]
+                want = kern.out_dtypes.get(c)
+                if want is not None and arr.dtype != np.dtype(want):
+                    arr = arr.astype(want)
+                result[c] = arr
         return result
 
     # -- warmup / AOT ---------------------------------------------------- #
@@ -922,6 +986,60 @@ def _get_tracer():
         return get_tracer()
     except Exception:
         return _NullTracer()
+
+
+class _NullLedgerFallback:
+    """Stand-in when observability.profiler is unavailable (mirrors
+    _NullTracer: fusion must run without the observability package)."""
+
+    armed = False
+
+    def phase(self, name):
+        return _NullSpan()
+
+    def add(self, name, seconds):
+        pass
+
+    def note_pad(self, rows_real, rows_target):
+        pass
+
+    def note_shard(self, shard, seconds, rows=None):
+        pass
+
+    def cost(self, key, fn, *args, **kwargs):
+        return None
+
+    def set(self, **meta):
+        pass
+
+    def done(self, rtt_s=None):
+        pass
+
+
+_LEDGER_FALLBACK = _NullLedgerFallback()
+
+
+def _ledger(kind: str, segment: str, span: Any = None, **meta: Any):
+    """A phase ledger from the process-default profiler (the shared
+    no-op when it is disarmed), or the local fallback when the
+    observability package cannot load."""
+    try:
+        from ..observability.profiler import get_profiler
+
+        return get_profiler().ledger(kind, segment, span=span, **meta)
+    except Exception:
+        return _LEDGER_FALLBACK
+
+
+def _block_ready(outs: Any) -> None:
+    """block_until_ready for the profiler's compute bracket; fail-soft
+    (host-only test doubles have nothing to block on)."""
+    try:
+        import jax
+
+        jax.block_until_ready(outs)
+    except Exception:
+        pass
 
 
 def _set_fusion_gauge(label: str, ratio: float, mesh_shape: str = "1") -> None:

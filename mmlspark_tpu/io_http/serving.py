@@ -47,6 +47,16 @@ __all__ = ["ServingServer", "ServingFleet", "MicroBatchQuery", "serve_model",
 _SERVER_SEQ = itertools.count()
 
 
+def _prof_ledger(kind: str, segment: str, span: Any = None, **meta: Any):
+    """The process profiler's phase ledger for one scored batch — the
+    shared no-op when disarmed (one attribute check on the hot path).
+    Import is deferred so serving never pays observability's package
+    init unless a batch is actually scored."""
+    from ..observability.profiler import get_profiler
+
+    return get_profiler().ledger(kind, segment, span=span, **meta)
+
+
 def _handler_error_response(e: Exception) -> "HTTPResponseData":
     """Uniform 500 payload for a failed scoring batch (continuous and
     micro-batch paths share the error contract)."""
@@ -175,11 +185,13 @@ class _HotPath:
     def native_values(self, feats: np.ndarray) -> np.ndarray:
         return np.asarray(self.native_fn(feats), np.float64)
 
-    def fetch_values(self, outs, n_valid: int):
+    def fetch_values(self, outs, n_valid: int, ledger=None):
         """Block on one in-flight batch's device results and return
         whatever `replies_for` consumes — subclasses with a different
-        reply schema override both as a pair."""
-        return self.executor.fetch(outs, n_valid)[self.output_col]
+        reply schema override both as a pair. An armed `ledger` splits
+        the wait into compute (device) and d2h (host copy) phases."""
+        return self.executor.fetch(outs, n_valid, ledger=ledger)[
+            self.output_col]
 
     def resident_values(self, feats: np.ndarray, n_valid: int):
         outs = self.executor.dispatch({self.feature_col: feats})
@@ -800,12 +812,22 @@ class ServingServer:
                     "executable_cache_hits": exe["hits"],
                     "executable_cache_misses": exe["misses"],
                     "executable_cache_recompiles": exe["recompiles"],
+                    # wall-clock seconds spent inside builders, process-
+                    # wide + the slowest (family, shape) entries of the
+                    # hot path's own cache — where startup time went
+                    "compile_seconds_total": round(
+                        exe.get("compile_seconds", 0.0), 6),
+                    "compile_ledger": (
+                        outer.hot_path.executor.segment
+                        ._exec_cache.compile_ledger(top=8)
+                        if outer.hot_path is not None else None),
                     "bucket_ladder": (list(outer.bucketer.ladder)
                                       if outer.bucketer is not None
                                       else [outer.max_batch_size]),
                     "latency": outer.latency_stats(),
                     "hot_path": (outer.hot_path.snapshot()
                                  if outer.hot_path is not None else None),
+                    "profiler": outer._profiler_info(),
                 }).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
@@ -900,6 +922,17 @@ class ServingServer:
             "p50_ms": float(np.percentile(arr, 50)),
             "p99_ms": float(np.percentile(arr, 99)),
         }
+
+    def _profiler_info(self) -> dict:
+        """The info() `profiler` block: the process profiler's phase
+        attribution (diagnose --perf renders it for a live server).
+        Fail-soft so a broken profiler can never break GET /."""
+        try:
+            from ..observability.profiler import get_profiler
+
+            return get_profiler().snapshot()
+        except Exception:  # noqa: BLE001 — info must always answer
+            return {"enabled": False, "ledgers": 0, "attribution": []}
 
     def reset_latency_stats(self) -> None:
         """Clear the rolling latency window (e.g. after warm-up requests)."""
@@ -1079,6 +1112,7 @@ class ServingServer:
         False = the batch fell outside the cached schema and the caller
         must re-route it to the handler path."""
         hp = self.hot_path
+        t_score = time.perf_counter()
         feats = hp.decoder.decode([ex.request for ex in batch], target)
         if feats is None:
             return False
@@ -1088,8 +1122,20 @@ class ServingServer:
             return False
         self._c_bucket.labels(server=self.server_label,
                               bucket=str(target)).inc()
+        # the ledger opens only after the batch is committed to this
+        # route (a declined batch would leave an uncommitted ledger);
+        # the decode above IS the prepare phase, timed retroactively
+        ledger = _prof_ledger(
+            "request", hp.resident_label,
+            span=batch[0].span if len(batch) == 1 else None,
+            server=self.server_label, bucket=target)
+        if ledger.armed:
+            ledger.add("queue", max(t_score - batch[0].enqueued_at, 0.0))
+            ledger.add("prepare", time.perf_counter() - t_score)
+            ledger.note_pad(len(batch), target)
         try:
-            outs = hp.executor.dispatch({hp.feature_col: feats})
+            outs = hp.executor.dispatch({hp.feature_col: feats},
+                                        ledger=ledger)
         except Exception as e:  # noqa: BLE001 — batch failure -> 500s
             self._c_failed.inc(len(batch))
             for ex in batch:
@@ -1098,7 +1144,7 @@ class ServingServer:
             return True
         hp.resident_batches += 1
         self._c_round_trips.inc()
-        readback.push((outs, batch))
+        readback.push((outs, batch, ledger, time.perf_counter()))
         depth = readback.pending
         for ex in batch:
             ex.readback_lag = depth
@@ -1108,17 +1154,32 @@ class ServingServer:
 
     def _complete_resident(self, item) -> None:
         """AsyncReadback's fetch callback: block on one in-flight batch's
-        device results and write every exchange's reply."""
-        outs, batch = item
+        device results and write every exchange's reply. The dispatch ->
+        drain gap is the lag-N readback hold — attributed to `queue`
+        alongside the input wait, so the attribution table shows the
+        latency the overlap window costs each request."""
+        outs, batch, ledger, t_dispatched = item
         hp = self.hot_path
+        if ledger.armed:
+            ledger.add("queue",
+                       max(time.perf_counter() - t_dispatched, 0.0))
         try:
-            replies = hp.replies_for(hp.fetch_values(outs, len(batch)))
+            vals = hp.fetch_values(outs, len(batch), ledger=ledger)
+            # reply materialization is host readback work too — without
+            # it the phase sum can't explain the measured RTT
+            with ledger.phase("d2h"):
+                replies = hp.replies_for(vals)
         except Exception as e:  # noqa: BLE001 — batch failure -> 500s
             self._c_failed.inc(len(batch))
             replies = [_handler_error_response(e)] * len(batch)
         for ex, resp in zip(batch, replies):
             ex.response = resp
             ex.event.set()
+        if ledger.armed:
+            # server-side RTT for the batch's oldest request: enqueue ->
+            # replies written (the 15% phase-coverage bar in diagnose)
+            ledger.done(
+                rtt_s=time.perf_counter() - batch[0].enqueued_at)
 
     def _score_native(self, batch: "list[_Exchange]") -> bool:
         """Score synchronously on the native C++ tree walk — zero
@@ -1126,17 +1187,27 @@ class ServingServer:
         ragged sizes cost nothing); the small-batch side of the
         crossover. False = re-route to the handler path."""
         hp = self.hot_path
+        t_score = time.perf_counter()
         feats = hp.decoder.decode([ex.request for ex in batch])
         if feats is None:
             return False
+        ledger = _prof_ledger("request", "native",
+                              server=self.server_label)
+        if ledger.armed:
+            ledger.add("queue", max(t_score - batch[0].enqueued_at, 0.0))
+            ledger.add("prepare", time.perf_counter() - t_score)
         try:
-            replies = hp.replies_for(hp.native_values(feats))
+            with ledger.phase("compute"):
+                replies = hp.replies_for(hp.native_values(feats))
         except Exception as e:  # noqa: BLE001 — batch failure -> 500s
             self._c_failed.inc(len(batch))
             replies = [_handler_error_response(e)] * len(batch)
         for ex, resp in zip(batch, replies):
             ex.response = resp
             ex.event.set()
+        if ledger.armed:
+            ledger.done(
+                rtt_s=time.perf_counter() - batch[0].enqueued_at)
         return True
 
     def _score_batch(self, batch: "list[_Exchange]") -> None:
@@ -1151,8 +1222,14 @@ class ServingServer:
         parent = batch[0].span if len(batch) == 1 else None
         if parent is not None and not getattr(parent, "span_id", 0):
             parent = None
+        t_score = time.perf_counter()
         with tracer.start_span("serving.score", parent=parent,
                                batch_rows=len(batch)) as sspan:
+            ledger = _prof_ledger("request", "host", span=sspan,
+                                  server=self.server_label)
+            if ledger.armed:
+                ledger.add("queue",
+                           max(t_score - batch[0].enqueued_at, 0.0))
             target = None
             try:
                 requests = [ex.request for ex in batch]
@@ -1161,10 +1238,15 @@ class ServingServer:
                     self._c_bucket.labels(
                         server=self.server_label,
                         bucket=str(target)).inc()
-                    requests = requests + \
-                        [requests[-1]] * (target - len(requests))
+                    with ledger.phase("pad"):
+                        requests = requests + \
+                            [requests[-1]] * (target - len(requests))
+                    ledger.note_pad(len(batch), target)
                 table = Table({"request": requests})
-                out = self.handler(table)
+                # the handler path scores host-side (or through its own
+                # fused transform): the whole call is its compute phase
+                with ledger.phase("compute"):
+                    out = self.handler(table)
                 replies = out["reply"]
                 if len(replies) != len(requests):
                     raise ValueError(
@@ -1184,6 +1266,8 @@ class ServingServer:
         for ex, resp in zip(batch, replies):
             ex.response = resp
             ex.event.set()
+        if ledger.armed:
+            ledger.done(rtt_s=time.perf_counter() - batch[0].enqueued_at)
 
 
 class MicroBatchQuery:

@@ -8,14 +8,18 @@ traceparent propagation, and SLO burn rates. See docs/observability.md.
 """
 
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
-                      DEFAULT_BUCKETS, METRIC_NAME_RE, get_registry,
-                      set_default_registry, set_enabled)
+                      DEFAULT_BUCKETS, PHASE_BUCKETS, METRIC_NAME_RE,
+                      get_registry, set_default_registry, set_enabled)
 from .tracing import (Span, Tracer, get_tracer, set_default_tracer,
                       load_jsonl, merge_jsonl, format_traceparent,
                       parse_traceparent, current_traceparent,
-                      CHROME_EVENT_KEYS)
+                      CHROME_EVENT_KEYS, PHASE_SPAN_PREFIX, phase_children)
 from .recorder import (FlightRecorder, load_dump, get_recorder,
                        set_default_recorder, DUMP_SCHEMA_VERSION)
+from .profiler import (Profiler, PhaseLedger, PHASES, PROFILER_SERIES,
+                       get_profiler, set_default_profiler,
+                       cost_analysis_of, attribution_from_snapshot,
+                       render_attribution)
 from .stage import InstrumentedTransformer, FlightRecorderTransformer
 from .fleet import (MetricFamily, MetricSample, FamilyList,
                     MetricsAggregator,
@@ -26,13 +30,18 @@ from .slo import (SLO, SLOEngine, SeriesReader, availability_slo,
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "DEFAULT_BUCKETS",
-    "METRIC_NAME_RE", "get_registry", "set_default_registry", "set_enabled",
+    "PHASE_BUCKETS", "METRIC_NAME_RE", "get_registry",
+    "set_default_registry", "set_enabled",
     "Span", "Tracer", "get_tracer", "set_default_tracer", "load_jsonl",
     "merge_jsonl", "format_traceparent", "parse_traceparent",
-    "current_traceparent", "CHROME_EVENT_KEYS", "InstrumentedTransformer",
+    "current_traceparent", "CHROME_EVENT_KEYS", "PHASE_SPAN_PREFIX",
+    "phase_children", "InstrumentedTransformer",
     "FlightRecorderTransformer",
     "FlightRecorder", "load_dump", "get_recorder", "set_default_recorder",
     "DUMP_SCHEMA_VERSION",
+    "Profiler", "PhaseLedger", "PHASES", "PROFILER_SERIES", "get_profiler",
+    "set_default_profiler", "cost_analysis_of", "attribution_from_snapshot",
+    "render_attribution",
     "MetricFamily", "MetricSample", "FamilyList", "MetricsAggregator",
     "parse_prometheus",
     "render_families", "merge_policy_for", "GAUGE_MERGE_POLICIES",

@@ -341,6 +341,10 @@ GAUGE_MERGE_POLICIES: dict[str, str] = {
     "mmlspark_tpu_streaming_partition_watermark_seconds": "min",
     # spill files are disjoint per partition, so bytes genuinely add
     "mmlspark_tpu_streaming_state_spill_bytes": "sum",
+    # bucket-pad waste: the WORST rung across the fleet is what the
+    # attribution table should surface (a replica padding 2x is the
+    # problem even when the fleet average looks fine)
+    "mmlspark_tpu_dataplane_pad_waste_ratio": "max",
 }
 
 _SUFFIX_POLICIES: tuple[tuple[str, str], ...] = (

@@ -38,7 +38,8 @@ from typing import Any, Callable, Iterable
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "get_registry", "set_default_registry", "set_enabled",
-    "DEFAULT_BUCKETS", "METRIC_NAME_RE", "EXEMPLAR_LABEL_SET_MAX",
+    "DEFAULT_BUCKETS", "PHASE_BUCKETS", "METRIC_NAME_RE",
+    "EXEMPLAR_LABEL_SET_MAX",
 ]
 
 METRIC_NAME_RE = re.compile(r"^mmlspark_tpu_[a-z0-9_]+$")
@@ -48,6 +49,16 @@ _LABEL_NAME_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
 DEFAULT_BUCKETS: tuple[float, ...] = (
     0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
     0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+# phase-shaped buckets for the profiler's attribution histograms: a
+# single dispatch phase (h2d, XLA dispatch, d2h slice) is microseconds,
+# not the milliseconds DEFAULT_BUCKETS starts at — resolution must reach
+# below where "where did the microsecond go" lives
+PHASE_BUCKETS: tuple[float, ...] = (
+    0.000001, 0.0000025, 0.000005, 0.00001, 0.000025, 0.00005,
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 1.0,
 )
 
 

@@ -157,6 +157,17 @@ class FlightRecorder:
                     bucket=bucket, queue_depth=queue_depth,
                     latency_s=latency_s, status=status, **extra)
 
+    def record_ledger(self, ledger: str = "", segment: str = "",
+                      phases: "dict | None" = None, **extra: Any) -> None:
+        """One committed profiler phase ledger (observability.profiler):
+        the black box keeps the per-dispatch attribution records around
+        an incident, not just their aggregate histograms — a postmortem
+        can say which phase blew up on the exact slow dispatches."""
+        if not self.enabled:
+            return
+        self.record("profiler.ledger", ledger=ledger, segment=segment,
+                    phases=phases or {}, **extra)
+
     def record_transition(self, component: str, action: str,
                           **detail: Any) -> None:
         """A control-plane state change: breaker trip/close, autoscaler

@@ -42,7 +42,11 @@ from typing import Any
 __all__ = ["Span", "Tracer", "get_tracer", "set_default_tracer",
            "load_jsonl", "merge_jsonl", "CHROME_EVENT_KEYS",
            "format_traceparent", "parse_traceparent",
-           "current_traceparent"]
+           "current_traceparent", "PHASE_SPAN_PREFIX", "phase_children"]
+
+# the profiler's phase child-spans are named `phase.<name>` under the
+# dispatch/request span they decompose (observability.profiler)
+PHASE_SPAN_PREFIX = "phase."
 
 # the schema contract for exported events (load_jsonl verifies it)
 CHROME_EVENT_KEYS = ("name", "cat", "ph", "ts", "dur", "pid", "tid")
@@ -402,6 +406,28 @@ def load_jsonl(path: str) -> list[dict]:
 
 
 merge_jsonl = Tracer.merge_jsonl
+
+
+def phase_children(events: "list[dict]",
+                   parent_span_id: "int | None" = None) -> "dict[int, dict]":
+    """Group the profiler's `phase.*` child events out of an exported
+    Chrome-trace event list: {parent span_id: {phase name: dur_us}}.
+    Pass `parent_span_id` to restrict to one dispatch/request span —
+    what the Perfetto round-trip test and `diagnose.py --perf` use to
+    re-read an attribution straight from a trace file."""
+    out: dict[int, dict] = {}
+    for ev in events:
+        name = ev.get("name", "")
+        if not name.startswith(PHASE_SPAN_PREFIX):
+            continue
+        args = ev.get("args", {})
+        pid_ = args.get("parent_id", 0)
+        if parent_span_id is not None and pid_ != parent_span_id:
+            continue
+        phases = out.setdefault(pid_, {})
+        short = name[len(PHASE_SPAN_PREFIX):]
+        phases[short] = phases.get(short, 0.0) + float(ev.get("dur", 0.0))
+    return out
 
 
 # --------------------------------------------------------------------- #

@@ -40,6 +40,7 @@ __all__ = [
     "data_sharding",
     "replicated_sharding",
     "shard_rows",
+    "shard_row_counts",
     "local_device_count",
     "mesh_shape_label",
     "mesh_device_count",
@@ -193,3 +194,18 @@ def shard_rows(array, mesh: Mesh | None = None, pad_value=0):
         arr = np.pad(arr, pad_width, constant_values=pad_value)
     sharded = jax.device_put(arr, data_sharding(mesh, *([None] * (arr.ndim - 1))))
     return sharded, n
+
+
+def shard_row_counts(array) -> dict[str, int]:
+    """Rows resident on each device of a sharded array, keyed by device
+    label — the row-count half of the profiler's per-shard attribution
+    table (which shard is slow AND how many rows it held). Empty for
+    host arrays / single-shard placements (nothing to attribute)."""
+    shards = list(getattr(array, "addressable_shards", None) or [])
+    if len(shards) <= 1:
+        return {}
+    out: dict[str, int] = {}
+    for sh in shards:
+        key = str(sh.device)
+        out[key] = out.get(key, 0) + int(sh.data.shape[0])
+    return out

@@ -193,8 +193,8 @@ class SARHotPath(_HotPath):
 
     resident_label = "sar_resident"
 
-    def fetch_values(self, outs, n_valid: int):
-        res = self.executor.fetch(outs, n_valid)
+    def fetch_values(self, outs, n_valid: int, ledger=None):
+        res = self.executor.fetch(outs, n_valid, ledger=ledger)
         return res["recommendations"], res["ratings"]
 
     def replies_for(self, vals) -> "list[HTTPResponseData]":

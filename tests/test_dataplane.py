@@ -98,8 +98,10 @@ class TestExecutableCache:
             return build
 
         assert c.get_or_build("fam", (8,), builder("a")) == "a"
-        assert c.stats() == {"hits": 0, "misses": 1, "recompiles": 0,
-                             "entries": 1}
+        st = c.stats()
+        assert st.pop("compile_seconds") >= 0.0
+        assert st == {"hits": 0, "misses": 1, "recompiles": 0,
+                      "entries": 1}
         # same family+shape: hit, builder NOT rerun
         assert c.get_or_build("fam", (8,), builder("b")) == "a"
         assert c.hits == 1 and built == ["a"]

@@ -1,0 +1,274 @@
+#!/usr/bin/env python
+"""Bench regression gate over the BENCH_*/MULTICHIP_* trajectory.
+
+Every round of the bench suite leaves an artifact in the repo root:
+``BENCH_rNN.json`` (the suite's final JSON line — paired-median ratios,
+throughputs, latencies — captured in its ``tail``) and
+``MULTICHIP_rNN.json`` (the sharded-fusion ladder). This gate turns that
+trajectory into CI pass/fail:
+
+  * the LAST artifact of each family is the candidate; every earlier
+    ``rc == 0`` round is history.
+  * each bench row is compared against the BEST prior value for that row
+    (the bench's rows are already paired medians, so best-prior is a
+    stable target — machine-load noise cancels within a row, not across
+    rounds).
+  * a row fails only beyond its NOISE BAND: the row's full historical
+    relative swing ((max - min) / median over prior rounds), floored at
+    ``--floor`` (default 0.15) for rows with little history. A row whose
+    history already swings 2x cannot fail on a 1.5x move — CPU CI
+    benches genuinely do that — while a stable row regressing past the
+    floor fails loudly.
+  * rows with no prior value are reported as new, never failed: a PR
+    adding a bench row must not be gated on its own round.
+
+Direction is inferred from the row name: ``*_per_sec`` / ``*_tflops`` /
+``*_acc`` / ``*_auc`` / ``*_vs_baseline`` are higher-is-better;
+``*_seconds`` / ``*_ms`` / ``*overhead*`` / ``*_skew_ratio`` are
+lower-is-better; anything else (config scalars like ``seq_len``) is not
+gated.
+
+Usage:
+  python tools/bench_gate.py              # gate the repo trajectory
+  python tools/bench_gate.py --selftest   # synthetic regression must
+                                          # fail, noisy history must
+                                          # pass, real trajectory must
+                                          # pass (the ci.sh smoke)
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+ROOT = os.path.normpath(os.path.join(os.path.dirname(__file__), ".."))
+
+# `"name": number` pairs anywhere in the captured tail — the artifact
+# keeps only the LAST ~2000 chars of suite stdout, so the final JSON
+# line is usually truncated mid-object and a structural parse would
+# lose every round; the pair scan recovers the metric rows regardless
+_PAIR_RE = re.compile(r'"([a-z0-9_]+)":\s*(-?\d+(?:\.\d+)?(?:[eE]-?\d+)?)')
+
+_ROUND_RE = re.compile(r"_r(\d+)\.json$")
+
+_HIGHER = ("_per_sec", "_tflops", "_gbps", "_acc", "_auc",
+           "_vs_baseline", "_vs_single_chip")
+_LOWER = ("_seconds", "_ms", "_skew_ratio")
+
+
+def direction(name: str) -> "str | None":
+    """'higher' / 'lower' is better, or None for ungated scalars."""
+    if "overhead" in name:
+        return "lower"
+    if name.endswith(_HIGHER):
+        return "higher"
+    if name.endswith(_LOWER):
+        return "lower"
+    return None
+
+
+def bench_metrics(record: dict) -> dict[str, float]:
+    """Numeric bench rows from one BENCH_rNN.json record."""
+    out: dict[str, float] = {}
+    parsed = record.get("parsed")
+    if isinstance(parsed, dict):
+        blob = json.dumps(parsed)
+        out.update({k: float(v) for k, v in _PAIR_RE.findall(blob)})
+    out.update({k: float(v)
+                for k, v in _PAIR_RE.findall(record.get("tail") or "")})
+    return out
+
+
+def multichip_metrics(record: dict) -> dict[str, float]:
+    """The sharded ladder flattened to per-mesh-size rows."""
+    out: dict[str, float] = {}
+    for row in record.get("fused_sharded_vs_single") or []:
+        nd = row.get("n_devices")
+        for key in ("per_chip_vs_single_chip", "rows_per_sec",
+                    "shard_skew_ratio"):
+            if key in row:
+                out[f"multichip_nd{nd}_{key}"] = float(row[key])
+    return out
+
+
+def load_rounds(pattern: str,
+                extract) -> list[tuple[str, dict[str, float]]]:
+    """(name, metrics) per successful round, in round order."""
+    paths = []
+    for p in glob.glob(pattern):
+        m = _ROUND_RE.search(os.path.basename(p))
+        if m:
+            paths.append((int(m.group(1)), p))
+    rounds = []
+    for _, p in sorted(paths):
+        try:
+            with open(p) as fh:
+                record = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        if record.get("rc") not in (0, None) or record.get("ok") is False:
+            continue
+        metrics = extract(record)
+        if metrics:
+            rounds.append((os.path.basename(p), metrics))
+    return rounds
+
+
+def gate_rounds(rounds: list[tuple[str, dict[str, float]]],
+                floor: float, label: str
+                ) -> tuple[list[str], list[str]]:
+    """(regressions, report lines) for one artifact family."""
+    report: list[str] = []
+    problems: list[str] = []
+    if len(rounds) < 2:
+        report.append(f"{label}: {len(rounds)} usable round(s) — nothing "
+                      "to gate")
+        return problems, report
+    cand_name, cand = rounds[-1]
+    history = rounds[:-1]
+    report.append(f"{label}: candidate {cand_name} vs "
+                  f"{len(history)} prior round(s)")
+    for name in sorted(cand):
+        sense = direction(name)
+        if sense is None:
+            continue
+        prior = [m[name] for _, m in history if name in m]
+        if not prior:
+            report.append(f"  NEW     {name} = {cand[name]:.4g}")
+            continue
+        best = max(prior) if sense == "higher" else min(prior)
+        if best == 0:
+            continue
+        med = sorted(prior)[len(prior) // 2]
+        swing = ((max(prior) - min(prior)) / abs(med)) if med else 0.0
+        band = max(floor, swing)
+        ratio = cand[name] / best
+        bad = (ratio < 1.0 - band) if sense == "higher" \
+            else (ratio > 1.0 + band)
+        tag = "REGRESS" if bad else "ok"
+        report.append(
+            f"  {tag:7s} {name}: {cand[name]:.4g} vs best {best:.4g} "
+            f"(x{ratio:.3f}, band ±{band:.0%}, {sense} is better)")
+        if bad:
+            problems.append(
+                f"{label}/{name}: {cand[name]:.4g} is x{ratio:.3f} of "
+                f"best prior {best:.4g} — beyond the ±{band:.0%} noise "
+                "band")
+    return problems, report
+
+
+def run_gate(root: str, floor: float) -> int:
+    problems: list[str] = []
+    for label, pattern, extract in (
+            ("bench", os.path.join(root, "BENCH_r*.json"), bench_metrics),
+            ("multichip", os.path.join(root, "MULTICHIP_r*.json"),
+             multichip_metrics)):
+        probs, report = gate_rounds(load_rounds(pattern, extract),
+                                    floor, label)
+        print("\n".join(report))
+        problems.extend(probs)
+    if problems:
+        print(f"bench_gate: {len(problems)} regression(s):",
+              file=sys.stderr)
+        for p in problems:
+            print(f"  {p}", file=sys.stderr)
+        return 1
+    print("bench_gate: trajectory OK")
+    return 0
+
+
+# -- selftest ----------------------------------------------------------- #
+
+def _fake_round(path: str, metrics: dict) -> None:
+    tail = json.dumps({"extra": metrics})
+    with open(path, "w") as fh:
+        json.dump({"n": 1, "cmd": "synthetic", "rc": 0,
+                   "tail": tail, "parsed": None}, fh)
+
+
+def selftest(floor: float) -> int:
+    import tempfile
+
+    checks: dict[str, bool] = {}
+    with tempfile.TemporaryDirectory() as d:
+        # stable history, clearly regressed candidate -> must fail
+        _fake_round(os.path.join(d, "BENCH_r01.json"),
+                    {"serving_p50_ms": 1.00, "gbdt_rows_per_sec": 1e6})
+        _fake_round(os.path.join(d, "BENCH_r02.json"),
+                    {"serving_p50_ms": 1.05, "gbdt_rows_per_sec": 1.02e6})
+        _fake_round(os.path.join(d, "BENCH_r03.json"),
+                    {"serving_p50_ms": 2.40, "gbdt_rows_per_sec": 0.4e6})
+        rounds = load_rounds(os.path.join(d, "BENCH_r*.json"),
+                             bench_metrics)
+        probs, report = gate_rounds(rounds, floor, "synthetic")
+        print("\n".join(report))
+        checks["synthetic regression caught"] = len(probs) == 2
+        checks["latency row named"] = any("serving_p50_ms" in p
+                                          for p in probs)
+        checks["throughput row named"] = any("gbdt_rows_per_sec" in p
+                                             for p in probs)
+
+    with tempfile.TemporaryDirectory() as d:
+        # noisy history: the same 2.4 reading is INSIDE the row's
+        # historical swing (0.9..3.1) -> must pass (no flaky CI reds)
+        _fake_round(os.path.join(d, "BENCH_r01.json"),
+                    {"serving_p50_ms": 1.0})
+        _fake_round(os.path.join(d, "BENCH_r02.json"),
+                    {"serving_p50_ms": 3.1})
+        _fake_round(os.path.join(d, "BENCH_r03.json"),
+                    {"serving_p50_ms": 0.9})
+        _fake_round(os.path.join(d, "BENCH_r04.json"),
+                    {"serving_p50_ms": 2.4})
+        rounds = load_rounds(os.path.join(d, "BENCH_r*.json"),
+                             bench_metrics)
+        probs, report = gate_rounds(rounds, floor, "noisy")
+        print("\n".join(report))
+        checks["noisy history passes"] = not probs
+
+    # a new row with no history must never gate
+    with tempfile.TemporaryDirectory() as d:
+        _fake_round(os.path.join(d, "BENCH_r01.json"),
+                    {"serving_p50_ms": 1.0})
+        _fake_round(os.path.join(d, "BENCH_r02.json"),
+                    {"serving_p50_ms": 1.0, "profiler_overhead": 1.01})
+        rounds = load_rounds(os.path.join(d, "BENCH_r*.json"),
+                             bench_metrics)
+        probs, report = gate_rounds(rounds, floor, "new-row")
+        checks["new row reported, not gated"] = (
+            not probs and any("NEW" in ln and "profiler_overhead" in ln
+                              for ln in report))
+
+    # the repo's real trajectory must pass: the gate exists to catch
+    # future regressions, not to indict history
+    print()
+    checks["real trajectory passes"] = run_gate(ROOT, floor) == 0
+
+    failed = [name for name, ok in checks.items() if not ok]
+    if failed:
+        print(f"bench_gate selftest FAILED: {failed}", file=sys.stderr)
+        return 1
+    print(f"bench_gate selftest OK ({len(checks)} checks)")
+    return 0
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=ROOT,
+                    help="directory holding BENCH_*/MULTICHIP_* artifacts")
+    ap.add_argument("--floor", type=float, default=0.15,
+                    help="minimum per-row noise band (relative)")
+    ap.add_argument("--selftest", action="store_true",
+                    help="synthetic regression caught + noise passed + "
+                         "real trajectory passes")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return selftest(args.floor)
+    return run_gate(args.dir, args.floor)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

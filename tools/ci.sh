@@ -5,16 +5,21 @@
 #      + flight-recorder postmortem smoke (synthetic 3-process incident)
 #      + distributed-streaming smoke (real P=2 partition-parallel query
 #        diagnosed from its checkpoint dir)
-#   3. pipeline-fusion segment report (fails if an exemplar stops fusing)
-#   4. full test suite on the 8-virtual-device CPU mesh
-#   5. multi-chip dryrun (sharding compiles + replicated-model check)
-#   6. benchmark smoke on CPU (fail-soft backend selection)
+#      + perf-attribution smoke (armed profiler on a live resident
+#        server; phase sum must cover the measured RTT)
+#   3. bench regression gate over the BENCH_*/MULTICHIP_* trajectory
+#   4. pipeline-fusion segment report (fails if an exemplar stops fusing)
+#   5. full test suite on the 8-virtual-device CPU mesh
+#   6. multi-chip dryrun (sharding compiles + replicated-model check)
+#   7. benchmark smoke on CPU (fail-soft backend selection)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 python tools/metric_lint.py
 python tools/diagnose.py --selftest
 python tools/diagnose.py --postmortem --selftest
 python tools/diagnose.py --streaming --selftest
+python tools/diagnose.py --perf --selftest
+python tools/bench_gate.py --selftest
 python tools/fusion_report.py
 python -m pytest tests/ -q
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"

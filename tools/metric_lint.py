@@ -41,6 +41,13 @@ the static skeleton), and enforces:
      are present, and ``fleet.parse_prometheus`` →
      ``fleet.render_families`` round-trips the text byte-identically —
      a renderer drift here would corrupt exemplars at the aggregator.
+  7. profiler phase vocabulary: every ``*_seconds`` histogram the
+     profiler publishes (``observability.profiler.PROFILER_SERIES``)
+     must carry a ``phase`` label, and a live Profiler driven through a
+     full ledger must only ever emit phase label VALUES from the fixed
+     vocabulary ``observability.profiler.PHASES`` — a free-form phase
+     string would mint an unbounded label set and split the attribution
+     table across misspellings.
 
 Usage: python tools/metric_lint.py    # exit 1 with a report if any fail
 """
@@ -235,6 +242,70 @@ def lint_exemplars() -> list[str]:
     return problems
 
 
+# -- rule 7: profiler phase vocabulary ---------------------------------- #
+
+
+def lint_profiler_phases() -> list[str]:
+    """Rule 7: the profiler's ``*_seconds`` histograms must declare the
+    ``phase`` label (statically, via its PROFILER_SERIES manifest), and
+    a live ledger driven through every phase must emit only label values
+    from the fixed PHASES vocabulary."""
+    sys.path.insert(0, ROOT)
+    try:
+        from mmlspark_tpu.observability.metrics import MetricsRegistry
+        from mmlspark_tpu.observability.profiler import (PHASE_LABEL,
+                                                         PHASES,
+                                                         PROFILER_SERIES,
+                                                         Profiler)
+    finally:
+        sys.path.pop(0)
+    problems = []
+    for name, (kind, labelnames) in sorted(PROFILER_SERIES.items()):
+        if name.endswith("_seconds") and kind == "histogram" \
+                and PHASE_LABEL not in labelnames:
+            problems.append(
+                f"profiler series {name!r} is a timing histogram without "
+                f"a {PHASE_LABEL!r} label — attribution cannot group it "
+                "by phase")
+    # live exercise: one ledger touching every phase, then inspect the
+    # actual label values the registry recorded
+    reg = MetricsRegistry()
+    prof = Profiler(registry=reg, enabled=True)
+    led = prof.ledger("lint", "seg0")
+    for ph in PHASES:
+        led.add(ph, 0.001)
+    led.note_pad(6, 8)
+    led.note_shard("TPU_0", 0.002, rows=6)
+    led.done(rtt_s=0.01)
+    prof.flush()  # commits drain on a background thread
+    try:
+        led.add("not_a_phase", 0.001)
+    except ValueError:
+        pass
+    else:
+        problems.append(
+            "PhaseLedger.add accepted a phase outside PHASES — the "
+            "vocabulary is not enforced at the recording site")
+    vocab = set(PHASES)
+    seen_phases = 0
+    for name, fam in reg.snapshot().items():
+        for sample in fam.get("samples", []):
+            phase = (sample.get("labels") or {}).get(PHASE_LABEL)
+            if phase is None:
+                continue
+            seen_phases += 1
+            if phase not in vocab:
+                problems.append(
+                    f"live profiler emitted phase label {phase!r} on "
+                    f"{name!r} — outside the fixed vocabulary "
+                    f"{'|'.join(PHASES)}")
+    if not seen_phases:
+        problems.append(
+            "live profiler ledger committed no phase-labeled samples — "
+            "the rule 7 dynamic check is vacuous")
+    return problems
+
+
 def main() -> None:
     checked = 0
     problems: list[str] = []
@@ -245,13 +316,15 @@ def main() -> None:
             checked += sum(1 for line in fh
                            for _ in LITERAL_RE.finditer(line))
     problems.extend(lint_exemplars())
+    problems.extend(lint_profiler_phases())
     if problems:
         print(f"metric_lint: {len(problems)} problem(s):")
         for p in problems:
             print(f"  {p}")
         raise SystemExit(1)
     print(f"metric_lint: {checked} metric-name literal(s) OK; "
-          "exemplar exposition OK (rule 6)")
+          "exemplar exposition OK (rule 6); "
+          "profiler phase vocabulary OK (rule 7)")
 
 
 if __name__ == "__main__":
