@@ -859,6 +859,64 @@ def bench_trainer(peak_tflops: "float | None") -> dict:
     }
 
 
+def bench_trainer_checkpoint_overhead() -> dict:
+    """The elastic-training paired row: steady-state DNN epoch time with
+    per-epoch checkpointing ON (checkpoint_dir + checkpoint_every_n=1:
+    every epoch serializes params/opt-state and lands them through
+    atomic_write + manifest update) vs OFF. Same estimator as
+    bench_trainer — fit(1+k) - fit(1) cancels the compile — and the two
+    arms alternate within each pass so host noise hits both equally; the
+    reported ratio is the median of per-pass ratios. Acceptance bar
+    (ISSUE 14): checkpointed/plain <= 1.05."""
+    import tempfile
+
+    from mmlspark_tpu.core.schema import Table
+    from mmlspark_tpu.nn.trainer import DNNLearner
+
+    rng = np.random.default_rng(9)
+    # sized so one epoch is O(500ms): the checkpoint cost is a FIXED
+    # ~10ms per-snapshot tax (serialize + payload fsync + manifest
+    # fsync), so a toy epoch would measure fsync latency against
+    # nothing — the ratio is only meaningful when the epoch does real
+    # work, as any actual training run does
+    n, d, classes = 16384, 256, 10
+    x = rng.normal(size=(n, d))
+    y = rng.integers(0, classes, size=n).astype(np.float64)
+    tbl = Table({"features": x, "label": y})
+    extra_epochs = 4
+
+    def fit_seconds(epochs: int, ckpt_dir: "str | None") -> float:
+        kw = dict(checkpoint_dir=ckpt_dir, checkpoint_every_n=1) \
+            if ckpt_dir else {}
+        learner = DNNLearner(
+            architecture="mlp", epochs=epochs, batch_size=128,
+            model_config={"features": (512, 256), "num_outputs": classes},
+            use_mesh=False, seed=0, **kw)
+        t0 = time.perf_counter()
+        learner.fit(tbl)
+        return time.perf_counter() - t0
+
+    ratios, plain_s, ckpt_s = [], [], []
+    for _ in range(3):
+        with tempfile.TemporaryDirectory() as ck:
+            # a fresh dir per pass: the checkpointed arm must WRITE every
+            # epoch, not resume past the work the plain arm does
+            t_off = max(fit_seconds(1 + extra_epochs, None)
+                        - fit_seconds(1, None), 1e-9)
+            with tempfile.TemporaryDirectory() as ck1:
+                t_on = max(fit_seconds(1 + extra_epochs, ck)
+                           - fit_seconds(1, ck1), 1e-9)
+        plain_s.append(t_off)
+        ckpt_s.append(t_on)
+        ratios.append(t_on / t_off)
+    return {
+        "ratio_checkpointed": float(np.median(ratios)),
+        "plain_epoch_seconds": float(np.median(plain_s)) / extra_epochs,
+        "checkpointed_epoch_seconds": float(
+            np.median(ckpt_s)) / extra_epochs,
+    }
+
+
 def bench_serving() -> dict:
     """Continuous-mode serving latency (p50/p99 ms) on a warm jitted model —
     the measured counterpart of the reference's ~1 ms claim
@@ -2302,6 +2360,12 @@ def _run_suite(platform: str) -> dict:
               file=sys.stderr)
         profiler = None
     try:
+        ckpt_overhead = bench_trainer_checkpoint_overhead()
+    except Exception as e:  # noqa: BLE001 — overhead row is auxiliary
+        print(f"bench: trainer checkpoint overhead bench failed ({e!r})",
+              file=sys.stderr)
+        ckpt_overhead = None
+    try:
         fleet_scrape = bench_fleet_scrape()
     except Exception as e:  # noqa: BLE001 — aggregation row is auxiliary
         print(f"bench: fleet scrape bench failed ({e!r})", file=sys.stderr)
@@ -2433,6 +2497,15 @@ def _run_suite(platform: str) -> dict:
             "profiler_disabled_cost_us": round(
                 profiler["disabled_cost_us_per_request"], 3)
                 if profiler else None,
+            "trainer_checkpoint_overhead": round(
+                ckpt_overhead["ratio_checkpointed"], 4)
+                if ckpt_overhead else None,
+            "trainer_checkpoint_epoch_ms": round(
+                ckpt_overhead["checkpointed_epoch_seconds"] * 1e3, 3)
+                if ckpt_overhead else None,
+            "trainer_plain_epoch_ms": round(
+                ckpt_overhead["plain_epoch_seconds"] * 1e3, 3)
+                if ckpt_overhead else None,
             "fleet_scrape_aggregate_ms": {
                 str(n): round(v, 3) for n, v in
                 fleet_scrape["aggregate_ms_by_n"].items()}

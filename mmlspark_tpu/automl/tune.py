@@ -16,6 +16,9 @@ are possible by passing estimators configured with different meshes.
 from __future__ import annotations
 
 import itertools
+import json
+import os
+import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Iterable, Sequence
 
@@ -166,6 +169,32 @@ _MAXIMIZE = {
 }
 
 
+def _tune_record(kind: str, **data: Any) -> None:
+    try:
+        from ..observability.recorder import get_recorder
+
+        get_recorder().record(kind, **data)
+    except Exception:  # noqa: BLE001 — telemetry never blocks the sweep
+        pass
+
+
+def _give_trial_checkpoints(est, directory: str) -> None:
+    """Point a trial's estimator at its own checkpoint directory so a
+    killed trial resumes MID-fit, not from scratch. Only estimators that
+    expose the checkpoint Params participate; an explicitly-set
+    checkpoint_dir on the template estimator is left alone. Estimators
+    whose checkpointing is off by default (GBDT's checkpoint_every_n=0)
+    get a per-round cadence — CV fold fits are small, and the template
+    estimator's own setting wins when present."""
+    if "checkpoint_dir" not in est._params or est.get("checkpoint_dir"):
+        return
+    kw: dict[str, Any] = {"checkpoint_dir": directory}
+    if ("checkpoint_every_n" in est._params
+            and not int(est.get("checkpoint_every_n") or 0)):
+        kw["checkpoint_every_n"] = 1
+    est.set(**kw)
+
+
 @register_stage
 class TuneHyperparameters(HasLabelCol, Estimator):
     """K-fold CV search over estimators × param maps, trials on a thread
@@ -184,6 +213,20 @@ class TuneHyperparameters(HasLabelCol, Estimator):
     # one (reference thread-pool trials, TuneHyperparameters.scala:79-92,
     # share the whole cluster instead). 0 = all trials on the default mesh.
     trial_submeshes = Param(0, "disjoint data submeshes for parallel trials", ptype=int)
+    # preemption-tolerant sweeps (resilience/elastic.py): completed trials
+    # land in a checksummed ledger under checkpoint_dir and are skipped on
+    # resume; in-flight trials get per-(trial, fold) checkpoint dirs so a
+    # killed fit resumes mid-trial. A resumed sweep reproduces the
+    # uninterrupted sweep's best model byte-for-byte.
+    checkpoint_dir = Param(
+        None, "sweep checkpoint directory (trial ledger + per-trial dirs)",
+        ptype=str)
+    trial_restarts = Param(
+        0, "transient-failure retries per trial (RestartPolicy budget)",
+        ptype=int)
+
+    # programmatic override for the Param-built default restart policy
+    restart_policy = None
 
     def _space(self):
         sp = self.get("param_space")
@@ -242,11 +285,47 @@ class TuneHyperparameters(HasLabelCol, Estimator):
             for sub in split_mesh(get_mesh(), int(self.get("trial_submeshes"))):
                 submesh_pool.put(sub)
 
-        def run_folds(mi, pm):
+        # sweep checkpointing: a checksummed trial ledger (reusing the
+        # TrainingCheckpointer store) + per-(trial, fold) checkpoint dirs
+        ckpt_dir = self.get("checkpoint_dir")
+        ledger: dict[str, float] = {}
+        ledger_ckpt = None
+        ledger_lock = threading.Lock()
+        if ckpt_dir:
+            from ..resilience.elastic import TrainingCheckpointer
+
+            ledger_ckpt = TrainingCheckpointer(
+                os.path.join(ckpt_dir, "_trials"), keep=2)
+            loaded = ledger_ckpt.load_latest()
+            if loaded is not None:
+                try:
+                    doc = json.loads(loaded[0].decode("utf-8"))
+                    if doc.get("kind") == "tune-trials":
+                        ledger = dict(doc.get("trials", {}))
+                except ValueError:
+                    ledger = {}
+
+        def trial_key(ti, mi, pm):
+            # the param map is part of the key: a changed search space must
+            # re-run, not inherit a stale score
+            return f"{ti}:" + json.dumps([mi, pm], sort_keys=True,
+                                         default=str)
+
+        policy = self.restart_policy
+        if policy is None and int(self.get("trial_restarts") or 0) > 0:
+            from ..resilience.supervisor import RestartPolicy
+
+            policy = RestartPolicy(
+                max_restarts=int(self.get("trial_restarts")))
+
+        def run_folds(ti, mi, pm):
             scores = []
-            for train_idx, valid_idx in folds:
+            for fi, (train_idx, valid_idx) in enumerate(folds):
                 train, valid = table.gather(train_idx), table.gather(valid_idx)
                 est = models[mi].copy(pm)
+                if ckpt_dir:
+                    _give_trial_checkpoints(est, os.path.join(
+                        ckpt_dir, f"trial-{ti:04d}", f"fold-{fi}"))
                 fitted = est.fit(train)
                 scored = fitted.transform(valid)
                 row = stats.transform(scored)
@@ -257,30 +336,65 @@ class TuneHyperparameters(HasLabelCol, Estimator):
                 scores.append(float(np.asarray(row[metric])[0]))
             return float(np.mean(scores))
 
-        def run_trial(args):
-            mi, pm = args
+        def run_trial_once(ti, mi, pm):
             if submesh_pool is None:
-                return run_folds(mi, pm)
+                return run_folds(ti, mi, pm)
             from ..parallel.mesh import use_mesh
 
             sub = submesh_pool.get()   # blocks until an ICI partition frees up
             try:
                 with use_mesh(sub):
-                    return run_folds(mi, pm)
+                    return run_folds(ti, mi, pm)
             finally:
                 submesh_pool.put(sub)
 
+        def run_trial(args):
+            from ..resilience.elastic import Preempted
+
+            ti, (mi, pm) = args
+            key = trial_key(ti, mi, pm)
+            if key in ledger:
+                _tune_record("tune.trial_skipped", trial=ti)
+                return float(ledger[key])
+            sess = policy.backoff.session() if policy is not None else None
+            while True:
+                try:
+                    out = run_trial_once(ti, mi, pm)
+                    break
+                except Preempted:
+                    raise   # the process is draining — completed trials are
+                            # already durable in the ledger; do not retry
+                except Exception as e:  # noqa: BLE001 — classified below
+                    if (policy is None or policy.is_fatal(e)
+                            or sess is None or not sess.should_retry()):
+                        raise
+                    _tune_record("tune.trial_retry", trial=ti,
+                                 error=f"{type(e).__name__}: {e}")
+                    sess.backoff()
+            if ledger_ckpt is not None:
+                with ledger_lock:
+                    ledger[key] = out
+                    ledger_ckpt.save(
+                        json.dumps({"kind": "tune-trials",
+                                    "trials": ledger}).encode("utf-8"),
+                        tag=f"trials-{len(ledger):04d}",
+                        meta={"done": len(ledger), "total": len(trials)})
+            return out
+
         with ThreadPoolExecutor(max_workers=self.get("parallelism")) as pool:
-            results = list(pool.map(run_trial, trials))
+            results = list(pool.map(run_trial, enumerate(trials)))
 
         best_i = int(np.argmax(results) if maximize else np.argmin(results))
         best_mi, best_pm = trials[best_i]
+        refit_est = models[best_mi].copy(best_pm)
+        if ckpt_dir:
+            # the final fit resumes after a kill too
+            _give_trial_checkpoints(
+                refit_est, os.path.join(ckpt_dir, "refit"))
         if self.get("refit"):
-            best_model = models[best_mi].copy(best_pm).fit(table)
+            best_model = refit_est.fit(table)
         else:
-            best_model = models[best_mi].copy(best_pm).fit(
-                table.gather(folds[0][0])
-            )
+            best_model = refit_est.fit(table.gather(folds[0][0]))
         out = TuneHyperparametersModel()
         out.best_model = best_model
         out.best_metric = results[best_i]

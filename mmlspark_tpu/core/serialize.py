@@ -191,7 +191,13 @@ def stage_to_blob(stage: Any) -> str:
     """Serialize a stage (directory format) into one base64 string — used by
     composite models (TrainedClassifierModel, TuneHyperparametersModel, …)
     to embed sub-stages in their own state, the role of the reference's
-    ConstructorWritable nesting (core/serialize/ConstructorWriter.scala)."""
+    ConstructorWritable nesting (core/serialize/ConstructorWriter.scala).
+
+    The archive is deterministic: members are sorted and stamped with a
+    fixed epoch, so two fits that produce the same stage produce the same
+    blob — equal models compare equal as strings, across processes and
+    across wall-clock time (the elastic-training byte-identity contract
+    leans on this)."""
     import base64
     import io
     import tempfile
@@ -200,12 +206,19 @@ def stage_to_blob(stage: Any) -> str:
     with tempfile.TemporaryDirectory() as d:
         p = os.path.join(d, "stage")
         save_stage(stage, p)
+        members = []
+        for root, dirs, files in os.walk(p):
+            dirs.sort()
+            for fname in sorted(files):
+                full = os.path.join(root, fname)
+                members.append((os.path.relpath(full, p), full))
         buf = io.BytesIO()
         with zipfile.ZipFile(buf, "w") as zf:
-            for root, _, files in os.walk(p):
-                for fname in files:
-                    full = os.path.join(root, fname)
-                    zf.write(full, os.path.relpath(full, p))
+            for arcname, full in members:
+                info = zipfile.ZipInfo(arcname, date_time=(1980, 1, 1,
+                                                           0, 0, 0))
+                with open(full, "rb") as fh:
+                    zf.writestr(info, fh.read())
         return base64.b64encode(buf.getvalue()).decode()
 
 

@@ -100,6 +100,16 @@ class TrainOptions:
     # VMEM. Opt-in until measured on-chip (tools/sweep_hist.py sweeps it).
     bin_dtype: str = "int32"
     init_model: "Booster | None" = None   # warm start (reference modelString)
+    # preemption-tolerant training (resilience/elastic.py): with a
+    # checkpoint_dir and checkpoint_every_n > 0 the fused boosting loop
+    # runs in round-aligned chunks, snapshotting the booster-so-far after
+    # each chunk and resuming from the newest verified snapshot. The
+    # resumed model is byte-identical to an uninterrupted fit (global
+    # round indices feed every RNG fold). Disabled under early stopping
+    # (the ES carry spans rounds) and single-class dart (cross-round
+    # drop algebra).
+    checkpoint_dir: "str | None" = None
+    checkpoint_every_n: int = 0
     seed: int = 0
 
 
@@ -368,60 +378,138 @@ class Booster:
             from .fused import FusedTrainSpec, make_fused_train_fn
 
             num_rounds = opts.num_iterations - start_iter
-            if num_rounds > 0:
-                spec = FusedTrainSpec(
-                    num_rounds=num_rounds,
-                    num_class=k,
-                    boosting_type=(
-                        "gbdt" if opts.boosting_type == "dart"
-                        else opts.boosting_type
-                    ),
-                    bagging_fraction=opts.bagging_fraction,
-                    bagging_freq=opts.bagging_freq,
-                    feature_fraction=opts.feature_fraction,
-                    top_rate=opts.top_rate,
-                    other_rate=opts.other_rate,
-                    early_stopping_round=(
-                        opts.early_stopping_round if es_active else 0
-                    ),
-                    renew_alpha=renew_alpha,
-                    renew_weighted=renew_weighted,
+            ckpt = None
+            ck_every = int(opts.checkpoint_every_n or 0)
+            if opts.checkpoint_dir and ck_every > 0 and num_rounds > 0:
+                if es_active:
+                    if log:
+                        log("checkpointing disabled: early stopping carries "
+                            "cross-round state inside the fused scan")
+                else:
+                    from ..resilience.elastic import TrainingCheckpointer
+
+                    ckpt = TrainingCheckpointer(opts.checkpoint_dir)
+            fit_done = 0
+            if ckpt is not None:
+                restored = _restore_snapshot(ckpt, opts, k, start_iter, log)
+                if restored is not None:
+                    snap, fit_done = restored
+                    fit_done = min(fit_done, num_rounds)
+                    trees = [snap._tree_dict(t)
+                             for t in range(snap.feature.shape[0])]
+                    tree_classes = [int(c) for c in snap.tree_class]
+                    if opts.boosting_type != "rf" and fit_done > 0:
+                        # re-derive the carry: predict_raw accumulates
+                        # init + per-tree f32 adds in strict tree order,
+                        # bit-identical to the in-scan pred updates
+                        raw = snap.predict_raw(x)
+                        raw_p = np.concatenate(
+                            [raw, np.zeros((pad,) + raw.shape[1:])])
+                        pred = jnp.asarray(
+                            raw_p, jnp.float32).reshape(pred.shape)
+            if num_rounds > 0 and fit_done < num_rounds:
+                spec_boosting = (
+                    "gbdt" if opts.boosting_type == "dart"
+                    else opts.boosting_type
                 )
-                fused = make_fused_train_fn(
-                    f, num_bins, cfg, mapper.num_bins, cat_mask, obj_fn, spec,
-                    mesh=mesh,
-                    cache_key=(opts.objective, opts.alpha,
-                               opts.tweedie_variance_power, opts.fair_c),
-                    val_loss_fn=val_loss_fn if es_active else None,
-                )
+
+                def build_fused(nr):
+                    spec = FusedTrainSpec(
+                        num_rounds=nr,
+                        num_class=k,
+                        boosting_type=spec_boosting,
+                        bagging_fraction=opts.bagging_fraction,
+                        bagging_freq=opts.bagging_freq,
+                        feature_fraction=opts.feature_fraction,
+                        top_rate=opts.top_rate,
+                        other_rate=opts.other_rate,
+                        early_stopping_round=(
+                            opts.early_stopping_round if es_active else 0
+                        ),
+                        renew_alpha=renew_alpha,
+                        renew_weighted=renew_weighted,
+                    )
+                    return make_fused_train_fn(
+                        f, num_bins, cfg, mapper.num_bins, cat_mask, obj_fn,
+                        spec, mesh=mesh,
+                        cache_key=(opts.objective, opts.alpha,
+                                   opts.tweedie_variance_power, opts.fair_c),
+                        val_loss_fn=val_loss_fn if es_active else None,
+                    )
+
                 y_f = jnp.asarray(y_pad, jnp.float32)
                 seed = opts.seed if opts.seed else opts.bagging_seed
-                if log:
-                    log(f"fused boosting: {num_rounds} rounds x {k} class(es) "
-                        "in one XLA program (first run compiles)")
-                args = (bins_dev, y_f, base_mask, pred, seed)
-                if es_active:
-                    args = args + (xv_bins, y_val_dev, val_raw)
-                t_stack, _pred, (r_best_dev, stopped_dev) = fused(*args)
-                kept_rounds = num_rounds
-                if es_active:
-                    r_best = int(r_best_dev)
-                    if bool(stopped_dev) and r_best >= 0:
-                        kept_rounds = r_best + 1
-                        if log:
-                            log(f"early stop after round {r_best + start_iter} "
-                                f"(kept {kept_rounds}/{num_rounds} rounds)")
-                    best_iter = start_iter + r_best if r_best >= 0 else -1
-                if log:
-                    log(f"fused boosting: done ({kept_rounds * k} trees)")
-                t_host = {kf: np.asarray(v) for kf, v in t_stack._asdict().items()}
                 names = ("feature", "threshold_bin", "is_categorical",
                          "left", "right", "value", "gain", "cat_bitset")
-                for r in range(kept_rounds):
-                    for cls in range(k):
-                        idx = (r, cls) if k > 1 else (r,)
-                        trees.append({name: t_host[name][idx] for name in names})
-                        tree_classes.append(cls)
+
+                def append_round_trees(t_stack, nr):
+                    t_host = {kf: np.asarray(v)
+                              for kf, v in t_stack._asdict().items()}
+                    for r in range(nr):
+                        for cls in range(k):
+                            idx = (r, cls) if k > 1 else (r,)
+                            trees.append(
+                                {name: t_host[name][idx] for name in names})
+                            tree_classes.append(cls)
+
+                if ckpt is None:
+                    fused = build_fused(num_rounds)
+                    if log:
+                        log(f"fused boosting: {num_rounds} rounds x {k} "
+                            "class(es) in one XLA program (first run "
+                            "compiles)")
+                    args = (bins_dev, y_f, base_mask, pred, seed,
+                            jnp.asarray(0, jnp.int32))
+                    if es_active:
+                        args = args + (xv_bins, y_val_dev, val_raw)
+                    t_stack, _pred, (r_best_dev, stopped_dev) = fused(*args)
+                    kept_rounds = num_rounds
+                    if es_active:
+                        r_best = int(r_best_dev)
+                        if bool(stopped_dev) and r_best >= 0:
+                            kept_rounds = r_best + 1
+                            if log:
+                                log(f"early stop after round "
+                                    f"{r_best + start_iter} (kept "
+                                    f"{kept_rounds}/{num_rounds} rounds)")
+                        best_iter = start_iter + r_best if r_best >= 0 else -1
+                    if log:
+                        log(f"fused boosting: done ({kept_rounds * k} trees)")
+                    append_round_trees(t_stack, kept_rounds)
+                else:
+                    from ..resilience.elastic import preempt_now
+
+                    # chunk boundaries must land on bagging-period edges:
+                    # the gbdt bag refreshes when it % bagging_freq == 0
+                    # and carries otherwise, and the carried bag lives only
+                    # on device. (rf resamples and goss redraws per round,
+                    # so any boundary works there.)
+                    gbdt_bagging = (spec_boosting == "gbdt"
+                                    and opts.bagging_fraction < 1.0
+                                    and opts.bagging_freq > 0)
+                    align = opts.bagging_freq if gbdt_bagging else 1
+                    chunk = max((ck_every // align) * align, align)
+                    if log:
+                        log(f"fused boosting: {num_rounds} rounds x {k} "
+                            f"class(es), checkpoint every {chunk} rounds"
+                            + (f" (resumed at round {start_iter + fit_done})"
+                               if fit_done else ""))
+                    fused_chunk, chunk_nr = None, -1
+                    while fit_done < num_rounds:
+                        nr = min(chunk, num_rounds - fit_done)
+                        if nr != chunk_nr:
+                            fused_chunk, chunk_nr = build_fused(nr), nr
+                        t_stack, pred, _ = fused_chunk(
+                            bins_dev, y_f, base_mask, pred, seed,
+                            jnp.asarray(fit_done, jnp.int32))
+                        append_round_trees(t_stack, nr)
+                        fit_done += nr
+                        path = _write_snapshot(
+                            ckpt, trees, tree_classes, mapper, opts, init,
+                            feature_names, fit_done, start_iter, k)
+                        preempt_now(None, lambda: path, "gbdt-train")
+                    if log:
+                        log(f"fused boosting: done ({num_rounds * k} trees)")
             if opts.boosting_type == "rf" and trees:
                 scale = 1.0 / max(len(trees) // k, 1)
                 trees = [_scale_tree(t, scale) for t in trees]
@@ -1535,3 +1623,61 @@ def _scale_tree(t: dict[str, np.ndarray], scale: float) -> dict[str, np.ndarray]
     t = dict(t)
     t["value"] = np.asarray(t["value"]) * scale
     return t
+
+
+# ---- preemption-tolerant chunked training (resilience/elastic.py) ---- #
+
+def _ckpt_config(opts: TrainOptions, k: int, start_iter: int) -> dict:
+    """The fit identity a snapshot must match to be resumable: a snapshot
+    from a different config would silently change the model."""
+    return {
+        "objective": opts.objective, "boosting_type": opts.boosting_type,
+        "num_class": int(k), "seed": int(opts.seed),
+        "bagging_seed": int(opts.bagging_seed),
+        "num_iterations": int(opts.num_iterations),
+        "num_leaves": int(opts.num_leaves),
+        "learning_rate": float(opts.learning_rate),
+        "start_iter": int(start_iter),
+    }
+
+
+def _write_snapshot(ckpt, trees, tree_classes, mapper, opts, init,
+                    feature_names, fit_done: int, start_iter: int,
+                    k: int) -> str:
+    """Snapshot the booster-so-far (model text roundtrips f32-exactly).
+    rf trees are stored UNSCALED — the 1/T averaging happens once at the
+    end of the fit, and an unscale-rescale roundtrip is not f32-exact."""
+    snap = Booster._from_tree_dicts(
+        trees, tree_classes, mapper, opts, init, feature_names or [])
+    doc = {"kind": "gbdt", "fit_rounds_done": int(fit_done),
+           "config": _ckpt_config(opts, k, start_iter),
+           "model": snap.to_text()}
+    return ckpt.save(json.dumps(doc).encode("utf-8"),
+                     tag=f"round-{start_iter + fit_done:06d}",
+                     meta={"rounds_done": int(fit_done),
+                           **_ckpt_config(opts, k, start_iter)})
+
+
+def _restore_snapshot(ckpt, opts, k: int, start_iter: int, log):
+    """Newest verified snapshot matching this fit's config, parsed back
+    into (booster, rounds_done) — or None to start from round 0."""
+    loaded = ckpt.load_latest()
+    if loaded is None:
+        return None
+    payload, entry = loaded
+    try:
+        doc = json.loads(payload.decode("utf-8"))
+        if doc.get("kind") != "gbdt":
+            raise ValueError(f"kind {doc.get('kind')!r}")
+        if doc.get("config") != _ckpt_config(opts, k, start_iter):
+            raise ValueError("config mismatch")
+        snap = Booster.from_text(doc["model"])
+        fit_done = int(doc["fit_rounds_done"])
+    except (ValueError, KeyError, TypeError) as e:
+        if log:
+            log(f"ignoring checkpoint {entry['file']}: {e}")
+        return None
+    if log:
+        log(f"resumed from {entry['file']}: "
+            f"{fit_done} rounds already trained")
+    return snap, fit_done

@@ -98,6 +98,16 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
     )
     verbosity = Param(1, "logging verbosity", ptype=int)
     seed = Param(0, "master rng seed", ptype=int)
+    checkpoint_dir = Param(
+        None,
+        "preemption-tolerant training: snapshot the booster-so-far here "
+        "and resume from the newest verified snapshot (resilience/elastic)",
+        ptype=str,
+    )
+    checkpoint_every_n = Param(
+        0, "boosting rounds between snapshots (0 = checkpointing off)",
+        ptype=int,
+    )
 
     def _train_options(self, objective: str, num_class: int = 1) -> TrainOptions:
         init_model = None
@@ -134,6 +144,8 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
             num_class=num_class,
             boost_from_average=self.get("boost_from_average"),
             init_model=init_model,
+            checkpoint_dir=self.get("checkpoint_dir"),
+            checkpoint_every_n=self.get("checkpoint_every_n"),
             seed=self.get("seed"),
         )
 

@@ -308,7 +308,7 @@ def make_fused_train_fn(
         bag_frac = 0.632 if rf_mode else 1.0  # rf defaults to bootstrap-ish
     bag_freq = max(spec.bagging_freq, 1)
 
-    def loop(bins, y, base_w, pred0, seed,
+    def loop(bins, y, base_w, pred0, seed, round_offset,
              val_bins=None, y_val=None, val_raw0=None, axis_name=None):
         n = bins.shape[0]  # local rows (per shard under shard_map)
         # key_repl stays replicated: the FEATURE mask must be identical on
@@ -462,8 +462,15 @@ def make_fused_train_fn(
             jnp.asarray(jnp.inf, jnp.float32), jnp.asarray(-1, jnp.int32),
             jnp.asarray(0, jnp.int32), jnp.asarray(False),
         )
+        # global round indices: a checkpointed fit re-enters the scan with
+        # round_offset = rounds-already-done, so every RNG fold_in sees the
+        # same `it` it would in an uninterrupted run (byte-identity of the
+        # resumed model depends on this). A traced offset shares one
+        # executable across chunks.
+        its = jnp.arange(spec.num_rounds) + jnp.asarray(
+            round_offset, jnp.int32)
         (pred, _, _, _, best_iter, _, stopped), trees = jax.lax.scan(
-            body, carry0, jnp.arange(spec.num_rounds)
+            body, carry0, its
         )
         return trees, pred, (best_iter, stopped)
 
@@ -475,7 +482,7 @@ def make_fused_train_fn(
         fn = jax.jit(shard_map(
             functools.partial(loop, axis_name=DATA_AXIS),
             mesh=mesh,
-            in_specs=(P(DATA_AXIS, None), rowk, row, rowk, P()) + es_in,
+            in_specs=(P(DATA_AXIS, None), rowk, row, rowk, P(), P()) + es_in,
             out_specs=(
                 TreeArrays(*([P()] * len(TreeArrays._fields))),
                 rowk,

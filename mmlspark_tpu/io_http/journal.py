@@ -30,6 +30,7 @@ import os
 import threading
 
 from .schema import HTTPRequestData, HTTPResponseData
+from ..utils.storage import atomic_write
 
 __all__ = ["ServingJournal"]
 
@@ -160,27 +161,23 @@ class ServingJournal:
     def compact(self) -> int:
         """Drop fully answered accept/reply pairs from disk (the
         reference's commit() batch trimming). Returns pairs trimmed.
-        Atomic: rewrite to a tmp then rename."""
+        Atomic via `utils.storage.atomic_write` (tmp + fsync + rename
+        + dir-fsync)."""
         with self._lock:
             answered = [i for i in self._accepts if i in self._replies]
             for i in answered:
                 del self._accepts[i]
                 del self._replies[i]
-            tmp = self.path + ".tmp"
-            with open(tmp, "w", encoding="utf-8") as fh:
-                for i, r in self._accepts.items():
-                    fh.write(json.dumps({
-                        "t": "accept", "id": i, "method": r.method,
-                        "url": r.url, "headers": dict(r.headers or {}),
-                        "entity": base64.b64encode(r.entity).decode()
-                        if r.entity is not None else None,
-                    }) + "\n")
-                # replies without accepts can't exist (reply() requires the
-                # pending exchange), so the rewrite is accepts-only
-                fh.flush()
-                os.fsync(fh.fileno())
+            # replies without accepts can't exist (reply() requires the
+            # pending exchange), so the rewrite is accepts-only
+            lines = [json.dumps({
+                "t": "accept", "id": i, "method": r.method,
+                "url": r.url, "headers": dict(r.headers or {}),
+                "entity": base64.b64encode(r.entity).decode()
+                if r.entity is not None else None,
+            }) + "\n" for i, r in self._accepts.items()]
             self._fh.close()
-            os.replace(tmp, self.path)
+            atomic_write(self.path, "".join(lines))
             self._fh = open(self.path, "a", encoding="utf-8")
             return len(answered)
 

@@ -9,14 +9,20 @@ replicated — and XLA inserts the gradient all-reduce on ICI automatically
 (the pjit data-parallel recipe). Multi-host = same program under
 `jax.distributed.initialize` (parallel/mesh.py), no hostfiles or ssh.
 
-Checkpoint/resume: orbax-style (flax serialization) epoch checkpoints in
-`checkpoint_dir` — the parity for brainscript's model snapshots
-(BrainscriptBuilder.scala:16-151 output config).
+Checkpoint/resume: flax-serialized snapshots through
+`resilience.elastic.TrainingCheckpointer` (atomic, blake2b-verified,
+manifest + retention) — the parity for brainscript's model snapshots
+(BrainscriptBuilder.scala:16-151 output config), hardened for
+preemptible fleets. The cursor is (epoch, batch): end-of-epoch
+checkpoints store (epoch+1, 0); a PreemptionGuard drain mid-epoch on
+the streamed path stores (epoch, step+1), and resume replays the numpy
+shuffle stream and per-step fold_in positions so the resumed fit is
+byte-identical to an uninterrupted one on the same mesh.
 """
 
 from __future__ import annotations
 
-import os
+import itertools
 from typing import Any, Callable
 
 import jax
@@ -60,6 +66,7 @@ class DNNLearner(HasFeaturesCol, HasLabelCol, Estimator):
     use_mesh = Param(True, "data-parallel over the mesh data axis", ptype=bool)
     seed = Param(0, "init + shuffle seed", ptype=int)
     checkpoint_dir = Param(None, "epoch checkpoint directory (resume if present)", ptype=str)
+    checkpoint_every_n = Param(1, "checkpoint every N epochs (needs checkpoint_dir)", ptype=int)
     init_bundle_path = Param(None, "warm start from a saved ModelBundle", ptype=str)
     bfloat16 = Param(True, "compute in bfloat16 (f32 params)", ptype=bool)
     # jax.checkpoint over the forward: activations are recomputed in the
@@ -178,9 +185,14 @@ class DNNLearner(HasFeaturesCol, HasLabelCol, Estimator):
             d = mesh.shape[DATA_AXIS]
             bs = max((bs // d) * d, d)
         rng = np.random.default_rng(self.get("seed"))
-        start_epoch, params, batch_stats, opt_state = self._maybe_resume(
-            params, batch_stats, opt_state
-        )
+        ckpt = self._checkpointer()
+        (start_epoch, start_batch, params, batch_stats,
+         opt_state) = self._maybe_resume(ckpt, params, batch_stats, opt_state)
+        # replay the shuffle stream for completed epochs: the epoch we
+        # resume into must draw the same permutation it drew originally,
+        # or the resumed fit diverges from the uninterrupted one
+        for _ in range(start_epoch):
+            rng.permutation(n)
 
         steps = (n - bs) // bs + 1 if n >= bs else 0
         fused = (
@@ -228,16 +240,23 @@ class DNNLearner(HasFeaturesCol, HasLabelCol, Estimator):
 
             epoch_fn = jax.jit(run_epoch, donate_argnums=(0, 1, 2))
 
+        from ..resilience.elastic import preempt_now
+
         log = self._log()
         tracer = get_tracer()
         for epoch in range(start_epoch, int(self.get("epochs"))):
+            # a mid-epoch cursor can only come from the streamed path, so
+            # the resumed-into epoch streams even when fusion is on — the
+            # two paths fold the same per-step rng at the same positions
+            resume_k = start_batch if epoch == start_epoch else 0
+            use_fused = fused and not resume_k
             with tracer.start_span("trainer.epoch", epoch=epoch,
-                                   fused=fused, steps=steps) as ep_span:
+                                   fused=use_fused, steps=steps) as ep_span:
                 order = rng.permutation(n)
                 # drop the ragged tail (shuffled: all rows seen across
                 # epochs); XLA compiles one batch shape
                 epoch_rng = jax.random.fold_in(base_rng, epoch)
-                if fused:
+                if use_fused:
                     idx = jnp.asarray(
                         order[: steps * bs].reshape(steps, bs), jnp.int32
                     )
@@ -249,18 +268,27 @@ class DNNLearner(HasFeaturesCol, HasLabelCol, Estimator):
                     def prep(ki, _order=order, _rng=epoch_rng):
                         k, i = ki
                         idx = _order[i : i + bs]
-                        return (jnp.asarray(x[idx]), jnp.asarray(y[idx]),
+                        return (k, jnp.asarray(x[idx]), jnp.asarray(y[idx]),
                                 jax.random.fold_in(_rng, k))
 
                     losses = []
-                    for bx, by, step_rng in Prefetcher(
-                        enumerate(range(0, n - bs + 1, bs)), prep,
+                    for k, bx, by, step_rng in Prefetcher(
+                        itertools.islice(
+                            enumerate(range(0, n - bs + 1, bs)),
+                            resume_k, None),
+                        prep,
                         depth=int(self.get("prefetch_depth")), name="trainer",
                     ):
                         params, batch_stats, opt_state, loss = step(
                             params, batch_stats, opt_state, bx, by, step_rng
                         )
                         losses.append(loss)
+                        preempt_now(
+                            None,
+                            lambda: self._maybe_checkpoint(
+                                ckpt, epoch, k + 1, params, batch_stats,
+                                opt_state, force=True),
+                            "dnn-train")
                     mean_loss = (
                         float(jnp.mean(jnp.stack(losses)))
                         if losses else float("nan")
@@ -269,7 +297,14 @@ class DNNLearner(HasFeaturesCol, HasLabelCol, Estimator):
                 if log:
                     log(f"epoch {epoch + 1}/{self.get('epochs')}: "
                         f"loss={mean_loss:.4f}")
-                self._maybe_checkpoint(epoch, params, batch_stats, opt_state)
+                self._maybe_checkpoint(
+                    ckpt, epoch + 1, 0, params, batch_stats, opt_state)
+                preempt_now(
+                    None,
+                    lambda: self._maybe_checkpoint(
+                        ckpt, epoch + 1, 0, params, batch_stats, opt_state,
+                        force=True),
+                    "dnn-train")
 
         variables = {"params": jax.device_get(params)}
         if has_bn:
@@ -321,47 +356,65 @@ class DNNLearner(HasFeaturesCol, HasLabelCol, Estimator):
 
         return build(params)
 
-    def _ckpt_path(self) -> str | None:
+    def _checkpointer(self):
         d = self.get("checkpoint_dir")
-        return os.path.join(d, "last.ckpt") if d else None
+        if not d:
+            return None
+        from ..resilience.elastic import TrainingCheckpointer
 
-    def _maybe_checkpoint(self, epoch, params, batch_stats, opt_state) -> None:
-        path = self._ckpt_path()
-        if not path:
-            return
-        from flax import serialization
+        return TrainingCheckpointer(d)
 
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        state = {
-            "epoch": epoch + 1,
-            "params": jax.device_get(params),
-            "batch_stats": jax.device_get(batch_stats),
-            "opt_state": jax.device_get(opt_state),
-        }
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as fh:
-            fh.write(serialization.to_bytes(state))
-        os.replace(tmp, path)  # atomic: a crash never corrupts the checkpoint
-
-    def _maybe_resume(self, params, batch_stats, opt_state):
-        path = self._ckpt_path()
-        if not path or not os.path.exists(path):
-            return 0, params, batch_stats, opt_state
-        from flax import serialization
-
-        template = {
+    def _state_template(self, params, batch_stats, opt_state) -> dict:
+        return {
             "epoch": 0,
+            "batch": 0,
             "params": jax.device_get(params),
             "batch_stats": jax.device_get(batch_stats),
             "opt_state": jax.device_get(opt_state),
         }
-        with open(path, "rb") as fh:
-            state = serialization.from_bytes(template, fh.read())
+
+    def _maybe_checkpoint(self, ckpt, epoch, batch, params, batch_stats,
+                          opt_state, force: bool = False) -> "str | None":
+        """Snapshot the resume cursor (epoch, batch) + full f32 training
+        state. Cursor semantics: resume AT epoch, AT batch — end-of-epoch
+        writes (epoch+1, 0), a mid-epoch drain writes (epoch, step+1)."""
+        if ckpt is None:
+            return None
+        every = max(int(self.get("checkpoint_every_n")), 1)
+        if not force and (batch != 0 or epoch % every != 0):
+            return None
+        from flax import serialization
+
+        state = self._state_template(params, batch_stats, opt_state)
+        state.update(epoch=int(epoch), batch=int(batch))
+        tag = f"epoch-{epoch:04d}" + (f"-step-{batch:05d}" if batch else "")
+        return ckpt.save(serialization.to_bytes(state), tag=tag,
+                         meta={"epoch": int(epoch), "batch": int(batch),
+                               "seed": int(self.get("seed"))})
+
+    def _maybe_resume(self, ckpt, params, batch_stats, opt_state):
+        if ckpt is None:
+            return 0, 0, params, batch_stats, opt_state
+        loaded = ckpt.load_latest()
+        if loaded is None:
+            return 0, 0, params, batch_stats, opt_state
+        payload, entry = loaded
         log = self._log()
+        meta = entry.get("meta") or {}
+        if "seed" in meta and int(meta["seed"]) != int(self.get("seed")):
+            if log:
+                log(f"ignoring checkpoint {entry['file']}: "
+                    f"seed {meta['seed']} != {self.get('seed')}")
+            return 0, 0, params, batch_stats, opt_state
+        from flax import serialization
+
+        state = serialization.from_bytes(
+            self._state_template(params, batch_stats, opt_state), payload)
         if log:
-            log(f"resuming from {path} at epoch {state['epoch']}")
-        return (state["epoch"], state["params"], state["batch_stats"],
-                state["opt_state"])
+            log(f"resuming from {entry['file']} at epoch "
+                f"{state['epoch']} batch {state['batch']}")
+        return (int(state["epoch"]), int(state["batch"]), state["params"],
+                state["batch_stats"], state["opt_state"])
 
     def _log(self):
         import logging

@@ -345,6 +345,11 @@ GAUGE_MERGE_POLICIES: dict[str, str] = {
     # attribution table should surface (a replica padding 2x is the
     # problem even when the fleet average looks fine)
     "mmlspark_tpu_dataplane_pad_waste_ratio": "max",
+    # elastic training (resilience/elastic.py): the replica that has gone
+    # LONGEST without a checkpoint is the one a preemption would set back
+    # the furthest — worst age is the pageable signal, not the fleet
+    # average or the "_seconds" last-wins default
+    "mmlspark_tpu_checkpoint_last_age_seconds": "max",
 }
 
 _SUFFIX_POLICIES: tuple[tuple[str, str], ...] = (

@@ -31,6 +31,9 @@ from .breaker import (
 from .chaos import ChaosError, ChaosTransformer, FaultInjector
 from .supervisor import (PartitionSupervisor, QuerySupervisor,
                          RestartPolicy)
+from .elastic import (Preempted, PreemptionGuard, RESUMABLE_EXIT_CODE,
+                      TrainingCheckpointer, get_active_guard,
+                      set_active_guard)
 
 __all__ = [
     "Clock",
@@ -53,4 +56,10 @@ __all__ = [
     "QuerySupervisor",
     "PartitionSupervisor",
     "RestartPolicy",
+    "TrainingCheckpointer",
+    "PreemptionGuard",
+    "Preempted",
+    "RESUMABLE_EXIT_CODE",
+    "get_active_guard",
+    "set_active_guard",
 ]

@@ -14,11 +14,15 @@ TPU redesign: one append-only JSONL log (`commits.jsonl`) carries both
 record types — `{"t": "plan", "batch_id", "start", "end"}` and
 `{"t": "commit", "batch_id"}` — with the serving journal's durability
 idioms (io_http/journal.py): write+flush+fsync per record, torn-tail
-detection with on-disk truncation at load, atomic compact via tmp-write
-plus os.replace. Stateful-operator snapshots live beside it as
-`state-<batchId>.json`, written atomically before the sink write so a
-replayed batch restarts its operators from the state that PRECEDED the
-crashed attempt.
+detection with on-disk truncation at load, atomic compact via
+`utils.storage.atomic_write` (tmp → fsync → os.replace → dir-fsync).
+Stateful-operator snapshots live beside it as `state-<batchId>.json`,
+written atomically before the sink write so a replayed batch restarts
+its operators from the state that PRECEDED the crashed attempt. A
+snapshot that fails to parse at recovery (bit-flip, torn pre-upgrade
+write) is skipped — recovery falls back to the newest older snapshot at
+or before the last commit and emits a `checkpoint.corrupt` recorder
+event plus a `mmlspark_tpu_checkpoint_corrupt_total` count.
 """
 
 from __future__ import annotations
@@ -27,7 +31,21 @@ import json
 import os
 import threading
 
+from ..utils.storage import atomic_write
+
 __all__ = ["CommitLog"]
+
+
+def _note_corrupt(path: str, detail: str) -> None:
+    """Count + record a snapshot that failed to parse (never raises)."""
+    try:
+        from ..resilience.elastic import _count, _record
+
+        _count("mmlspark_tpu_checkpoint_corrupt_total",
+               "checkpoint snapshots/manifests that failed verification")
+        _record("checkpoint.corrupt", file=path, what=detail)
+    except Exception:  # noqa: BLE001 — telemetry never blocks recovery
+        pass
 
 
 class CommitLog:
@@ -118,21 +136,43 @@ class CommitLog:
 
     def write_state(self, batch_id: int, doc: dict) -> None:
         """Atomically snapshot stateful-operator state as of AFTER
-        `batch_id` (tmp + rename, so a crash mid-write leaves the previous
-        snapshot intact and a replay simply overwrites)."""
-        tmp = self._state_path(batch_id) + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as fh:
-            json.dump(doc, fh)
-            fh.flush()
-            os.fsync(fh.fileno())
-        os.replace(tmp, self._state_path(batch_id))
+        `batch_id` (atomic_write: tmp + fsync + rename, so a crash
+        mid-write leaves the previous snapshot intact and a replay
+        simply overwrites)."""
+        atomic_write(self._state_path(batch_id), json.dumps(doc))
+
+    def _state_batch_ids(self) -> "list[int]":
+        """Batch ids of all whole-query snapshots on disk, ascending."""
+        out = []
+        for name in os.listdir(self.dir):
+            if not (name.startswith("state-") and name.endswith(".json")):
+                continue
+            if self._parse_pstate(name) is not None:
+                continue                        # per-partition snapshot
+            try:
+                out.append(int(name[len("state-"):-len(".json")]))
+            except ValueError:
+                continue
+        return sorted(out)
 
     def read_state(self, batch_id: int) -> dict | None:
-        try:
-            with open(self._state_path(batch_id), encoding="utf-8") as fh:
-                return json.load(fh)
-        except (FileNotFoundError, json.JSONDecodeError):
-            return None
+        """Newest intact whole-query snapshot at or before `batch_id`.
+
+        A snapshot that no longer parses is skipped (counted and
+        recorded) and recovery falls back to the next-older one — a
+        stale-but-consistent restore beats discarding all state."""
+        for bid in reversed([b for b in self._state_batch_ids()
+                             if b <= batch_id]):
+            path = self._state_path(bid)
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    return json.load(fh)
+            except FileNotFoundError:
+                continue
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                _note_corrupt(path, "state-snapshot")
+                continue
+        return None
 
     # -- per-partition incremental snapshots ------------------------------- #
     #
@@ -167,35 +207,34 @@ class CommitLog:
     def write_partition_state(self, partition: int, batch_id: int,
                               doc: dict) -> None:
         """Atomically snapshot ONE partition's operator state as of after
-        `batch_id` (same tmp + rename durability as `write_state`)."""
-        path = self._pstate_path(partition, batch_id)
-        tmp = path + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as fh:
-            json.dump(doc, fh, sort_keys=True)
-            fh.flush()
-            os.fsync(fh.fileno())
-        os.replace(tmp, path)
+        `batch_id` (same atomic_write durability as `write_state`)."""
+        atomic_write(self._pstate_path(partition, batch_id),
+                     json.dumps(doc, sort_keys=True))
 
     def read_partition_state(self, partition: int,
                              batch_id: int) -> dict | None:
-        """Newest snapshot of `partition` at or before `batch_id` — the
-        incremental layout means the partition may not have written at
-        `batch_id` itself if nothing changed since an earlier batch."""
-        best = -1
+        """Newest intact snapshot of `partition` at or before `batch_id`
+        — the incremental layout means the partition may not have written
+        at `batch_id` itself if nothing changed since an earlier batch.
+        Corrupt snapshots are skipped (counted + recorded) in favor of
+        the next-older one."""
+        bids = []
         for name in os.listdir(self.dir):
             parsed = self._parse_pstate(name)
-            if parsed is None or parsed[0] != partition:
+            if parsed is not None and parsed[0] == partition \
+                    and parsed[1] <= batch_id:
+                bids.append(parsed[1])
+        for bid in sorted(bids, reverse=True):
+            path = self._pstate_path(partition, bid)
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    return json.load(fh)
+            except FileNotFoundError:
                 continue
-            if best < parsed[1] <= batch_id:
-                best = parsed[1]
-        if best < 0:
-            return None
-        try:
-            with open(self._pstate_path(partition, best),
-                      encoding="utf-8") as fh:
-                return json.load(fh)
-        except (FileNotFoundError, json.JSONDecodeError):
-            return None
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                _note_corrupt(path, "partition-state-snapshot")
+                continue
+        return None
 
     def prune_state(self, keep_from: int) -> None:
         """Drop snapshots recovery can no longer need: whole-query
@@ -245,19 +284,16 @@ class CommitLog:
             dropped = (len(self._plans) - len(keep_plans)) + (
                 len(self._committed) - len(keep_commits))
             self._plans, self._committed = keep_plans, keep_commits
-            tmp = self.path + ".tmp"
-            with open(tmp, "w", encoding="utf-8") as fh:
-                for b in sorted(self._plans):
-                    fh.write(json.dumps({
-                        "t": "plan", "batch_id": b,
-                        "start": self._plans[b]["start"],
-                        "end": self._plans[b]["end"]}) + "\n")
-                for b in sorted(self._committed):
-                    fh.write(json.dumps({"t": "commit", "batch_id": b}) + "\n")
-                fh.flush()
-                os.fsync(fh.fileno())
+            lines = []
+            for b in sorted(self._plans):
+                lines.append(json.dumps({
+                    "t": "plan", "batch_id": b,
+                    "start": self._plans[b]["start"],
+                    "end": self._plans[b]["end"]}) + "\n")
+            for b in sorted(self._committed):
+                lines.append(json.dumps({"t": "commit", "batch_id": b}) + "\n")
             self._fh.close()
-            os.replace(tmp, self.path)
+            atomic_write(self.path, "".join(lines))
             self._fh = open(self.path, "a", encoding="utf-8")
             return dropped
 

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import os
 import shutil
+import tempfile
 import urllib.parse
 import urllib.request
 from typing import BinaryIO
@@ -21,6 +22,7 @@ __all__ = [
     "exists",
     "read_bytes",
     "write_bytes",
+    "atomic_write",
     "open_read",
     "copy_to_local",
 ]
@@ -112,6 +114,53 @@ def write_bytes(uri: str, data: bytes) -> None:
             f.write(data)
         return
     raise ValueError(f"unsupported storage scheme {scheme!r} in {uri!r}")
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync the directory so the rename itself is durable. Some
+    filesystems refuse directory fds (or fsync on them) — crash
+    consistency degrades gracefully there, it must not break writes."""
+    try:
+        fd = os.open(path or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write(path: str, data: "bytes | str") -> None:
+    """Crash-consistent local write: tmp file in the target directory →
+    write → flush → fsync(file) → os.replace → fsync(directory).
+
+    After this returns, a reader sees either the old content or the new
+    content, never a torn file — and the new content survives power loss
+    (the plain tempfile+os.replace idiom the early writers used leaves
+    both the data and the rename in volatile cache). Local paths only:
+    checkpoint/journal writers that need durability are all local."""
+    if scheme_of(path) not in ("", "file"):
+        raise ValueError(f"atomic_write is local-only, got {path!r}")
+    dest = _local_path(path)
+    payload = data.encode("utf-8") if isinstance(data, str) else data
+    dirname = os.path.dirname(dest) or "."
+    os.makedirs(dirname, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=dirname, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            fh.write(payload)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, dest)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    _fsync_dir(dirname)
 
 
 def copy_to_local(uri: str, dest_path: str) -> str:

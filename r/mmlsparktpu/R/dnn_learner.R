@@ -15,6 +15,7 @@
 #' @param use_mesh data-parallel over the mesh data axis
 #' @param seed init + shuffle seed
 #' @param checkpoint_dir epoch checkpoint directory (resume if present)
+#' @param checkpoint_every_n checkpoint every N epochs (needs checkpoint_dir)
 #' @param init_bundle_path warm start from a saved ModelBundle
 #' @param bfloat16 compute in bfloat16 (f32 params)
 #' @param remat rematerialize the forward in the backward pass
@@ -24,7 +25,7 @@
 #' @param prefetch_depth minibatches prepared ahead in the streamed epoch loop (0 = sync)
 #' @param only.model return the fitted model without transforming x (the reference's unfit.model)
 #' @export
-ml_dnn_learner <- function(x, label_col = "label", features_col = "features", architecture = "mlp", model_config = NULL, loss = "softmax_ce", optimizer = "adam", learning_rate = 0.001, epochs = 5L, batch_size = 128L, use_mesh = TRUE, seed = 0L, checkpoint_dir = NULL, init_bundle_path = NULL, bfloat16 = TRUE, remat = FALSE, trainable_prefixes = NULL, fused_epochs = TRUE, fused_epoch_budget_mb = 512L, prefetch_depth = 2L, only.model = FALSE)
+ml_dnn_learner <- function(x, label_col = "label", features_col = "features", architecture = "mlp", model_config = NULL, loss = "softmax_ce", optimizer = "adam", learning_rate = 0.001, epochs = 5L, batch_size = 128L, use_mesh = TRUE, seed = 0L, checkpoint_dir = NULL, checkpoint_every_n = 1L, init_bundle_path = NULL, bfloat16 = TRUE, remat = FALSE, trainable_prefixes = NULL, fused_epochs = TRUE, fused_epoch_budget_mb = 512L, prefetch_depth = 2L, only.model = FALSE)
 {
   params <- list()
   if (!is.null(label_col)) params$label_col <- as.character(label_col)
@@ -39,6 +40,7 @@ ml_dnn_learner <- function(x, label_col = "label", features_col = "features", ar
   if (!is.null(use_mesh)) params$use_mesh <- as.logical(use_mesh)
   if (!is.null(seed)) params$seed <- as.integer(seed)
   if (!is.null(checkpoint_dir)) params$checkpoint_dir <- as.character(checkpoint_dir)
+  if (!is.null(checkpoint_every_n)) params$checkpoint_every_n <- as.integer(checkpoint_every_n)
   if (!is.null(init_bundle_path)) params$init_bundle_path <- as.character(init_bundle_path)
   if (!is.null(bfloat16)) params$bfloat16 <- as.logical(bfloat16)
   if (!is.null(remat)) params$remat <- as.logical(remat)

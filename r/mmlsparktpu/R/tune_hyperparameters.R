@@ -13,9 +13,11 @@
 #' @param num_runs random-search runs (dict param_space only)
 #' @param refit refit best params on the full table
 #' @param trial_submeshes disjoint data submeshes for parallel trials
+#' @param checkpoint_dir sweep checkpoint directory (trial ledger + per-trial dirs)
+#' @param trial_restarts transient-failure retries per trial (RestartPolicy budget)
 #' @param only.model return the fitted model without transforming x (the reference's unfit.model)
 #' @export
-ml_tune_hyperparameters <- function(x, label_col = "label", models, evaluation_metric = "accuracy", num_folds = 3L, parallelism = 4L, seed = 0L, param_space, num_runs = 10L, refit = TRUE, trial_submeshes = 0L, only.model = FALSE)
+ml_tune_hyperparameters <- function(x, label_col = "label", models, evaluation_metric = "accuracy", num_folds = 3L, parallelism = 4L, seed = 0L, param_space, num_runs = 10L, refit = TRUE, trial_submeshes = 0L, checkpoint_dir = NULL, trial_restarts = 0L, only.model = FALSE)
 {
   params <- list()
   if (!is.null(label_col)) params$label_col <- as.character(label_col)
@@ -28,5 +30,7 @@ ml_tune_hyperparameters <- function(x, label_col = "label", models, evaluation_m
   if (!is.null(num_runs)) params$num_runs <- as.integer(num_runs)
   if (!is.null(refit)) params$refit <- as.logical(refit)
   if (!is.null(trial_submeshes)) params$trial_submeshes <- as.integer(trial_submeshes)
+  if (!is.null(checkpoint_dir)) params$checkpoint_dir <- as.character(checkpoint_dir)
+  if (!is.null(trial_restarts)) params$trial_restarts <- as.integer(trial_restarts)
   .tpu_apply_stage("mmlspark_tpu.automl.tune.TuneHyperparameters", params, x, is_estimator = TRUE, only.model = only.model)
 }

@@ -7,6 +7,8 @@
 #        diagnosed from its checkpoint dir)
 #      + perf-attribution smoke (armed profiler on a live resident
 #        server; phase sum must cover the measured RTT)
+#      + training-checkpoint smoke (real store + checkpointed GBDT fit;
+#        corruption fallback and lineage table assertions)
 #   3. bench regression gate over the BENCH_*/MULTICHIP_* trajectory
 #   4. pipeline-fusion segment report (fails if an exemplar stops fusing)
 #   5. full test suite on the 8-virtual-device CPU mesh
@@ -19,6 +21,7 @@ python tools/diagnose.py --selftest
 python tools/diagnose.py --postmortem --selftest
 python tools/diagnose.py --streaming --selftest
 python tools/diagnose.py --perf --selftest
+python tools/diagnose.py --checkpoints --selftest
 python tools/bench_gate.py --selftest
 python tools/fusion_report.py
 python -m pytest tests/ -q
