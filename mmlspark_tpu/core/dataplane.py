@@ -145,8 +145,9 @@ class Prefetcher:
                 except BaseException as e:  # noqa: BLE001 — re-raised at consumer
                     q.put(_Raised(e))
                     return
-                self.stats["prepare_seconds"] += time.perf_counter() - t0
-                q.put(out)
+                # stats is written only on the consumer thread; ship this
+                # item's prepare time through the queue alongside it
+                q.put((out, time.perf_counter() - t0))
         except BaseException as e:  # noqa: BLE001 — iterator itself raised
             q.put(_Raised(e))
             return
@@ -164,12 +165,14 @@ class Prefetcher:
         try:
             while True:
                 t0 = time.perf_counter()
-                out = self._queue.get()
+                got = self._queue.get()
                 self.stats["wait_seconds"] += time.perf_counter() - t0
-                if out is _End:
+                if got is _End:
                     return
-                if isinstance(out, _Raised):
-                    raise out.exc
+                if isinstance(got, _Raised):
+                    raise got.exc
+                out, prep_dt = got
+                self.stats["prepare_seconds"] += prep_dt
                 self.stats["items"] += 1
                 if g_depth is not None:
                     g_depth.set(self._queue.qsize())
@@ -394,9 +397,14 @@ class ExecutableCache:
     """
 
     def __init__(self) -> None:
+        # lazy import: this module stays free of package-load ordering
+        # (see _gauges), and the factory returns a plain RLock unless the
+        # lock-order sanitizer is enabled
+        from ..observability.sanitizer import make_rlock
+
         self._entries: dict[tuple, Any] = {}
         self._families: dict[Any, set] = {}
-        self._lock = threading.RLock()
+        self._lock = make_rlock("ExecutableCache._lock")
         self.hits = 0
         self.misses = 0
         self.recompiles = 0
@@ -502,14 +510,14 @@ class Lookahead:
     costs one synchronous read, never correctness.
     """
 
-    _MISS = object()
-
     def __init__(self, name: str = "lookahead"):
         self.name = name
         self._key: Any = None
         self._done = threading.Event()
-        self._result: Any = self._MISS
-        self._error: "BaseException | None" = None
+        # the background thread publishes into a per-submission box dict
+        # ("result"/"error" keys); the submitting thread reads it only
+        # after join(), so the box never needs a lock
+        self._box: dict = {}
         self._thread: "threading.Thread | None" = None
         self.hits = 0
         self.misses = 0
@@ -523,20 +531,19 @@ class Lookahead:
         submission is discarded first."""
         self.discard()
         self._key = key
-        self._done = threading.Event()
-        self._result, self._error = self._MISS, None
-        done = self._done
+        done = threading.Event()
+        box: dict = {}
 
         def run() -> None:
             try:
-                result = fn()
+                box["result"] = fn()
             except BaseException as e:  # noqa: BLE001 — reported as a miss
-                self._error = e
-            else:
-                self._result = result
+                box["error"] = e
             finally:
                 done.set()
 
+        self._done = done
+        self._box = box
         self._thread = threading.Thread(
             target=run, name=f"dataplane-{self.name}", daemon=True)
         self._thread.start()
@@ -549,10 +556,11 @@ class Lookahead:
         self._done.wait()
         self._thread.join()
         self._thread = None
-        matched = (self._key == key and self._error is None
-                   and self._result is not self._MISS)
-        result = self._result if matched else None
-        self._key, self._result, self._error = None, self._MISS, None
+        box = self._box
+        matched = (self._key == key and "error" not in box
+                   and "result" in box)
+        result = box.get("result") if matched else None
+        self._key, self._box = None, {}
         if matched:
             self.hits += 1
         else:
@@ -566,4 +574,4 @@ class Lookahead:
             self._done.wait()
             self._thread.join()
             self._thread = None
-        self._key, self._result, self._error = None, self._MISS, None
+        self._key, self._box = None, {}

@@ -26,6 +26,7 @@ import collections
 import threading
 from typing import Any, Callable
 
+from ..observability.sanitizer import make_rlock
 from ..resilience.policy import SYSTEM_CLOCK
 
 __all__ = ["FleetAutoscaler"]
@@ -74,7 +75,9 @@ class FleetAutoscaler:
         self.hysteresis_ticks = int(hysteresis_ticks)
         self.cooldown_s = float(cooldown_s)
         self.clock = clock if clock is not None else SYSTEM_CLOCK
-        self._lock = threading.Lock()
+        # RLock: tick() holds it while calling heal(), which is also a
+        # public entry point and takes it itself
+        self._lock = make_rlock("FleetAutoscaler._lock")
         self._calm_ticks = 0
         self._last_action = "none"
         self._last_action_t = float("-inf")
@@ -157,16 +160,18 @@ class FleetAutoscaler:
         cooldown: healing restores approved capacity, it is not a
         scaling decision."""
         healed = []
-        for slot in self.fleet.dead_slots():
-            try:
-                self.fleet.respawn(slot)
-                healed.append(slot)
-                self._record("respawn", f"slot {slot}")
-            except Exception as e:  # noqa: BLE001 — keep healing others
-                self.events.append({
-                    "t": self.clock.monotonic(), "action": "respawn_failed",
-                    "detail": f"slot {slot}: {e}",
-                    "n_live": self.fleet.n_live})
+        with self._lock:
+            for slot in self.fleet.dead_slots():
+                try:
+                    self.fleet.respawn(slot)
+                    healed.append(slot)
+                    self._record("respawn", f"slot {slot}")
+                except Exception as e:  # noqa: BLE001 — keep healing others
+                    self.events.append({
+                        "t": self.clock.monotonic(),
+                        "action": "respawn_failed",
+                        "detail": f"slot {slot}: {e}",
+                        "n_live": self.fleet.n_live})
         return healed
 
     def in_cooldown(self) -> bool:

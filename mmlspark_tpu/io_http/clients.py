@@ -31,6 +31,7 @@ import urllib.parse
 import urllib.request
 from typing import Iterable, Sequence
 
+from ..observability.sanitizer import make_lock
 from ..observability.tracing import current_traceparent
 from ..resilience.breaker import BreakerRegistry, CircuitBreaker
 from ..resilience.policy import (RetryPolicy, is_retryable_exception,
@@ -184,12 +185,18 @@ class TargetPool:
     Pick strategies:
       round_robin   next live target after a rotating cursor
       least_loaded  live target with the fewest in-flight leases
-      hash          consistent hash of `key` over a virtual-node ring —
-                    a key keeps its target until that target leaves the
-                    live set (stateful/session-affine handlers)
+      hash          consistent hash of `key` over a virtual-node ring,
+                    with a sticky binding remembered per key — a key
+                    keeps its target until that target leaves the live
+                    set (stateful/session-affine handlers). The ring
+                    alone is NOT enough for stickiness: admitting a new
+                    replica moves ~1/N of the ring, so a bare rehash
+                    would silently re-home established streams onto a
+                    replica that may not even speak their schema.
     """
 
     VNODES = 32  # virtual nodes per target on the hash ring
+    STICKY_MAX = 65536  # remembered key bindings before oldest-first drop
 
     def __init__(self, urls: Sequence[str] = (),
                  breakers: "BreakerRegistry | None" = None,
@@ -201,8 +208,9 @@ class TargetPool:
                 clock=clock if clock is not None else SYSTEM_CLOCK,
                 **breaker_kw)
         self.breakers = breakers
-        self._lock = threading.Lock()
+        self._lock = make_lock("TargetPool._lock")
         self._targets: dict[str, _Target] = {}
+        self._sticky: dict[str, str] = {}   # routing key -> bound url
         self._rr = itertools.count()
         for u in urls:
             self.add(u)
@@ -274,14 +282,23 @@ class TargetPool:
         if not live:
             return None
         if strategy == "hash" and key is not None:
+            # sticky first: an established key stays home as long as its
+            # replica is live, no matter how membership churns around it
+            live_urls = {t.url for t in live}
+            with self._lock:
+                bound = self._sticky.get(key)
+            if bound in live_urls:
+                return bound
             ring = sorted(
                 (_stable_hash(f"{t.url}#{v}"), t.url)
                 for t in live for v in range(self.VNODES))
             point = _stable_hash(key)
-            for h, url in ring:
-                if h >= point:
-                    return url
-            return ring[0][1]
+            url = next((u for h, u in ring if h >= point), ring[0][1])
+            with self._lock:
+                self._sticky[key] = url
+                while len(self._sticky) > self.STICKY_MAX:
+                    self._sticky.pop(next(iter(self._sticky)))
+            return url
         if strategy == "least_loaded":
             return min(live, key=lambda t: t.inflight).url
         # round_robin (and the hash strategy with no key)

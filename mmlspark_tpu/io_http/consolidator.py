@@ -24,6 +24,7 @@ import time
 from http.server import ThreadingHTTPServer
 from typing import Any, Callable
 
+from ..observability.sanitizer import make_lock
 from ..core.params import HasInputCol, HasOutputCol, Param
 from ..core.pipeline import Transformer
 from .serving import SingleSegmentHandler
@@ -37,7 +38,7 @@ __all__ = ["PartitionConsolidator", "ConsolidatorService"]
 class _RateLimiter:
     def __init__(self, per_second: float | None):
         self.interval = 1.0 / per_second if per_second else 0.0
-        self._lock = threading.Lock()
+        self._lock = make_lock("_RateLimiter._lock")
         self._next = 0.0
 
     def acquire(self) -> None:
@@ -97,7 +98,7 @@ class ConsolidatorService:
         self.host, self.port = host, port
         self._limiter = _RateLimiter(requests_per_second)
         self._lanes = threading.Semaphore(max(num_lanes, 1))
-        self._lock = threading.Lock()
+        self._lock = make_lock("ConsolidatorService._lock")
         self.served = 0
         self.in_flight = 0
         self.max_in_flight = 0

@@ -113,7 +113,6 @@ class ServingGateway:
         self._server: ThreadingHTTPServer | None = None
         self._probe_thread: "threading.Thread | None" = None
         self._stop = threading.Event()
-        self._lock = threading.Lock()
         self._fleet = None
         self.autoscaler = None
         self.exemplars = bool(exemplars)

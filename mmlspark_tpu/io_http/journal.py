@@ -29,6 +29,7 @@ import json
 import os
 import threading
 
+from ..observability.sanitizer import allow_blocking, make_lock
 from .schema import HTTPRequestData, HTTPResponseData
 from ..utils.storage import atomic_write
 
@@ -44,7 +45,7 @@ class ServingJournal:
         self.dir = checkpoint_dir
         os.makedirs(checkpoint_dir, exist_ok=True)
         self.path = os.path.join(checkpoint_dir, self.FILENAME)
-        self._lock = threading.Lock()
+        self._lock = make_lock("ServingJournal._lock")
         self._accepts: dict[str, HTTPRequestData] = {}
         self._replies: dict[str, HTTPResponseData] = {}
         self._load()
@@ -99,9 +100,23 @@ class ServingJournal:
             )
 
     def _append(self, rec: dict) -> None:
+        # Write + flush under the caller's lock (preserves record order);
+        # the durability fsync happens in _sync() AFTER the lock is
+        # released — group commit.
         self._fh.write(json.dumps(rec) + "\n")
         self._fh.flush()
-        os.fsync(self._fh.fileno())
+
+    def _sync(self) -> None:
+        # fsync flushes the whole fd, so records flushed by other threads
+        # between our _append and this call ride along for free.
+        fh = self._fh
+        try:
+            os.fsync(fh.fileno())
+        except (OSError, ValueError):
+            # fd replaced or closed by a concurrent compact()/close();
+            # the compacted file is already durable (atomic_write fsyncs
+            # before rename), so there is nothing left to sync.
+            pass
 
     # -- recording ------------------------------------------------------- #
 
@@ -114,6 +129,7 @@ class ServingJournal:
                 "entity": base64.b64encode(req.entity).decode()
                 if req.entity is not None else None,
             })
+        self._sync()
 
     def record_reply(self, ex_id: str, resp: HTTPResponseData) -> bool:
         """Record a reply; False (and no write) if `ex_id` was already
@@ -129,7 +145,8 @@ class ServingJournal:
                 "entity": base64.b64encode(resp.entity).decode()
                 if resp.entity is not None else None,
             })
-            return True
+        self._sync()
+        return True
 
     # -- queries --------------------------------------------------------- #
 
@@ -177,7 +194,10 @@ class ServingJournal:
                 if r.entity is not None else None,
             }) + "\n" for i, r in self._accepts.items()]
             self._fh.close()
-            atomic_write(self.path, "".join(lines))
+            # stop-the-world by design: recorders must stay excluded
+            # across the rewrite or their appends land on the replaced fd
+            with allow_blocking("journal compact rewrite"):
+                atomic_write(self.path, "".join(lines))
             self._fh = open(self.path, "a", encoding="utf-8")
             return len(answered)
 

@@ -36,6 +36,7 @@ import numpy as np
 
 from ..core.dataplane import AsyncReadback, ShapeBucketer, cache_stats
 from ..core.schema import Table
+from ..observability.sanitizer import make_lock, make_rlock
 from .schema import (HTTPRequestData, HTTPResponseData, RequestDecoder,
                      make_reply, parse_request)
 
@@ -161,16 +162,25 @@ class _HotPath:
         self.force_path: "str | None" = None
         self.path_requests = {self.resident_label: 0, "native": 0, "host": 0}
         self.resident_batches = 0
+        # guards the routing tables and counters above: warm_rung runs on
+        # the warmup thread while scorer threads call route_for/note
+        self._lock = make_rlock("_HotPath._lock")
+
+    def _disable(self, reason: str) -> None:
+        with self._lock:
+            self.disabled = reason
 
     def route_for(self, bucket: int) -> str:
-        if self.disabled is not None:
-            return "host"
-        if self.force_path is not None:
-            return self.force_path
-        # only rungs warmup measured (and byte-verified) route fast: an
-        # unknown rung on the resident path would pay a LIVE compile and
-        # score through a route whose replies were never checked
-        return self.crossover.get(bucket, "host")
+        with self._lock:
+            if self.disabled is not None:
+                return "host"
+            if self.force_path is not None:
+                return self.force_path
+            # only rungs warmup measured (and byte-verified) route fast:
+            # an unknown rung on the resident path would pay a LIVE
+            # compile and score through a route whose replies were never
+            # checked
+            return self.crossover.get(bucket, "host")
 
     def replies_for(self, vals: np.ndarray) -> "list[HTTPResponseData]":
         """Score column -> replies, byte-for-byte what the handler path's
@@ -207,7 +217,7 @@ class _HotPath:
             return
         feats = self.decoder.decode([request] * rung)
         if feats is None:
-            self.disabled = "warmup request outside the fast-path schema"
+            self._disable("warmup request outside the fast-path schema")
             return
         expect = list(expect_entities)
         reason = self.executor.check_ready(Table({self.feature_col: feats}))
@@ -226,7 +236,7 @@ class _HotPath:
                 Table({self.feature_col: feats}))
                 if feats is not None else "warmup schema")
             if feats is None or reason:
-                self.disabled = f"resident precondition: {reason}"
+                self._disable(f"resident precondition: {reason}")
                 return
             expect = [r.entity
                       for r in handler(Table({"request": [req32] * rung}))
@@ -234,10 +244,10 @@ class _HotPath:
         try:
             vals = self.resident_values(feats, rung)  # first call compiles
         except Exception as e:  # noqa: BLE001 — degrade, don't break serving
-            self.disabled = f"resident dispatch failed: {e}"
+            self._disable(f"resident dispatch failed: {e}")
             return
         if [r.entity for r in self.replies_for(vals)] != expect:
-            self.disabled = f"resident replies diverge at rung {rung}"
+            self._disable(f"resident replies diverge at rung {rung}")
             return
         t = {self.resident_label: self._time(
             lambda: self.resident_values(feats, rung))}
@@ -245,16 +255,19 @@ class _HotPath:
             try:
                 nvals = self.native_values(feats)
             except Exception:  # noqa: BLE001 — native scorer unusable
-                self.native_fn = None
+                with self._lock:
+                    self.native_fn = None
             else:
                 if [r.entity for r in self.replies_for(nvals)] != expect:
                     # wrong answers never route; resident is already proven
-                    self.native_fn = None
+                    with self._lock:
+                        self.native_fn = None
                 else:
                     t["native"] = self._time(
                         lambda: self.native_values(feats))
-        self.timings_ms[rung] = {k: v * 1e3 for k, v in t.items()}
-        self.crossover[rung] = min(t, key=t.get)
+        with self._lock:
+            self.timings_ms[rung] = {k: v * 1e3 for k, v in t.items()}
+            self.crossover[rung] = min(t, key=t.get)
 
     @staticmethod
     def _time(fn) -> float:
@@ -266,7 +279,12 @@ class _HotPath:
         return best
 
     def note(self, path: str, n: int) -> None:
-        self.path_requests[path] = self.path_requests.get(path, 0) + n
+        with self._lock:
+            self.path_requests[path] = self.path_requests.get(path, 0) + n
+
+    def note_resident_batch(self) -> None:
+        with self._lock:
+            self.resident_batches += 1
 
     def snapshot(self) -> dict:
         """The info() `hot_path` block: routing table, measured per-rung
@@ -274,24 +292,26 @@ class _HotPath:
         trip-per-request bar is `round_trips_per_resident_request` (each
         resident BATCH costs exactly one upload+readback pair, shared by
         every request coalesced into it)."""
-        res_req = self.path_requests.get(self.resident_label, 0)
-        return {
-            "enabled": self.disabled is None,
-            "disabled_reason": self.disabled,
-            "resident_label": self.resident_label,
-            "crossover": {str(b): p
-                          for b, p in sorted(self.crossover.items())},
-            "timings_ms": {str(b): {k: round(v, 4) for k, v in t.items()}
-                           for b, t in sorted(self.timings_ms.items())},
-            "readback_lag": self.readback_lag,
-            "paths": dict(self.path_requests),
-            "resident_batches": self.resident_batches,
-            "round_trips": self.executor.round_trips,
-            "round_trips_per_resident_request": (
-                self.resident_batches / res_req if res_req else 0.0),
-            "decoder": {"hits": self.decoder.hits,
-                        "fallbacks": self.decoder.fallbacks},
-        }
+        with self._lock:
+            res_req = self.path_requests.get(self.resident_label, 0)
+            return {
+                "enabled": self.disabled is None,
+                "disabled_reason": self.disabled,
+                "resident_label": self.resident_label,
+                "crossover": {str(b): p
+                              for b, p in sorted(self.crossover.items())},
+                "timings_ms": {str(b): {k: round(v, 4)
+                                        for k, v in t.items()}
+                               for b, t in sorted(self.timings_ms.items())},
+                "readback_lag": self.readback_lag,
+                "paths": dict(self.path_requests),
+                "resident_batches": self.resident_batches,
+                "round_trips": self.executor.round_trips,
+                "round_trips_per_resident_request": (
+                    self.resident_batches / res_req if res_req else 0.0),
+                "decoder": {"hits": self.decoder.hits,
+                            "fallbacks": self.decoder.fallbacks},
+            }
 
 
 class ServingServer:
@@ -474,7 +494,7 @@ class ServingServer:
         ensure_cache_metrics(self.metrics)
         _breaker_metrics(self.metrics)
         self._draining = False
-        self._counter_lock = threading.Lock()
+        self._counter_lock = make_lock("ServingServer._counter_lock")
         # rolling service latencies (seconds, enqueue -> reply written)
         self._latencies: collections.deque[float] = collections.deque(maxlen=8192)
         # distributed tracing: None resolves the process-default tracer
@@ -536,7 +556,8 @@ class ServingServer:
         if self.warmup_request is None:
             return True
         if self.bucketer is not None:
-            return set(self.bucketer.ladder) <= self._warm_rungs
+            with self._counter_lock:
+                return set(self.bucketer.ladder) <= self._warm_rungs
         return self._warmed.is_set()
 
     def warmup(self, request: "HTTPRequestData | None" = None) -> int:
@@ -566,7 +587,8 @@ class ServingServer:
                 self.hot_path.warm_rung(
                     self.handler, req, rung,
                     [r.entity for r in out["reply"]])
-            self._warm_rungs.add(rung)
+            with self._counter_lock:
+                self._warm_rungs.add(rung)
         self._warmed.set()
         return len(rungs)
 
@@ -585,9 +607,11 @@ class ServingServer:
                 probes[name] = fn()
             except Exception as e:  # noqa: BLE001 — probe failure is data
                 probes[name] = {"error": str(e)}
+        with self._counter_lock:
+            warm = sorted(self._warm_rungs)
         return {"status": "ok", "draining": self._draining,
                 "ready": self.ready, "pending": self._load(),
-                "warm_rungs": sorted(self._warm_rungs),
+                "warm_rungs": warm,
                 "probes": probes}
 
     # ------------------------------------------------------------------ #
@@ -789,9 +813,11 @@ class ServingServer:
                 if path == "/readyz":
                     # readiness: load balancers route only to 200
                     ready = outer.ready
+                    with outer._counter_lock:
+                        warm = sorted(outer._warm_rungs)
                     self._reply_json(200 if ready else 503, {
                         "ready": ready, "draining": outer._draining,
-                        "warm_rungs": sorted(outer._warm_rungs),
+                        "warm_rungs": warm,
                         "ladder": (list(outer.bucketer.ladder)
                                    if outer.bucketer is not None else None),
                     })
@@ -1142,14 +1168,15 @@ class ServingServer:
                 ex.response = _handler_error_response(e)
                 ex.event.set()
             return True
-        hp.resident_batches += 1
+        hp.note_resident_batch()
         self._c_round_trips.inc()
         readback.push((outs, batch, ledger, time.perf_counter()))
         depth = readback.pending
         for ex in batch:
             ex.readback_lag = depth
         self._g_readback.set(depth)
-        self._warm_rungs.add(target)
+        with self._counter_lock:
+            self._warm_rungs.add(target)
         return True
 
     def _complete_resident(self, item) -> None:
@@ -1258,7 +1285,8 @@ class ServingServer:
                 if target is not None:
                     # this rung's executable is compiled now — the
                     # readiness signal warmup() drives deliberately
-                    self._warm_rungs.add(target)
+                    with self._counter_lock:
+                        self._warm_rungs.add(target)
             except Exception as e:  # noqa: BLE001 — batch failure -> 500s
                 self._c_failed.inc(len(batch))
                 sspan.set(error=str(e))
@@ -1589,7 +1617,7 @@ class FleetRendezvous:
         self.name = name
         self.host, self.port = host, port
         self._services: dict[int, ServiceInfo] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("FleetRendezvous._lock")
         self._server: ThreadingHTTPServer | None = None
         self.aggregator = MetricsAggregator(
             urls=self._metric_urls, clock=clock,
@@ -1954,7 +1982,7 @@ class ServingFleet:
         # excludes them so self-healing never resurrects a scale-down
         self._retired: set[int] = set()
         self._watchers: list[Callable[[str, str], None]] = []
-        self._fleet_lock = threading.RLock()
+        self._fleet_lock = make_rlock("ServingFleet._fleet_lock")
         # the injectable clock drives the startup wait loop and the
         # rendezvous aggregator's staleness logic — chaos tests pass a
         # FakeClock so dead-replica detection needs zero real waiting
@@ -2119,7 +2147,8 @@ class ServingFleet:
             part = self._next_part
             self._next_part += 1
             p, parent = self._launch(part)
-            self._procs.append(p)
+            with self._fleet_lock:
+                self._procs.append(p)
             started.append((slot, p, parent))
         try:
             for slot, p, parent in started:
@@ -2190,7 +2219,8 @@ class ServingFleet:
                 f"slot {index} is still alive — kill() or retire() it "
                 "before respawning")
         self._drop_url(index)  # no-op when kill() already pruned it
-        self._retired.discard(index)
+        with self._fleet_lock:
+            self._retired.discard(index)
         url = self._spawn(index)
         self._record_transition("respawn", slot=index, url=url)
         return url
@@ -2200,7 +2230,8 @@ class ServingFleet:
         URL first (routing layers stop sending new work), then SIGTERM —
         the worker sheds, drains in-flight requests, flushes its final
         counters, and exits. Hard kill only past stop_timeout_s."""
-        self._retired.add(index)
+        with self._fleet_lock:
+            self._retired.add(index)
         self._drop_url(index)
         self._record_transition("retire", slot=index)
         p = self._procs[index]
@@ -2250,19 +2281,22 @@ class ServingFleet:
         pushed to the rendezvous, traces exported); workers that miss
         `stop_timeout_s` get the historical hard kill. The rendezvous
         stops LAST so the final flushes have somewhere to land."""
-        for p in self._procs:
+        with self._fleet_lock:
+            procs = list(self._procs)
+        for p in procs:
             if p.is_alive():
                 p.terminate()
         deadline = time.monotonic() + self.stop_timeout_s
-        for p in self._procs:
+        for p in procs:
             p.join(timeout=max(deadline - time.monotonic(), 0.1))
-        for p in self._procs:
+        for p in procs:
             if p.is_alive():
                 p.kill()
                 p.join(timeout=10)
-        self._procs = []
-        self._url_of = {}
-        self._retired = set()
-        self.urls = []
+        with self._fleet_lock:
+            self._procs = []
+            self._url_of = {}
+            self._retired = set()
+            self.urls = []
         if self.rendezvous is not None:
             self.rendezvous.stop()

@@ -29,6 +29,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterable
 
+from .sanitizer import make_lock
 from .metrics import _escape_label, _fmt_value
 
 __all__ = [
@@ -425,7 +426,7 @@ class MetricsAggregator:
         self.stale_after_s = float(stale_after_s)
         self.timeout_s = float(timeout_s)
         self._fetch = fetch if fetch is not None else _default_fetch
-        self._lock = threading.Lock()
+        self._lock = make_lock("MetricsAggregator._lock")
         self._replicas: dict[str, _ReplicaState] = {}
 
     # -- membership ----------------------------------------------------- #

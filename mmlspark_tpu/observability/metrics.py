@@ -32,6 +32,7 @@ from __future__ import annotations
 import bisect
 import re
 import threading
+from .sanitizer import make_lock, make_rlock
 import time
 from typing import Any, Callable, Iterable
 
@@ -137,7 +138,7 @@ class _CounterChild:
 
     def __init__(self, flag: _Flag):
         self._flag = flag
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics._CounterChild")
         self._value = 0.0
 
     def inc(self, v: float = 1.0) -> None:
@@ -158,14 +159,16 @@ class _GaugeChild:
 
     def __init__(self, flag: _Flag):
         self._flag = flag
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics._GaugeChild")
         self._value = 0.0
 
     def set(self, v: float) -> None:
         if not self._flag.on:
             return
-        # a plain store is atomic under the GIL; no lock on the set path
-        self._value = float(v)
+        # locked like inc(): an unlocked store could land between inc's
+        # read and write and be silently overwritten (lost update)
+        with self._lock:
+            self._value = float(v)
 
     def inc(self, v: float = 1.0) -> None:
         if not self._flag.on:
@@ -189,7 +192,7 @@ class _HistogramChild:
                  exemplars: bool = False):
         self._flag = flag
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics._HistogramChild")
         self._bounds = bounds
         self._counts = [0] * (len(bounds) + 1)   # last slot = +Inf
         self._sum = 0.0
@@ -442,7 +445,7 @@ class MetricsRegistry:
     def __init__(self, clock: Any = None, enabled: bool = True):
         self._clock = clock if clock is not None else _MonotonicClock()
         self._flag = _Flag(enabled)
-        self._lock = threading.RLock()
+        self._lock = make_rlock("MetricsRegistry._lock")
         self._families: dict[str, _Family] = {}
         # name -> (doc, kind, fn); fn() returns a float or a list of
         # (labels_dict, float) samples
@@ -615,7 +618,7 @@ class MetricsRegistry:
 # --------------------------------------------------------------------- #
 
 _DEFAULT: "MetricsRegistry | None" = None
-_DEFAULT_LOCK = threading.Lock()
+_DEFAULT_LOCK = make_lock("metrics._DEFAULT_LOCK")
 
 
 def _default_enabled() -> bool:

@@ -50,6 +50,7 @@ slowest shard — the input the skew-aware bucketing work needs.
 from __future__ import annotations
 
 import threading
+from .sanitizer import make_lock
 from collections import deque
 from typing import Any
 
@@ -348,7 +349,7 @@ class Profiler:
         self.tracer = tracer
         self.recorder = recorder
         self._clock = clock if clock is not None else _MonotonicClock()
-        self._lock = threading.Lock()
+        self._lock = make_lock("Profiler._lock")
         self._records: deque[dict] = deque(maxlen=int(max_records))
         # (kind, segment) -> aggregate dict
         self._agg: dict[tuple[str, str], dict] = {}
@@ -458,9 +459,11 @@ class Profiler:
         while True:
             self._wake.wait(timeout=0.25)
             self._wake.clear()
-            self._drain_idle = False
+            with self._lock:
+                self._drain_idle = False
             self.flush()
-            self._drain_idle = True
+            with self._lock:
+                self._drain_idle = True
 
     def flush(self) -> None:
         """Drain pending ledgers synchronously. Safe from any thread —
@@ -822,7 +825,7 @@ def render_attribution(rows: list[dict],
 # --------------------------------------------------------------------- #
 
 _DEFAULT: "Profiler | None" = None
-_DEFAULT_LOCK = threading.Lock()
+_DEFAULT_LOCK = make_lock("profiler._DEFAULT_LOCK")
 
 
 def get_profiler() -> Profiler:

@@ -49,6 +49,7 @@ import json
 import os
 import tempfile
 import threading
+from .sanitizer import make_lock
 import time
 from collections import deque
 from typing import Any
@@ -110,7 +111,7 @@ class FlightRecorder:
         # (None: the process default at call time)
         self.registry = registry
         self._clock = clock if clock is not None else _MonotonicClock()
-        self._lock = threading.Lock()
+        self._lock = make_lock("FlightRecorder._lock")
         self._events: deque[dict] = deque(maxlen=int(capacity))
         self._seq = 0
         self._dropped = 0
@@ -404,7 +405,7 @@ def load_dump(path: str) -> "tuple[dict, list[dict]]":
 # --------------------------------------------------------------------- #
 
 _DEFAULT: "FlightRecorder | None" = None
-_DEFAULT_LOCK = threading.Lock()
+_DEFAULT_LOCK = make_lock("recorder._DEFAULT_LOCK")
 
 
 def get_recorder() -> FlightRecorder:

@@ -27,6 +27,7 @@ import time
 from collections import deque
 from typing import Any, Callable
 
+from .sanitizer import make_lock
 from .metrics import MetricsRegistry
 
 __all__ = [
@@ -215,7 +216,7 @@ class SLOEngine:
             "mmlspark_tpu_slo_budget_remaining_ratio",
             "error budget left over the longest window (1 - burn, floor 0)",
             labels=("slo",))
-        self._lock = threading.Lock()
+        self._lock = make_lock("SLOEngine._lock")
         keep = 2.0 * max(self.windows.values())
         self._keep_s = keep
         # per-SLO history of (t, total, bad); pruned past 2x longest window

@@ -35,6 +35,7 @@ import json
 import os
 import re
 import threading
+from .sanitizer import make_lock
 import time
 from collections import deque
 from typing import Any
@@ -202,7 +203,7 @@ class Tracer:
             if annotate_device is None else bool(annotate_device))
         self._spans: deque[Span] = deque(maxlen=int(max_spans))
         self._dropped = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("Tracer._lock")
         # Ids are PROCESS-SEEDED: the pid owns the top bits and random
         # bits scatter the counter base, so per-replica exports merge
         # into one fleet trace with no span-id collisions. Stays < 2^62
@@ -435,7 +436,7 @@ def phase_children(events: "list[dict]",
 # --------------------------------------------------------------------- #
 
 _DEFAULT: "Tracer | None" = None
-_DEFAULT_LOCK = threading.Lock()
+_DEFAULT_LOCK = make_lock("tracing._DEFAULT_LOCK")
 
 
 def get_tracer() -> Tracer:

@@ -19,6 +19,7 @@ import threading
 import urllib.parse
 from typing import Any, Callable, TypeVar
 
+from ..observability.sanitizer import make_lock
 from ..core.params import Param
 from ..core.pipeline import Transformer
 from ..core.schema import Table
@@ -99,7 +100,7 @@ class CircuitBreaker:
         self.open_duration_s = float(open_duration_s)
         self.half_open_max_calls = int(half_open_max_calls)
         self.clock = clock
-        self._lock = threading.Lock()
+        self._lock = make_lock("CircuitBreaker._lock")
         self._outcomes: collections.deque[bool] = collections.deque(
             maxlen=self.window)
         self._state = "closed"
@@ -237,7 +238,7 @@ class BreakerRegistry:
 
     def __init__(self, clock: Clock = SYSTEM_CLOCK, **breaker_kw: Any):
         self._breakers: dict[str, CircuitBreaker] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("BreakerRegistry._lock")
         self._clock = clock
         self._kw = breaker_kw
 

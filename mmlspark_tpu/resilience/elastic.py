@@ -42,6 +42,7 @@ import threading
 import time
 from typing import Any, Callable
 
+from ..observability.sanitizer import make_lock
 from .policy import Clock, SYSTEM_CLOCK
 from ..utils.storage import atomic_write
 
@@ -80,7 +81,7 @@ class Preempted(RuntimeError):
 
 # -- telemetry (never blocks training) ---------------------------------- #
 
-_LAST_SAVE_LOCK = threading.Lock()
+_LAST_SAVE_LOCK = make_lock("elastic._LAST_SAVE_LOCK")
 _LAST_SAVE_T: "float | None" = None
 _LAST_SAVE_CLOCK: Clock = SYSTEM_CLOCK
 
@@ -286,7 +287,7 @@ class TrainingCheckpointer:
 # -- preemption ---------------------------------------------------------- #
 
 _ACTIVE_GUARD: "PreemptionGuard | None" = None
-_ACTIVE_LOCK = threading.Lock()
+_ACTIVE_LOCK = make_lock("elastic._ACTIVE_LOCK")
 
 
 def get_active_guard() -> "PreemptionGuard | None":

@@ -52,6 +52,12 @@ class SystemClock(Clock):
 
     def sleep(self, seconds: float) -> None:
         if seconds > 0:
+            # runtime R3 hook: a real sleep while holding a sanitized
+            # lock is reported (lazy import; free when the sanitizer is
+            # off or no sanitized locks are held)
+            from ..observability.sanitizer import note_blocking
+
+            note_blocking("sleep")
             time.sleep(seconds)
 
 

@@ -25,6 +25,7 @@ import collections
 import threading
 from typing import Any, Callable
 
+from ..observability.sanitizer import make_lock
 from .policy import (Clock, RetryPolicy, SYSTEM_CLOCK, is_fatal_exception)
 
 __all__ = ["RestartPolicy", "QuerySupervisor", "PartitionSupervisor"]
@@ -93,6 +94,13 @@ class QuerySupervisor:
         self._restart_times: collections.deque[float] = collections.deque()
         self._stop = threading.Event()
         self._thread: "threading.Thread | None" = None
+        # guards state/restarts/last_exception: written by the monitor
+        # thread, read and written by start()/stop() callers
+        self._state_lock = make_lock("QuerySupervisor._state_lock")
+
+    def _set_state(self, s: str) -> None:
+        with self._state_lock:
+            self.state = s
 
     def _count_restart(self) -> None:
         """Supervised restarts, labeled by query name. The counter lives in
@@ -136,7 +144,7 @@ class QuerySupervisor:
         if self._thread is not None and self._thread.is_alive():
             raise RuntimeError("supervisor is already running")
         self._stop.clear()
-        self.state = "running"
+        self._set_state("running")
         self.query.start()
         self._thread = threading.Thread(
             target=self._monitor, name="query-supervisor", daemon=True)
@@ -148,8 +156,9 @@ class QuerySupervisor:
         if self._thread is not None:
             self._thread.join(timeout=10)
         self.query.stop()
-        if self.state == "running":
-            self.state = "stopped"
+        with self._state_lock:
+            if self.state == "running":
+                self.state = "stopped"
 
     def await_terminal(self, timeout_s: "float | None" = None) -> bool:
         """Block until the supervisor leaves "running" (or timeout)."""
@@ -177,13 +186,14 @@ class QuerySupervisor:
             if self._stop.is_set():
                 break
             exc = self.query.exception
-            self.last_exception = exc
+            with self._state_lock:
+                self.last_exception = exc
             if exc is None:
                 # clean exit (someone stopped the query directly)
-                self.state = "stopped"
+                self._set_state("stopped")
                 return
             if self.policy.is_fatal(exc) or not self._restart_allowed():
-                self.state = "failed"
+                self._set_state("failed")
                 self._flight_record("escalate", exc,
                                     dump_trigger="restart", force=True)
                 if self.on_failure is not None:
@@ -195,7 +205,7 @@ class QuerySupervisor:
                     self.query.batches_processed > batches_at_restart:
                 sess = self.policy.backoff.session()
             if not sess.should_retry():
-                self.state = "failed"
+                self._set_state("failed")
                 self._flight_record("escalate", exc,
                                     dump_trigger="restart", force=True)
                 if self.on_failure is not None:
@@ -206,14 +216,15 @@ class QuerySupervisor:
             if self._stop.is_set():
                 break
             self._restart_times.append(self.clock.monotonic())
-            self.restarts += 1
+            with self._state_lock:
+                self.restarts += 1
             self._count_restart()
             self._flight_record("restart", exc, dump_trigger="restart")
             batches_at_restart = self.query.batches_processed
             if self.on_restart is not None:
                 self.on_restart(self.query, exc, self.restarts)
             self.query.start()
-        self.state = "stopped"
+        self._set_state("stopped")
 
 
 class PartitionSupervisor:
@@ -257,6 +268,13 @@ class PartitionSupervisor:
         self._respawn_times: collections.deque[float] = collections.deque()
         self._stop = threading.Event()
         self._thread: "threading.Thread | None" = None
+        # guards state/respawns/last_exception: written by the monitor
+        # thread, read and written by start()/stop() callers
+        self._state_lock = make_lock("PartitionSupervisor._state_lock")
+
+    def _set_state(self, s: str) -> None:
+        with self._state_lock:
+            self.state = s
 
     def _count_respawn(self) -> None:
         try:
@@ -299,7 +317,7 @@ class PartitionSupervisor:
         if self._thread is not None and self._thread.is_alive():
             raise RuntimeError("supervisor is already running")
         self._stop.clear()
-        self.state = "running"
+        self._set_state("running")
         self._thread = threading.Thread(
             target=self._monitor, name=f"partition-supervisor-{self.name}",
             daemon=True)
@@ -310,8 +328,9 @@ class PartitionSupervisor:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10)
-        if self.state == "running":
-            self.state = "stopped"
+        with self._state_lock:
+            if self.state == "running":
+                self.state = "stopped"
 
     def _monitor(self) -> None:
         while not self._stop.is_set():
@@ -323,7 +342,7 @@ class PartitionSupervisor:
                 if self._stop.is_set():
                     break
                 if not self._respawn_allowed():
-                    self.state = "failed"
+                    self._set_state("failed")
                     self._flight_record("escalate", slot=slot,
                                         exc=self.last_exception,
                                         dump_trigger="restart", force=True)
@@ -333,14 +352,16 @@ class PartitionSupervisor:
                 try:
                     self.fleet.respawn(slot)
                 except Exception as e:  # noqa: BLE001 — retried next poll
-                    self.last_exception = e
+                    with self._state_lock:
+                        self.last_exception = e
                     continue
                 self._respawn_times.append(self.clock.monotonic())
-                self.respawns += 1
+                with self._state_lock:
+                    self.respawns += 1
                 self._count_respawn()
                 self._flight_record("respawn", slot=slot,
                                     dump_trigger="restart")
                 if self.on_respawn is not None:
                     self.on_respawn(self.fleet, slot, self.respawns)
             self._stop.wait(self.poll_interval_s)
-        self.state = "stopped"
+        self._set_state("stopped")

@@ -31,6 +31,7 @@ import json
 import os
 import threading
 
+from ..observability.sanitizer import allow_blocking, make_lock
 from ..utils.storage import atomic_write
 
 __all__ = ["CommitLog"]
@@ -58,7 +59,7 @@ class CommitLog:
         self.dir = checkpoint_dir
         os.makedirs(checkpoint_dir, exist_ok=True)
         self.path = os.path.join(checkpoint_dir, self.FILENAME)
-        self._lock = threading.Lock()
+        self._lock = make_lock("CommitLog._lock")
         self._plans: dict[int, dict] = {}   # batch_id -> {"start", "end"}
         self._committed: set[int] = set()
         self._load()
@@ -98,9 +99,23 @@ class CommitLog:
             self._committed.add(int(rec["batch_id"]))
 
     def _append(self, rec: dict) -> None:
+        # Write + flush under the caller's lock (preserves record order);
+        # the durability fsync happens in _sync() AFTER the lock is
+        # released — group commit.
         self._fh.write(json.dumps(rec) + "\n")
         self._fh.flush()
-        os.fsync(self._fh.fileno())
+
+    def _sync(self) -> None:
+        # fsync flushes the whole fd, so records flushed by other threads
+        # between our _append and this call ride along for free.
+        fh = self._fh
+        try:
+            os.fsync(fh.fileno())
+        except (OSError, ValueError):
+            # fd replaced or closed by a concurrent compact()/close();
+            # the compacted file is already durable (atomic_write fsyncs
+            # before rename), so there is nothing left to sync.
+            pass
 
     # -- plan / commit ---------------------------------------------------- #
 
@@ -111,6 +126,7 @@ class CommitLog:
             self._plans[batch_id] = {"start": start, "end": end}
             self._append({"t": "plan", "batch_id": batch_id,
                           "start": start, "end": end})
+        self._sync()
 
     def planned(self, batch_id: int) -> dict | None:
         """{"start", "end"} of a planned batch, or None."""
@@ -123,6 +139,7 @@ class CommitLog:
                 return
             self._committed.add(batch_id)
             self._append({"t": "commit", "batch_id": batch_id})
+        self._sync()
 
     def last_committed(self) -> int:
         """Highest committed batch id; -1 when nothing has committed."""
@@ -293,7 +310,10 @@ class CommitLog:
             for b in sorted(self._committed):
                 lines.append(json.dumps({"t": "commit", "batch_id": b}) + "\n")
             self._fh.close()
-            atomic_write(self.path, "".join(lines))
+            # stop-the-world by design: writers must stay excluded
+            # across the rewrite or their appends land on the replaced fd
+            with allow_blocking("commit-log compact rewrite"):
+                atomic_write(self.path, "".join(lines))
             self._fh = open(self.path, "a", encoding="utf-8")
             return dropped
 

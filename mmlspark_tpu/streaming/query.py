@@ -31,6 +31,7 @@ from typing import Any, Callable
 
 from ..core.dataplane import Lookahead
 from ..core.schema import Table
+from ..observability.sanitizer import make_rlock
 from ..observability.metrics import get_registry
 from ..observability.tracing import get_tracer
 from ..resilience.policy import RetryPolicy, is_fatal_exception
@@ -130,7 +131,10 @@ class StreamingQuery:
         self._lookahead = (Lookahead(name=f"source-{name}")
                            if source_lookahead > 0 else None)
         self._log = CommitLog(checkpoint_dir) if checkpoint_dir else None
-        self._lock = threading.RLock()
+        # blocking_ok: this is the one-batch-at-a-time pipeline mutex —
+        # its holder performs the WAL plan/commit and sink write (all
+        # I/O) by design; it still participates in lock-order checking
+        self._lock = make_rlock("StreamingQuery._lock", blocking_ok=True)
         self._stop = threading.Event()
         self._closed = False
         self._failed = False
@@ -351,7 +355,8 @@ class StreamingQuery:
         if self._thread is not None and self._thread.is_alive():
             raise RuntimeError(f"query {self.name!r} is already running")
         self._stop.clear()
-        self._failed = False
+        with self._lock:
+            self._failed = False
         self._thread = threading.Thread(
             target=self._run, name=f"streaming-query-{self.name}",
             daemon=True)
@@ -364,14 +369,16 @@ class StreamingQuery:
             try:
                 progressed = self.process_next()
             except Exception as e:  # noqa: BLE001 — classified below
-                self._exception = e
+                with self._lock:
+                    self._exception = e
                 if sess is None:
                     sess = self.batch_retry_policy.session()
                 if is_fatal_exception(e) or not sess.should_retry():
                     # budget spent (or the error cannot heal): terminate
                     # with `exception` set — a QuerySupervisor above takes
                     # it from here; the WAL plan keeps a later replay exact
-                    self._failed = True
+                    with self._lock:
+                        self._failed = True
                     # last chance to get the black box out before the
                     # loop dies: record the fatal error and dump
                     try:
@@ -392,7 +399,8 @@ class StreamingQuery:
             sess = None
             if progressed:
                 # a recovered query must not look failed forever
-                self._exception = None
+                with self._lock:
+                    self._exception = None
             else:
                 self._stop.wait(self.trigger_interval_s)
 

@@ -25,6 +25,7 @@ import threading
 from typing import Any, Callable
 
 from ..core.schema import Table
+from ..observability.sanitizer import make_lock
 from ..core.table_io import write_parquet
 
 __all__ = ["Sink", "MemorySink", "ParquetSink", "ForeachBatchSink",
@@ -47,7 +48,7 @@ class MemorySink(Sink):
     is dropped."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("MemorySink._lock")
         self._batches: dict[int, Table] = {}
 
     def add_batch(self, batch_id: int, table: Table) -> None:

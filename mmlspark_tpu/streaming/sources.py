@@ -29,6 +29,7 @@ import threading
 from typing import Any
 
 from ..core.schema import Table
+from ..observability.sanitizer import make_lock
 from ..core.table_io import read_csv, read_parquet
 
 __all__ = ["Source", "DirectorySource", "MemorySource", "SocketSource",
@@ -72,7 +73,7 @@ class MemorySource(Source):
     """
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("MemorySource._lock")
         self._table: "Table | None" = None
         self._base = 0          # rows trimmed by commit()
 
@@ -200,7 +201,7 @@ class SocketSource(Source):
     def __init__(self, host: str, port: int,
                  encoding: str = "utf-8") -> None:
         self.host, self.port, self.encoding = host, port, encoding
-        self._lock = threading.Lock()
+        self._lock = make_lock("SocketSource._lock")
         self._lines: list[str] = []
         self._base = 0
         self._stop = threading.Event()

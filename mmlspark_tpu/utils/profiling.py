@@ -58,6 +58,7 @@ def block_until_ready(tree: Any) -> Any:
 
 def profile_fn(fn: Callable, *args, warmup: int = 1, iters: int = 3,
                registry: Any = None, name: "str | None" = None,
+               clock: "Callable[[], float] | None" = None,
                **kwargs) -> "tuple[Any, dict]":
     """Quick timing: compile (first-call) time, then per-iteration steady
     wall times with device completion awaited. Returns `(out, stats)` —
@@ -66,19 +67,24 @@ def profile_fn(fn: Callable, *args, warmup: int = 1, iters: int = 3,
     first_call_s, steady_s (mean), compile_overhead_s, iter_min_s,
     iter_median_s, iter_max_s, iters.
 
+    `clock` is any zero-arg monotonic float source (default
+    `time.perf_counter`); tests inject a fake to assert on the stats
+    arithmetic without depending on real elapsed time.
+
     The measurements also land in `registry` (the process default when
     None) as `mmlspark_tpu_profile_*` series labeled `fn=` the callable's
     name (override with `name=`)."""
-    t0 = time.perf_counter()
+    now = clock if clock is not None else time.perf_counter
+    t0 = now()
     out = block_until_ready(fn(*args, **kwargs))
-    first = time.perf_counter() - t0
+    first = now() - t0
     for _ in range(max(warmup - 1, 0)):
         block_until_ready(fn(*args, **kwargs))
     samples = []
     for _ in range(iters):
-        t0 = time.perf_counter()
+        t0 = now()
         out = block_until_ready(fn(*args, **kwargs))
-        samples.append(time.perf_counter() - t0)
+        samples.append(now() - t0)
     steady = sum(samples) / len(samples) if samples else 0.0
     ordered = sorted(samples)
     stats = {

@@ -116,10 +116,22 @@ def write_bytes(uri: str, data: bytes) -> None:
     raise ValueError(f"unsupported storage scheme {scheme!r} in {uri!r}")
 
 
+def _note_fsync() -> None:
+    """Runtime R3 hook: report an fsync issued while the calling thread
+    holds a sanitized lock (free when the sanitizer is off)."""
+    try:
+        from ..observability.sanitizer import note_blocking
+
+        note_blocking("fsync")
+    except ImportError:  # partial package import — never block a write
+        pass
+
+
 def _fsync_dir(path: str) -> None:
     """fsync the directory so the rename itself is durable. Some
     filesystems refuse directory fds (or fsync on them) — crash
     consistency degrades gracefully there, it must not break writes."""
+    _note_fsync()
     try:
         fd = os.open(path or ".", os.O_RDONLY)
     except OSError:
@@ -148,6 +160,7 @@ def atomic_write(path: str, data: "bytes | str") -> None:
     dirname = os.path.dirname(dest) or "."
     os.makedirs(dirname, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=dirname, suffix=".tmp")
+    _note_fsync()
     try:
         with os.fdopen(fd, "wb") as fh:
             fh.write(payload)

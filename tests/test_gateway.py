@@ -181,6 +181,24 @@ class TestTargetPool:
             if home != victim:
                 assert pool.pick("hash", key=k) == home
 
+    def test_hash_keys_do_not_move_when_a_target_is_admitted(self):
+        """Admitting a NEW target reshapes the ring (~1/N of hash space
+        moves) but must not re-home established keys: the sticky binding
+        holds as long as the old home stays live. A key whose home then
+        dies rehashes over the grown live set."""
+        pool = TargetPool(["http://a/", "http://b/"])
+        homes = {k: pool.pick("hash", key=k) for k in "abcdefgh"}
+        pool.admit("http://c/")
+        for k, home in homes.items():
+            assert pool.pick("hash", key=k) == home
+        pool.remove("http://a/")
+        for k, home in homes.items():
+            got = pool.pick("hash", key=k)
+            if home == "http://a/":
+                assert got in ("http://b/", "http://c/")
+            else:
+                assert got == home
+
     def test_eject_admit_gate(self):
         pool = TargetPool(["http://a/", "http://b/"])
         assert pool.eject("http://a/", reason="readyz")

@@ -1,0 +1,514 @@
+"""graftlint static analysis + runtime lock-order sanitizer.
+
+Two halves of one contract (docs/analysis.md): the static side proves
+each rule catches its seeded violation and stays quiet on a clean twin,
+and that the baseline policy holds (R1–R3 unsuppressable, every entry
+justified, stale entries fail the gate). The runtime side provokes a
+real 2-lock ordering cycle across two threads and asserts the sanitizer
+names both locks and both threads in the violation AND in the flight-
+recorder dump — with zero real waiting (the cycle is detected from
+ordering evidence, the run never deadlocks).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from tools.graftlint import engine
+from tools.graftlint.astinfo import index_source
+from tools.graftlint.engine import Finding, load_baseline, split_suppressed
+from tools.graftlint.rules_concurrency import _r1_run, _r2_run, _r3_run
+from tools.graftlint.rules_determinism import _r5_run
+from tools.graftlint.rules_device import _r4_run, _r6_run
+from tools.graftlint.rules_metrics import check_literal
+
+from mmlspark_tpu.observability import sanitizer
+from mmlspark_tpu.observability.recorder import FlightRecorder
+from mmlspark_tpu.resilience.policy import FakeClock, SystemClock
+
+
+# -- rule units: seeded violation + clean twin ---------------------------- #
+
+
+class TestR1GuardedBy:
+    def test_mixed_locking_is_a_lost_update(self):
+        src = """
+import threading
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0
+    def bump(self):
+        with self._lock:
+            self.hits += 1
+    def reset(self):
+        self.hits = 0
+"""
+        findings = _r1_run(index_source(src))
+        assert len(findings) == 1
+        f = findings[0]
+        assert (f.rule, f.func, f.match) == ("R1", "Counter.reset",
+                                             "attr:hits")
+        assert "Counter.bump" in f.message  # names the guarded site
+
+    def test_thread_write_read_by_caller(self):
+        src = """
+import threading
+class Bg:
+    def __init__(self):
+        self.out = None
+        self._t = threading.Thread(target=self._work)
+    def _work(self):
+        self.out = 7
+    def result(self):
+        return self.out
+"""
+        findings = _r1_run(index_source(src))
+        assert [f.func for f in findings] == ["Bg._work"]
+
+    def test_inherited_lockset_and_init_phase_are_clean(self):
+        # _advance writes bare, but its ONLY non-init caller holds the
+        # lock (caller-context inheritance); __init__-time writes and a
+        # helper reachable only from __init__ predate any concurrency
+        src = """
+import threading
+class Clean:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pos = 0
+        self._seed()
+    def _seed(self):
+        self.pos = -1
+    def _advance(self):
+        self.pos += 1
+    def step(self):
+        with self._lock:
+            self._advance()
+"""
+        assert _r1_run(index_source(src)) == []
+
+
+class TestR2LockOrder:
+    def test_three_lock_cycle_one_scc(self):
+        src = """
+import threading
+class C:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._c = threading.Lock()
+    def x(self):
+        with self._a:
+            with self._b:
+                pass
+    def y(self):
+        with self._b:
+            with self._c:
+                pass
+    def z(self):
+        with self._c:
+            with self._a:
+                pass
+"""
+        findings = _r2_run(index_source(src))
+        assert len(findings) == 1
+        assert findings[0].match == "cycle:C._a|C._b|C._c"
+        # every witness edge lands in the message for the postmortem
+        assert "C._a->C._b" in findings[0].message
+
+    def test_consistent_order_is_clean(self):
+        src = """
+import threading
+class C:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+    def x(self):
+        with self._a:
+            with self._b:
+                pass
+    def y(self):
+        with self._a:
+            pass
+"""
+        assert _r2_run(index_source(src)) == []
+
+
+class TestR3BlockingUnderLock:
+    def test_direct_socket_wait(self):
+        src = """
+import threading
+class Rx:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sock = None
+    def pull(self):
+        with self._lock:
+            return self._sock.recv(4096)
+"""
+        findings = _r3_run(index_source(src))
+        assert [(f.func, f.match) for f in findings] == [
+            ("Rx.pull", "op:recv")]
+
+    def test_propagated_one_call_level(self):
+        src = """
+import os, threading
+class Wal:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._fh = open("w", "a")
+    def _flush(self):
+        os.fsync(self._fh.fileno())
+    def append(self, rec):
+        with self._lock:
+            self._flush()
+"""
+        findings = _r3_run(index_source(src))
+        assert ("Wal.append", "call:_flush") in [
+            (f.func, f.match) for f in findings]
+
+    def test_blocking_after_release_is_clean(self):
+        src = """
+import threading, time
+class Ok:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.delay = 0.1
+    def nap(self):
+        with self._lock:
+            d = self.delay
+        time.sleep(d)
+"""
+        assert _r3_run(index_source(src)) == []
+
+
+class TestR4R5R6:
+    def test_r4_host_sync_in_hot_path_only(self):
+        src = """
+def fused_topk(x):
+    return x.tolist()
+
+def summarize(x):
+    return x.tolist()
+"""
+        findings = _r4_run(index_source(src))
+        assert [(f.func, f.match) for f in findings] == [
+            ("fused_topk", "sync:tolist")]
+
+    def test_r5_ambient_nondeterminism(self):
+        src = """
+import time, random
+def stamp(rows):
+    random.shuffle(rows)
+    return rows, time.time()
+
+def timed(rows, clock):
+    t0 = time.perf_counter()
+    return rows, clock.monotonic(), time.perf_counter() - t0
+"""
+        findings = _r5_run(index_source(src))
+        assert {f.match for f in findings} == {"call:random.shuffle",
+                                               "call:time.time"}
+        assert all(f.func == "stamp" for f in findings)
+
+    def test_r6_jit_immediate_and_uncached(self):
+        src = """
+import jax
+def once(x):
+    return jax.jit(lambda y: y + 1)(x)
+
+def builder(fn):
+    wrapped = jax.jit(fn)
+    return wrapped
+"""
+        findings = _r6_run(index_source(src))
+        assert {f.match for f in findings} == {"jit-immediate",
+                                               "jit-in-function"}
+
+    def test_r6_cached_construction_is_clean(self):
+        src = """
+import functools, jax
+class Model:
+    def __init__(self, fn):
+        self._step = jax.jit(fn)
+
+@functools.lru_cache(maxsize=4)
+def build(fn):
+    return jax.jit(fn)
+"""
+        assert _r6_run(index_source(src)) == []
+
+
+class TestMRules:
+    def test_metric_literal_checks(self):
+        assert check_literal("mmlspark_tpu_requests_total") is None
+        assert check_literal("Bad-Name_total")[0] == "M1"    # charset
+        assert check_literal("mmlspark_tpu_latency")[0] == "M2"  # unit
+
+
+# -- engine: keys, baseline policy, exit codes ---------------------------- #
+
+
+def _finding(rule="R5", file="mmlspark_tpu/x.py", line=3, func="f",
+             match="call:time.time", message="m"):
+    return Finding(rule, file, line, func, match, message)
+
+
+class TestEngine:
+    def test_finding_key_ignores_line(self):
+        assert _finding(line=3).key() == _finding(line=999).key()
+
+    def test_baseline_rejects_r1_r2_r3(self, tmp_path):
+        for rule in ("R1", "R2", "R3"):
+            p = tmp_path / f"{rule}.json"
+            p.write_text(json.dumps([{"rule": rule, "file": "a.py",
+                                      "func": "f", "match": "attr:x",
+                                      "why": "nope"}]))
+            with pytest.raises(SystemExit, match="never baselined"):
+                load_baseline(str(p))
+
+    def test_baseline_rejects_empty_why_and_missing_keys(self, tmp_path):
+        p = tmp_path / "b.json"
+        p.write_text(json.dumps([{"rule": "R4", "file": "a.py",
+                                  "func": "f", "match": "sync:item",
+                                  "why": "  "}]))
+        with pytest.raises(SystemExit, match="empty 'why'"):
+            load_baseline(str(p))
+        p.write_text(json.dumps([{"rule": "R4", "file": "a.py",
+                                  "why": "x"}]))
+        with pytest.raises(SystemExit, match="missing"):
+            load_baseline(str(p))
+
+    def test_split_suppressed_exact_wildcard_stale(self):
+        f = _finding()
+        exact = {"rule": "R5", "file": "mmlspark_tpu/x.py", "func": "f",
+                 "match": "call:time.time", "why": "w"}
+        wild = {"rule": "R5", "file": "mmlspark_tpu/x.py", "func": "*",
+                "match": "call:time.time", "why": "w"}
+        stale_e = {"rule": "R4", "file": "gone.py", "func": "g",
+                   "match": "sync:item", "why": "w"}
+        live, quiet, stale = split_suppressed([f], [exact, stale_e])
+        assert (live, [q.key() for q in quiet]) == ([], [f.key()])
+        assert stale == [stale_e]
+        live, quiet, stale = split_suppressed([f], [wild])
+        assert not live and quiet and not stale
+
+    def test_real_baseline_loads_and_selftests_pass(self):
+        load_baseline()        # the checked-in file obeys its own policy
+        assert engine.run_selftests() == []
+
+
+class TestEngineCli:
+    """End-to-end exit codes against a throwaway repo root."""
+
+    @pytest.fixture
+    def fake_repo(self, tmp_path):
+        pkg = tmp_path / "mmlspark_tpu"
+        pkg.mkdir()
+        (pkg / "mod.py").write_text(
+            "import time\n\ndef stamp():\n    return time.time()\n")
+        empty = tmp_path / "empty.json"
+        empty.write_text("[]")
+        return tmp_path, empty
+
+    def test_unsuppressed_finding_exits_with_rule_code(self, fake_repo,
+                                                       capsys):
+        root, empty = fake_repo
+        rc = engine.main(["--root", str(root), "--baseline", str(empty)])
+        assert rc == engine.RULE_EXIT["R5"] == 15
+        assert "time.time" in capsys.readouterr().out
+
+    def test_baselined_finding_exits_zero(self, fake_repo):
+        root, _ = fake_repo
+        b = root / "base.json"
+        b.write_text(json.dumps([{
+            "rule": "R5", "file": "mmlspark_tpu/mod.py", "func": "stamp",
+            "match": "call:time.time", "why": "test fixture"}]))
+        assert engine.main(["--root", str(root),
+                            "--baseline", str(b)]) == 0
+
+    def test_stale_entry_exits_two(self, fake_repo, capsys):
+        root, _ = fake_repo
+        b = root / "base.json"
+        b.write_text(json.dumps([
+            {"rule": "R5", "file": "mmlspark_tpu/mod.py", "func": "stamp",
+             "match": "call:time.time", "why": "test fixture"},
+            {"rule": "R4", "file": "mmlspark_tpu/gone.py", "func": "g",
+             "match": "sync:item", "why": "rotted"}]))
+        assert engine.main(["--root", str(root),
+                            "--baseline", str(b)]) == 2
+        assert "stale" in capsys.readouterr().out
+
+    def test_rules_scoping_does_not_stale_other_rules(self, fake_repo):
+        # the metric_lint shim runs M rules only: R4–R6 baseline entries
+        # didn't get a chance to match and must NOT count as stale
+        root, _ = fake_repo
+        b = root / "base.json"
+        b.write_text(json.dumps([{
+            "rule": "R5", "file": "mmlspark_tpu/mod.py", "func": "stamp",
+            "match": "call:time.time", "why": "test fixture"}]))
+        assert engine.main(["--root", str(root), "--baseline", str(b),
+                            "--rules", "M1,M2,M3,M4,M5,M6,M7"]) == 0
+
+
+# -- runtime sanitizer ---------------------------------------------------- #
+
+
+@pytest.fixture
+def clean_sanitizer(monkeypatch):
+    monkeypatch.delenv("MMLSPARK_TPU_SANITIZE", raising=False)
+    sanitizer.reset()
+    yield
+    sanitizer.reset()
+
+
+class TestSanitizer:
+    def test_factories_are_plain_when_disabled(self, clean_sanitizer):
+        assert not isinstance(sanitizer.make_lock("x"),
+                              sanitizer.SanitizedLock)
+        assert not isinstance(sanitizer.make_rlock("x"),
+                              sanitizer.SanitizedLock)
+
+    def test_two_lock_cycle_names_locks_threads_and_dumps(
+            self, clean_sanitizer, tmp_path):
+        # recorder is built BEFORE enable() so its own lock stays plain
+        # and the dump path never enters the graph under test
+        rec = FlightRecorder(dump_dir=str(tmp_path), process="sanit",
+                             clock=FakeClock())
+        sanitizer.enable(hard_fail=True, recorder=rec)
+        a = sanitizer.make_lock("jobs")
+        b = sanitizer.make_lock("stats")
+
+        def establish():            # jobs -> stats (the "good" order)
+            with a:
+                with b:
+                    pass
+
+        t1 = threading.Thread(target=establish, name="worker-ab")
+        t1.start()
+        t1.join()
+
+        box: dict = {}
+
+        def invert():               # stats -> jobs closes the cycle
+            try:
+                with b:
+                    with a:
+                        pass
+            except sanitizer.LockOrderError as e:
+                box["err"] = e
+
+        t2 = threading.Thread(target=invert, name="worker-ba")
+        t2.start()
+        t2.join()
+
+        assert isinstance(box.get("err"), sanitizer.LockOrderError)
+        cycles = [v for v in sanitizer.violations()
+                  if v["kind"] == "lock_cycle"]
+        assert len(cycles) == 1
+        assert cycles[0]["locks"] == ["jobs", "stats"]
+        assert sorted(cycles[0]["threads"]) == ["worker-ab", "worker-ba"]
+
+        dumps = sorted(tmp_path.glob("*.jsonl"))
+        assert dumps, "cycle must force a flight-recorder dump"
+        lines = [json.loads(ln)
+                 for ln in dumps[0].read_text().splitlines()]
+        assert lines[0]["trigger"] == "sanitizer.lock_cycle"
+        evs = [ln for ln in lines
+               if ln.get("kind") == "sanitizer.lock_cycle"]
+        assert evs, "dump must contain the violation event"
+        data = evs[0]["data"]
+        assert data["locks"] == ["jobs", "stats"]
+        assert sorted(data["threads"]) == ["worker-ab", "worker-ba"]
+
+    def test_consistent_order_stays_silent(self, clean_sanitizer):
+        sanitizer.enable(hard_fail=True)
+        a = sanitizer.make_lock("outer")
+        b = sanitizer.make_lock("inner")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert sanitizer.violations() == []
+        edges = {(e["src"], e["dst"])
+                 for e in sanitizer.snapshot()["edges"]}
+        assert edges == {("outer", "inner")}
+
+    def test_rlock_reentry_does_not_self_cycle(self, clean_sanitizer):
+        sanitizer.enable(hard_fail=True)
+        lk = sanitizer.make_rlock("re")
+        with lk:
+            with lk:
+                assert sanitizer.held_locks() == ["re"]
+        assert sanitizer.violations() == []
+
+    def test_note_blocking_reports_only_under_lock(self, clean_sanitizer):
+        sanitizer.enable(hard_fail=False)
+        sanitizer.note_blocking("fsync")        # nothing held: free
+        assert sanitizer.violations() == []
+        lk = sanitizer.make_lock("journal")
+        with lk:
+            sanitizer.note_blocking("fsync")
+        (v,) = sanitizer.violations()
+        assert (v["kind"], v["op"], v["locks"]) == (
+            "blocking_under_lock", "fsync", ["journal"])
+
+    def test_blocking_ok_lock_is_exempt_but_stays_in_graph(
+            self, clean_sanitizer):
+        sanitizer.enable(hard_fail=True)
+        coarse = sanitizer.make_lock("batch_mutex", blocking_ok=True)
+        with coarse:
+            sanitizer.note_blocking("fsync")    # waived: coarse by design
+        assert sanitizer.violations() == []
+        fine = sanitizer.make_lock("counters")
+        with coarse:
+            with fine:
+                pass                # edge still recorded for R2-at-runtime
+        assert {(e["src"], e["dst"])
+                for e in sanitizer.snapshot()["edges"]} == {
+                    ("batch_mutex", "counters")}
+
+    def test_allow_blocking_region_is_scoped(self, clean_sanitizer):
+        sanitizer.enable(hard_fail=False)
+        lk = sanitizer.make_lock("wal")
+        with lk:
+            with sanitizer.allow_blocking("compact rewrite"):
+                sanitizer.note_blocking("fsync")
+            assert sanitizer.violations() == []
+            sanitizer.note_blocking("fsync")    # outside: reported again
+        assert len(sanitizer.violations()) == 1
+
+    def test_system_clock_sleep_is_hooked(self, clean_sanitizer):
+        sanitizer.enable(hard_fail=False)
+        lk = sanitizer.make_lock("nap")
+        with lk:
+            SystemClock().sleep(0.001)
+        assert any(v["kind"] == "blocking_under_lock"
+                   and v["op"] == "sleep"
+                   for v in sanitizer.violations())
+
+
+# -- satellite: profile_fn injectable clock ------------------------------- #
+
+
+def test_profile_fn_injectable_clock():
+    from mmlspark_tpu.utils.profiling import profile_fn
+
+    ticks = iter(float(i) for i in range(100))
+    out, stats = profile_fn(lambda: 1, warmup=1, iters=3,
+                            clock=lambda: next(ticks))
+    assert out == 1
+    assert stats["first_call_s"] == 1.0
+    assert stats["iters"] == 3
+    assert stats["steady_s"] == 1.0
+    assert stats["compile_overhead_s"] == 0.0
+
+
+def test_profile_fn_default_clock_is_monotonic():
+    from mmlspark_tpu.utils import profiling
+
+    assert profiling.time.perf_counter is time.perf_counter

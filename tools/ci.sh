@@ -1,6 +1,10 @@
 #!/usr/bin/env bash
 # CI quality gate (the reference's `runme` analogue, L8 tooling):
-#   1. metric-name lint (static: catches bad names on rarely-taken paths)
+#   1. graftlint selftests (each rule catches its seeded violation and
+#      stays quiet on a clean twin) then the full static-analysis gate:
+#      concurrency (R1–R3, unsuppressable), device hazards (R4–R6,
+#      baselined with justification), metric names (M1–M7). Zero
+#      unsuppressed findings and zero stale baseline entries to pass.
 #   2. fleet-observability smoke (2 real replicas scraped + aggregated)
 #      + flight-recorder postmortem smoke (synthetic 3-process incident)
 #      + distributed-streaming smoke (real P=2 partition-parallel query
@@ -12,11 +16,15 @@
 #   3. bench regression gate over the BENCH_*/MULTICHIP_* trajectory
 #   4. pipeline-fusion segment report (fails if an exemplar stops fusing)
 #   5. full test suite on the 8-virtual-device CPU mesh
-#   6. multi-chip dryrun (sharding compiles + replicated-model check)
-#   7. benchmark smoke on CPU (fail-soft backend selection)
+#   6. threaded-subsystem shard re-run under the runtime lock-order
+#      sanitizer (MMLSPARK_TPU_SANITIZE=1 hard-fails on any lock-order
+#      cycle or blocking-under-lock the static pass could not see)
+#   7. multi-chip dryrun (sharding compiles + replicated-model check)
+#   8. benchmark smoke on CPU (fail-soft backend selection)
 set -euo pipefail
 cd "$(dirname "$0")/.."
-python tools/metric_lint.py
+python -m tools.graftlint --selftest
+python -m tools.graftlint
 python tools/diagnose.py --selftest
 python tools/diagnose.py --postmortem --selftest
 python tools/diagnose.py --streaming --selftest
@@ -25,5 +33,8 @@ python tools/diagnose.py --checkpoints --selftest
 python tools/bench_gate.py --selftest
 python tools/fusion_report.py
 python -m pytest tests/ -q
+MMLSPARK_TPU_SANITIZE=1 python -m pytest -q \
+    tests/test_serving.py tests/test_streaming.py tests/test_io_http.py \
+    tests/test_resilience.py tests/test_observability.py
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
 MMLSPARK_TPU_BENCH_FORCE_CPU=1 python bench.py

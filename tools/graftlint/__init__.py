@@ -1,0 +1,9 @@
+"""graftlint: concurrency & device-hazard static analysis.
+
+Run with ``python -m tools.graftlint`` from the repo root. See
+docs/analysis.md for the rule catalog and baseline workflow.
+"""
+
+from .engine import Finding, Rule, main, rules  # noqa: F401
+
+__all__ = ["Finding", "Rule", "main", "rules"]

@@ -1,0 +1,222 @@
+"""graftlint engine: rule registry, baseline suppression, CLI.
+
+A rule produces :class:`Finding`s keyed by ``(rule, file, func, match)``
+— deliberately NOT by line number, so a baseline entry survives
+unrelated edits to the file. The baseline (``baseline.json`` beside
+this package) is a list of those keys plus a mandatory one-line ``why``;
+policy (enforced by review, verbalized in docs/analysis.md): R1–R3
+findings are fixed, never baselined — only R4–R6 and M-rules may carry
+entries, each with a justification.
+
+Exit codes: 0 clean; a single failing rule exits with that rule's own
+code (R1..R6 -> 11..16, M1..M7 -> 21..27); multiple failing rules -> 1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from dataclasses import dataclass, field
+
+ROOT = os.path.normpath(os.path.join(os.path.dirname(__file__), "..", ".."))
+BASELINE_PATH = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+RULE_EXIT = {f"R{i}": 10 + i for i in range(1, 7)}
+RULE_EXIT.update({f"M{i}": 20 + i for i in range(1, 8)})
+
+
+@dataclass
+class Finding:
+    rule: str
+    file: str
+    line: int
+    func: str
+    match: str
+    message: str
+
+    def key(self) -> tuple:
+        return (self.rule, self.file, self.func, self.match)
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "file": self.file, "line": self.line,
+                "func": self.func, "match": self.match,
+                "message": self.message}
+
+
+@dataclass
+class Rule:
+    id: str
+    title: str
+    run: "callable"          # (Index) -> list[Finding]
+    selftest: "callable"     # () -> list[str] problems (empty = pass)
+    doc: str = ""
+
+
+_REGISTRY: "list[Rule]" = []
+
+
+def register(rule: Rule) -> Rule:
+    _REGISTRY.append(rule)
+    return rule
+
+
+def rules() -> "list[Rule]":
+    if not _REGISTRY:
+        # import for side effect: each module registers its rules
+        from . import rules_concurrency  # noqa: F401
+        from . import rules_determinism  # noqa: F401
+        from . import rules_device  # noqa: F401
+        from . import rules_metrics  # noqa: F401
+    return list(_REGISTRY)
+
+
+# -- baseline ------------------------------------------------------------- #
+
+
+def load_baseline(path: str = BASELINE_PATH) -> "list[dict]":
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as fh:
+        entries = json.load(fh)
+    for e in entries:
+        missing = {"rule", "file", "func", "match", "why"} - set(e)
+        if missing:
+            raise SystemExit(
+                f"graftlint: baseline entry {e!r} missing {sorted(missing)}")
+        if not str(e["why"]).strip():
+            raise SystemExit(
+                f"graftlint: baseline entry {e!r} has an empty 'why' — "
+                "every suppression must carry a justification")
+        if e["rule"] in ("R1", "R2", "R3"):
+            raise SystemExit(
+                f"graftlint: baseline entry {e!r} suppresses {e['rule']} — "
+                "concurrency findings are fixed, never baselined")
+    return entries
+
+
+def split_suppressed(findings: "list[Finding]", baseline: "list[dict]"
+                     ) -> "tuple[list[Finding], list[Finding], list[dict]]":
+    """(unsuppressed, suppressed, stale-baseline-entries)."""
+    index = {}
+    for e in baseline:
+        index[(e["rule"], e["file"], e["func"], e["match"])] = e
+    live, quiet, hit = [], [], set()
+    for f in findings:
+        exact = index.get(f.key())
+        wild = index.get((f.rule, f.file, "*", f.match))
+        entry = exact or wild
+        if entry is not None:
+            quiet.append(f)
+            hit.add(id(entry))
+        else:
+            live.append(f)
+    stale = [e for e in baseline if id(e) not in hit]
+    return live, quiet, stale
+
+
+# -- run ------------------------------------------------------------------ #
+
+
+def run_rules(root: str = ROOT, only: "set[str] | None" = None
+              ) -> "list[Finding]":
+    from .astinfo import build_index
+    idx = build_index(root)
+    out: list[Finding] = []
+    for rule in rules():
+        if only and rule.id not in only:
+            continue
+        out.extend(rule.run(idx))
+    return out
+
+
+def run_selftests(only: "set[str] | None" = None) -> "list[str]":
+    problems = []
+    for rule in rules():
+        if only and rule.id not in only:
+            continue
+        try:
+            problems.extend(f"{rule.id}: {p}" for p in rule.selftest())
+        except Exception as exc:  # noqa: BLE001 — a crash IS a failure
+            problems.append(f"{rule.id}: selftest crashed: {exc!r}")
+    return problems
+
+
+def _table(findings: "list[Finding]") -> str:
+    rows = [(f.rule, f"{f.file}:{f.line}", f.func, f.message)
+            for f in findings]
+    widths = [max(len(r[i]) for r in rows) for i in range(3)]
+    return "\n".join(
+        f"  {r[0]:<{widths[0]}}  {r[1]:<{widths[1]}}  "
+        f"{r[2]:<{widths[2]}}  {r[3]}" for r in rows)
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftlint",
+        description="concurrency & device-hazard static analysis")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run each rule against its seeded-violation and "
+                         "clean-twin fixtures")
+    ap.add_argument("--rules", default="",
+                    help="comma-separated rule ids (default: all)")
+    ap.add_argument("--baseline", default=BASELINE_PATH)
+    ap.add_argument("--root", default=ROOT)
+    ap.add_argument("--list", action="store_true",
+                    help="list registered rules and exit")
+    args = ap.parse_args(argv)
+
+    only = {r.strip().upper() for r in args.rules.split(",")
+            if r.strip()} or None
+
+    if args.list:
+        for rule in rules():
+            print(f"{rule.id:<3} exit={RULE_EXIT[rule.id]:<3} {rule.title}")
+        return 0
+
+    if args.selftest:
+        problems = run_selftests(only)
+        if problems:
+            print(f"graftlint --selftest: {len(problems)} failure(s):")
+            for p in problems:
+                print(f"  {p}")
+            return 1
+        n = len([r for r in rules() if not only or r.id in only])
+        print(f"graftlint --selftest: {n} rule(s) OK "
+              "(seeded violations caught, clean twins pass)")
+        return 0
+
+    findings = run_rules(args.root, only)
+    baseline = load_baseline(args.baseline)
+    if only:
+        # staleness is only judged against rules that actually ran
+        baseline = [e for e in baseline if e["rule"] in only]
+    live, quiet, stale = split_suppressed(findings, baseline)
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.as_dict() for f in live],
+            "suppressed": [f.as_dict() for f in quiet],
+            "stale_baseline": stale,
+        }, indent=2, sort_keys=True))
+    elif live:
+        print(f"graftlint: {len(live)} finding(s) "
+              f"({len(quiet)} baselined):")
+        print(_table(live))
+    else:
+        print(f"graftlint: clean ({len(quiet)} baselined finding(s), "
+              f"{len(stale)} stale baseline entrie(s))")
+
+    if stale and not live:
+        # stale entries rot the baseline: fail so they get pruned
+        print("graftlint: stale baseline entries (no longer matched):")
+        for e in stale:
+            print(f"  {e['rule']} {e['file']} {e['func']} {e['match']}")
+        return 2
+
+    if not live:
+        return 0
+    failing = sorted({f.rule for f in live})
+    return RULE_EXIT[failing[0]] if len(failing) == 1 else 1

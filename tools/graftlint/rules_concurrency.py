@@ -1,0 +1,405 @@
+"""R1 guarded-by, R2 lock-order, R3 blocking-under-lock.
+
+Lineage: R1 is a static lockset check in the Eraser family (Savage et
+al. 1997) restricted to WRITES of ``self`` attributes — reads are
+deliberately out of scope (a torn read of a counter is tolerable, a
+lost update is not, and write-side discipline is what this codebase's
+comments promise). R2/R3 are GoodLock-style (Havelund 2000): a static
+lock-acquisition graph whose cycles are potential deadlocks, and a scan
+for calls that can block (sleep, socket I/O, fsync, device sync) while
+any lock is held. All three propagate one call level through
+``self.m()`` (intra-class fixpoint) and ``self.X.m()`` where ``X``'s
+class is known from the constructor.
+
+Policy: findings from these three rules are FIXED, never baselined —
+the engine rejects R1–R3 baseline entries outright.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .astinfo import (Index, call_name, caller_context, index_source,
+                      is_self_attr, thread_reachable,
+                      transitive_acquires, transitive_blocking)
+from .engine import Finding, Rule, register
+
+# -- R1 ------------------------------------------------------------------- #
+
+
+def _r1_run(idx: Index) -> "list[Finding]":
+    out: list[Finding] = []
+    reach = thread_reachable(idx)
+    for mod in idx.modules:
+        for cls in mod.classes.values():
+            if not cls.lock_attrs and not cls.thread_targets \
+                    and id(cls) not in reach:
+                continue
+            creach = reach.get(id(cls), set())
+            if not cls.lock_attrs and not creach:
+                continue
+            init_phase, inherited = caller_context(cls)
+            guarded: dict[str, list] = {}
+            unguarded: dict[str, list] = {}
+            for fname, fi in cls.funcs.items():
+                if fname in init_phase:
+                    continue            # init-phase: no concurrency yet
+                ctx = inherited.get(fname, frozenset())
+                for attr, held, lineno, _how in fi.self_writes():
+                    if attr in cls.lock_attrs:
+                        continue
+                    bucket = guarded if (held or ctx) else unguarded
+                    bucket.setdefault(attr, []).append((fname, lineno))
+            shared_readers = {
+                attr
+                for fname, fi in cls.funcs.items()
+                if fname not in creach and fname not in init_phase
+                for attr in (fi.self_reads()
+                             | {a for a, *_ in fi.self_writes()})}
+            for attr, sites in sorted(unguarded.items()):
+                if attr in guarded:
+                    gf, gl = guarded[attr][0]
+                    for fname, lineno in sites:
+                        out.append(Finding(
+                            "R1", mod.relpath, lineno,
+                            f"{cls.name}.{fname}", f"attr:{attr}",
+                            f"self.{attr} written without a lock here "
+                            f"but under a lock in {cls.name}.{gf} "
+                            f"(line {gl}) — lost-update race"))
+                    continue
+                for fname, lineno in sites:
+                    if fname in creach and attr in shared_readers:
+                        out.append(Finding(
+                            "R1", mod.relpath, lineno,
+                            f"{cls.name}.{fname}", f"attr:{attr}",
+                            f"self.{attr} written from thread-reachable "
+                            f"{cls.name}.{fname} without any lock, and "
+                            "accessed from non-thread methods — data "
+                            "race"))
+    return out
+
+
+_R1_BAD = """
+import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+    def a(self):
+        with self._lock:
+            self.n += 1
+    def b(self):
+        self.n = 5
+"""
+
+_R1_BAD_THREAD = """
+import threading
+class C:
+    def __init__(self):
+        self._result = None
+        self._thread = threading.Thread(target=self._run)
+    def _run(self):
+        self._result = 42
+    def result(self):
+        return self._result
+"""
+
+_R1_CLEAN = """
+import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+    def a(self):
+        with self._lock:
+            self.n += 1
+    def b(self):
+        with self._lock:
+            self.n = 5
+"""
+
+
+# -- R2 ------------------------------------------------------------------- #
+
+
+def _lock_edges(idx: Index) -> "dict[tuple, tuple]":
+    """(src, dst) -> (file, line, func) witness for every ordered pair
+    of lock acquisitions the source can perform."""
+    edges: dict[tuple, tuple] = {}
+
+    def add(src: str, dst: str, where: tuple) -> None:
+        if src != dst:
+            edges.setdefault((src, dst), where)
+
+    for mod, fi in idx.all_funcs():
+        for lid, held, lineno in fi.acquires:
+            for h in held:
+                add(h, lid, (mod.relpath, lineno, fi.qualname))
+
+    for mod in idx.modules:
+        for cls in mod.classes.values():
+            trans = transitive_acquires(cls)
+            for fname, fi in cls.funcs.items():
+                for callee, held, lineno in fi.self_calls():
+                    if not held:
+                        continue
+                    for lid in trans.get(callee, ()):
+                        for h in held:
+                            add(h, lid,
+                                (mod.relpath, lineno, fi.qualname))
+                for attr, meth, held, lineno in fi.attr_calls():
+                    if not held:
+                        continue
+                    tname = cls.attr_types.get(attr)
+                    target = (idx.classes_by_name.get(tname)
+                              if tname else None)
+                    if target is None:
+                        continue
+                    ttrans = transitive_acquires(target)
+                    for lid in ttrans.get(meth, ()):
+                        for h in held:
+                            add(h, lid,
+                                (mod.relpath, lineno, fi.qualname))
+    return edges
+
+
+def _r2_run(idx: Index) -> "list[Finding]":
+    edges = _lock_edges(idx)
+    graph: dict[str, set] = {}
+    for (src, dst) in edges:
+        graph.setdefault(src, set()).add(dst)
+
+    # iterative Tarjan SCC
+    index_of: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set = set()
+    stack: list[str] = []
+    counter = [0]
+    sccs: list[list[str]] = []
+
+    def strongconnect(root: str) -> None:
+        work = [(root, iter(sorted(graph.get(root, ()))))]
+        index_of[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index_of:
+                    index_of[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    work.append((nxt, iter(sorted(graph.get(nxt, ())))))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], index_of[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index_of[node]:
+                comp = []
+                while True:
+                    top = stack.pop()
+                    on_stack.discard(top)
+                    comp.append(top)
+                    if top == node:
+                        break
+                sccs.append(comp)
+
+    for node in sorted(graph):
+        if node not in index_of:
+            strongconnect(node)
+
+    out = []
+    for comp in sccs:
+        if len(comp) < 2:
+            continue
+        comp = sorted(comp)
+        witnesses = sorted(
+            f"{src}->{dst} at {w[0]}:{w[1]} ({w[2]})"
+            for (src, dst), w in edges.items()
+            if src in comp and dst in comp)
+        rel, line = edges[next(
+            (s, d) for (s, d) in edges if s in comp and d in comp)][:2]
+        out.append(Finding(
+            "R2", rel, line, "-", "cycle:" + "|".join(comp),
+            "lock-order cycle (potential deadlock) among "
+            f"{{{', '.join(comp)}}}; witnesses: "
+            + "; ".join(witnesses)))
+    return out
+
+
+_R2_BAD = """
+import threading
+class C:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+    def x(self):
+        with self._a:
+            with self._b:
+                pass
+    def y(self):
+        with self._b:
+            with self._a:
+                pass
+"""
+
+_R2_CLEAN = """
+import threading
+class C:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+    def x(self):
+        with self._a:
+            with self._b:
+                pass
+    def y(self):
+        with self._a:
+            with self._b:
+                pass
+"""
+
+
+# -- R3 ------------------------------------------------------------------- #
+
+# blocking by attribute name (os.fsync, sock.recv, time.sleep,
+# clock.sleep, arr.block_until_ready, conn.getresponse, ...)
+_BLOCKING_ATTRS = {"sleep", "fsync", "block_until_ready", "recv",
+                   "recv_into", "sendall", "sendto", "accept", "connect",
+                   "getresponse", "urlopen", "create_connection",
+                   "serve_forever"}
+_BLOCKING_NAMES = {"urlopen", "http_send", "create_connection"}
+
+
+def _blocking_ops(fi) -> "list[tuple[str, int]]":
+    out = []
+    for node, _held in fi.events:
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        if isinstance(node.func, ast.Attribute):
+            if name in _BLOCKING_ATTRS:
+                out.append((name, node.lineno))
+        elif name in _BLOCKING_NAMES:
+            out.append((name, node.lineno))
+    return out
+
+
+def _r3_run(idx: Index) -> "list[Finding]":
+    out: list[Finding] = []
+    for mod, fi in idx.all_funcs():
+        for node, held in fi.events:
+            if not held or not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            hit = (name in _BLOCKING_ATTRS
+                   if isinstance(node.func, ast.Attribute)
+                   else name in _BLOCKING_NAMES)
+            if hit:
+                out.append(Finding(
+                    "R3", mod.relpath, node.lineno, fi.qualname,
+                    f"op:{name}",
+                    f"blocking call {name}() while holding "
+                    f"{{{', '.join(held)}}}"))
+    # one propagated level: calling a method that blocks, under a lock
+    for mod in idx.modules:
+        for cls in mod.classes.values():
+            trans = transitive_blocking(cls, _blocking_ops)
+            for fname, fi in cls.funcs.items():
+                for callee, held, lineno in fi.self_calls():
+                    ops = trans.get(callee)
+                    if held and ops:
+                        opnames = sorted({o for o, _l in ops})
+                        out.append(Finding(
+                            "R3", mod.relpath, lineno,
+                            f"{cls.name}.{fname}",
+                            f"call:{callee}",
+                            f"calls self.{callee}() which performs "
+                            f"{'/'.join(opnames)} while holding "
+                            f"{{{', '.join(held)}}}"))
+    return out
+
+
+_R3_BAD = """
+import threading, time
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+    def a(self):
+        with self._lock:
+            time.sleep(1)
+"""
+
+_R3_BAD_PROPAGATED = """
+import os, threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._fh = open("x", "a")
+    def _append(self):
+        os.fsync(self._fh.fileno())
+    def record(self):
+        with self._lock:
+            self._append()
+"""
+
+_R3_CLEAN = """
+import threading, time
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+    def a(self):
+        with self._lock:
+            n = 1
+        time.sleep(n)
+"""
+
+
+# -- selftest plumbing ---------------------------------------------------- #
+
+
+def _fixture_selftest(run, bad_sources: "list[str]", clean: str,
+                      relpath: str = "selftest.py"):
+    def selftest() -> "list[str]":
+        problems = []
+        for i, src in enumerate(bad_sources):
+            if not run(index_source(src, relpath)):
+                problems.append(
+                    f"seeded violation #{i} was NOT caught")
+        leaked = run(index_source(clean, relpath))
+        if leaked:
+            problems.append(
+                f"clean twin produced findings: "
+                f"{[f.message for f in leaked]}")
+        return problems
+    return selftest
+
+
+register(Rule(
+    id="R1", title="guarded-by: self-attribute writes with inconsistent "
+    "or missing lock protection",
+    run=_r1_run,
+    selftest=_fixture_selftest(_r1_run, [_R1_BAD, _R1_BAD_THREAD],
+                               _R1_CLEAN)))
+
+register(Rule(
+    id="R2", title="lock-order: cycles in the static lock-acquisition "
+    "graph (potential deadlocks)",
+    run=_r2_run,
+    selftest=_fixture_selftest(_r2_run, [_R2_BAD], _R2_CLEAN)))
+
+register(Rule(
+    id="R3", title="blocking-under-lock: sleep/socket/fsync/device-sync "
+    "while holding a lock",
+    run=_r3_run,
+    selftest=_fixture_selftest(_r3_run, [_R3_BAD, _R3_BAD_PROPAGATED],
+                               _R3_CLEAN)))

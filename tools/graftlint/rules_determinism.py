@@ -1,0 +1,87 @@
+"""R5 determinism: wall-clock and ambient randomness in replayed paths.
+
+The replay guarantees this repo sells (resume-to-identical-digest,
+exactly-once streaming, byte-identical batch re-forms) all assume a
+re-run computes the same bytes. ``time.time()``, ``datetime.now()`` and
+the ambient ``random`` module are the classic leaks: invisible inputs
+that differ across runs. The sanctioned escapes are the injectable
+``Clock`` (``resilience/policy.py`` — its SystemClock is the ONLY
+module allowed to touch the wall clock) and explicit jax PRNG keys;
+``time.monotonic``/``perf_counter`` are allowed everywhere because they
+feed telemetry, not data. Remaining wall-clock sites (provenance
+timestamps that are metadata, never folded into state) live in the
+baseline, each with its justification.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .astinfo import Index, index_source
+from .engine import Finding, Rule, register
+
+# modules whose JOB is the wall clock / process randomness
+_EXEMPT = ("resilience/policy.py",)
+
+# receiver-name -> forbidden attrs; `time.time` not `t.time`
+_FORBIDDEN = {
+    "time": {"time", "time_ns"},
+    "datetime": {"now", "utcnow", "today"},
+    "date": {"today"},
+    "random": {"random", "randint", "randrange", "uniform", "choice",
+               "choices", "shuffle", "sample", "gauss", "seed",
+               "getrandbits"},
+}
+
+
+def _r5_run(idx: Index) -> "list[Finding]":
+    out: list[Finding] = []
+    for mod, fi in idx.all_funcs():
+        if mod.relpath.replace("\\", "/").endswith(_EXEMPT):
+            continue
+        for node, _held in fi.events:
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)):
+                continue
+            recv, attr = node.func.value.id, node.func.attr
+            if attr in _FORBIDDEN.get(recv, ()):
+                out.append(Finding(
+                    "R5", mod.relpath, node.lineno, fi.qualname,
+                    f"call:{recv}.{attr}",
+                    f"{recv}.{attr}() is an ambient nondeterministic "
+                    f"input in {fi.qualname} — inject a Clock "
+                    "(resilience.policy) or a jax PRNG key, or justify "
+                    "in the baseline"))
+    return out
+
+
+_R5_BAD = """
+import time
+def fold(state, row):
+    return state + [time.time()]
+"""
+
+_R5_CLEAN = """
+import time
+def fold(state, row, clock):
+    t0 = time.perf_counter()
+    return state + [clock.monotonic()], time.perf_counter() - t0
+"""
+
+
+def _selftest() -> "list[str]":
+    problems = []
+    if not _r5_run(index_source(_R5_BAD)):
+        problems.append("seeded violation was NOT caught")
+    leaked = _r5_run(index_source(_R5_CLEAN))
+    if leaked:
+        problems.append(f"clean twin produced findings: "
+                        f"{[f.message for f in leaked]}")
+    return problems
+
+
+register(Rule(
+    id="R5", title="determinism: time.time/datetime.now/ambient random "
+    "in paths that must replay byte-identically",
+    run=_r5_run, selftest=_selftest))

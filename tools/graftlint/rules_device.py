@@ -1,0 +1,215 @@
+"""R4 host-sync-in-hot-path, R6 recompile-hazard.
+
+Both rules flag *costs the type system can't see*: a ``.item()`` on a
+device array stalls the dispatch pipeline for a full device round-trip;
+a ``jax.jit`` wrapper constructed per call throws away XLA's executable
+cache and re-traces every time. Findings here are triaged — a site that
+is deliberate (a terminal readback, a builder invoked once per model)
+goes in the baseline WITH a one-line justification; the rule exists so
+every new site forces that conversation.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .astinfo import Index, index_source, is_self_attr
+from .engine import Finding, Rule, register
+
+# -- R4 ------------------------------------------------------------------- #
+
+# a function (or its class/module) is "hot" when its name advertises the
+# fused/per-request path — the paths whose latency budget is microseconds
+_HOT_MARKERS = ("fused", "hot", "kernel", "resident", "score")
+
+_SYNC_ATTRS = {"item", "tolist", "block_until_ready"}
+_SYNC_NP = {"asarray", "array"}
+
+
+def _is_hot(qualname: str, relpath: str) -> bool:
+    hay = f"{relpath}:{qualname}".lower()
+    return any(m in hay for m in _HOT_MARKERS)
+
+
+def _r4_run(idx: Index) -> "list[Finding]":
+    out: list[Finding] = []
+    for mod, fi in idx.all_funcs():
+        if not _is_hot(fi.qualname, mod.relpath):
+            continue
+        for node, _held in fi.events:
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            op = None
+            if isinstance(f, ast.Attribute):
+                if f.attr in _SYNC_ATTRS:
+                    op = f.attr
+                elif (f.attr in _SYNC_NP
+                      and isinstance(f.value, ast.Name)
+                      and f.value.id == "np"):
+                    op = f"np.{f.attr}"
+            elif (isinstance(f, ast.Name) and f.id == "float"
+                  and node.args
+                  and isinstance(node.args[0], (ast.Call, ast.Subscript))):
+                op = "float"
+            if op is not None:
+                out.append(Finding(
+                    "R4", mod.relpath, node.lineno, fi.qualname,
+                    f"sync:{op}",
+                    f"{op}() forces a host-device sync inside hot-path "
+                    f"function {fi.qualname} — hide it behind the "
+                    "async-readback path or justify in the baseline"))
+    return out
+
+
+_R4_BAD = """
+def hot_path_score(x):
+    return x.item()
+"""
+
+_R4_CLEAN = """
+def summarize(x):
+    return x.item()
+
+def hot_path_score(x):
+    return x + 1
+"""
+
+
+# -- R6 ------------------------------------------------------------------- #
+
+_CACHE_DECOS = {"lru_cache", "cache", "cached_property"}
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+    f = node.func
+    if isinstance(f, ast.Name) and f.id == "jit":
+        return True
+    return (isinstance(f, ast.Attribute) and f.attr == "jit"
+            and isinstance(f.value, ast.Name) and f.value.id == "jax")
+
+
+def _deco_name(deco: ast.AST) -> "str | None":
+    if isinstance(deco, ast.Call):
+        deco = deco.func
+    if isinstance(deco, ast.Attribute):
+        return deco.attr
+    if isinstance(deco, ast.Name):
+        return deco.id
+    return None
+
+
+def _names_cache(node: ast.AST) -> bool:
+    """True when an assignment target routes the value into something
+    whose name admits it is a cache (``cache[key]``, ``self._jit_cache``)."""
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute):
+        return "cache" in node.attr.lower()
+    if isinstance(node, ast.Name):
+        return "cache" in node.id.lower()
+    return False
+
+
+def _r6_run(idx: Index) -> "list[Finding]":
+    out: list[Finding] = []
+    for mod in idx.modules:
+        parents: dict = {}
+        for parent in ast.walk(mod.tree):
+            for child in ast.iter_child_nodes(parent):
+                parents[child] = parent
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call) and _is_jit_call(node)):
+                continue
+            chain = []
+            cur = node
+            while cur in parents:
+                cur = parents[cur]
+                chain.append(cur)
+            funcs = [c for c in chain
+                     if isinstance(c, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))]
+            if not funcs:
+                continue                # module-level: XLA caches by id
+            enclosing = funcs[0]
+            qual = ".".join([c.name for c in reversed(chain)
+                             if isinstance(c, (ast.FunctionDef,
+                                               ast.AsyncFunctionDef,
+                                               ast.ClassDef))])
+            parent = parents[node]
+            if isinstance(parent, ast.Call) and parent.func is node:
+                out.append(Finding(
+                    "R6", mod.relpath, node.lineno, qual, "jit-immediate",
+                    "jax.jit(...)(...) builds a fresh jit wrapper per "
+                    "call — every invocation re-traces; hoist the "
+                    "wrapper or route through ExecutableCache"))
+                continue
+            if enclosing.name == "__init__":
+                continue                # one wrapper per object lifetime
+            if any(_deco_name(d) in _CACHE_DECOS
+                   for d in enclosing.decorator_list):
+                continue
+            cls_chain = [c for c in chain if isinstance(c, ast.ClassDef)]
+            if cls_chain and "cache" in cls_chain[0].name.lower():
+                continue
+            if isinstance(parent, ast.Assign) and any(
+                    _names_cache(t) or is_self_attr(t)
+                    for t in parent.targets):
+                continue
+            if (isinstance(parent, ast.Call)
+                    and isinstance(parent.func, ast.Attribute)
+                    and parent.func.attr == "get_or_build"):
+                continue
+            out.append(Finding(
+                "R6", mod.relpath, node.lineno, qual, "jit-in-function",
+                f"jax.jit constructed inside {qual} with no visible "
+                "cache (ExecutableCache / lru_cache / cache-dict "
+                "assignment) — recompiles unless every caller memoizes "
+                "the result"))
+    return out
+
+
+_R6_BAD = """
+import jax
+def f(x):
+    return jax.jit(lambda y: y + 1)(x)
+"""
+
+_R6_CLEAN = """
+import functools
+import jax
+
+def _fwd(y):
+    return y + 1
+
+g = jax.jit(_fwd)
+
+@functools.lru_cache(maxsize=8)
+def build(n):
+    return jax.jit(_fwd)
+"""
+
+
+def _fixture_selftest(run, bad: str, clean: str):
+    def selftest() -> "list[str]":
+        problems = []
+        if not run(index_source(bad)):
+            problems.append("seeded violation was NOT caught")
+        leaked = run(index_source(clean))
+        if leaked:
+            problems.append(
+                f"clean twin produced findings: "
+                f"{[f.message for f in leaked]}")
+        return problems
+    return selftest
+
+
+register(Rule(
+    id="R4", title="host-sync-in-hot-path: .item()/np.asarray/"
+    "block_until_ready inside fused/hot-path/kernel functions",
+    run=_r4_run, selftest=_fixture_selftest(_r4_run, _R4_BAD, _R4_CLEAN)))
+
+register(Rule(
+    id="R6", title="recompile-hazard: per-call jax.jit wrappers not "
+    "routed through a cache",
+    run=_r6_run, selftest=_fixture_selftest(_r6_run, _R6_BAD, _R6_CLEAN)))

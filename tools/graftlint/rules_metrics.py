@@ -1,0 +1,339 @@
+"""M1–M7: the metric-hygiene rules, folded in from tools/metric_lint.py.
+
+Same checks, same semantics, one runner: M1 charset, M2 unit suffix,
+M3 cross-replica merge policy resolvable, M4 ratio gauges need an
+explicit policy, M5 control-plane (gateway/autoscaler) gauges need an
+explicit policy, M6 OpenMetrics exemplar syntax + fleet round-trip over
+a LIVE exposition, M7 profiler phase vocabulary (static manifest + live
+ledger). ``tools/metric_lint.py`` remains as a shim that runs exactly
+these rules.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+from .engine import ROOT, Finding, Rule, register
+
+NAME_RE = re.compile(r"^mmlspark_tpu_[a-z0-9_]+$")
+UNIT_SUFFIXES = ("_total", "_seconds", "_bytes", "_ratio", "_depth",
+                 "_count", "_rate")
+LITERAL_RE = re.compile(
+    r"""[fF]?("mmlspark_tpu_[^"\n]*"|'mmlspark_tpu_[^'\n]*')""")
+PLACEHOLDER_RE = re.compile(r"\{[^{}]*\}")
+_HISTOGRAM_SAMPLE_RE = re.compile(r"_seconds(_bucket|_sum|_count)$")
+
+
+def _fleet():
+    sys.path.insert(0, ROOT)
+    try:
+        from mmlspark_tpu.observability import fleet
+    finally:
+        sys.path.pop(0)
+    return fleet
+
+
+def _merge_policy_for(name: str) -> "str | None":
+    kind = "counter" if name.endswith("_total") else "gauge"
+    return _fleet().merge_policy_for(name, kind)
+
+
+def _explicit_policy(name: str) -> "str | None":
+    return _fleet().GAUGE_MERGE_POLICIES.get(name)
+
+
+def _iter_literals(text: str):
+    for lineno, line in enumerate(text.splitlines(), 1):
+        for match in LITERAL_RE.finditer(line):
+            yield lineno, PLACEHOLDER_RE.sub("x", match.group(1)[1:-1])
+
+
+def check_literal(name: str, resolver=None, explicit=None
+                  ) -> "tuple[str, str] | None":
+    """(rule-id, message) for the FIRST failed check of one metric-name
+    literal, or None. `resolver`/`explicit` are injectable for
+    selftests (default: the live fleet tables)."""
+    resolver = resolver or _merge_policy_for
+    explicit = explicit or _explicit_policy
+    if not NAME_RE.match(name):
+        return ("M1", f"{name!r} violates ^mmlspark_tpu_[a-z0-9_]+$")
+    if not name.endswith(UNIT_SUFFIXES):
+        return ("M2", f"{name!r} lacks a unit suffix "
+                f"({', '.join(UNIT_SUFFIXES)})")
+    base = _HISTOGRAM_SAMPLE_RE.sub("_seconds", name)
+    if resolver(base) is None:
+        return ("M3", f"{name!r} has no cross-replica merge policy (add "
+                "it to observability.fleet.GAUGE_MERGE_POLICIES or use "
+                "a suffix with a default)")
+    if name.endswith("_ratio") and explicit(name) is None:
+        return ("M4", f"ratio gauge {name!r} relies on the suffix-"
+                "default merge policy — declare max/min intent "
+                "explicitly in observability.fleet.GAUGE_MERGE_POLICIES")
+    if (name.startswith(("mmlspark_tpu_gateway_",
+                         "mmlspark_tpu_autoscaler_"))
+            and not name.endswith("_total")
+            and not base.endswith("_seconds")
+            and explicit(name) is None):
+        return ("M5", f"control-plane gauge {name!r} relies on a per-"
+                "replica suffix default — gateway/autoscaler series are "
+                "driver singletons; add an explicit observability."
+                "fleet.GAUGE_MERGE_POLICIES entry")
+    return None
+
+
+def _literal_rule_run(rule_id: str):
+    def run(idx) -> "list[Finding]":
+        out = []
+        for mod in idx.modules:
+            try:
+                with open(mod.path, encoding="utf-8") as fh:
+                    text = fh.read()
+            except OSError:
+                continue
+            for lineno, name in _iter_literals(text):
+                hit = check_literal(name)
+                if hit and hit[0] == rule_id:
+                    out.append(Finding(rule_id, mod.relpath, lineno,
+                                       "-", f"name:{name}", hit[1]))
+        return out
+    return run
+
+
+def _literal_selftest(rule_id: str, bad_name: str, clean_name: str,
+                      resolver=None, explicit=None):
+    def selftest() -> "list[str]":
+        problems = []
+        hit = check_literal(bad_name, resolver, explicit)
+        if hit is None or hit[0] != rule_id:
+            problems.append(
+                f"seeded bad name {bad_name!r} not flagged as {rule_id} "
+                f"(got {hit!r})")
+        leak = check_literal(clean_name, resolver, explicit)
+        if leak is not None and leak[0] == rule_id:
+            problems.append(f"clean name {clean_name!r} flagged: {leak}")
+        return problems
+    return selftest
+
+
+# -- M6: OpenMetrics exemplar syntax (live exposition) -------------------- #
+
+_EXEMPLAR_LINE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)(?P<labels>\{[^}]*\})? "
+    r"(?P<value>\S+) # \{(?P<ex>[^}]*)\} (?P<ex_value>\S+)$")
+_EX_PAIR_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def lint_exposition(text: str, where: str = "exposition") -> "list[str]":
+    """M6 over one rendered exposition: exemplar syntax, the 128-char
+    label-set cap, the `# EOF` terminator, and a byte-identical fleet
+    parse -> render round trip."""
+    fleet = _fleet()
+    sys.path.insert(0, ROOT)
+    try:
+        from mmlspark_tpu.observability.metrics import \
+            EXEMPLAR_LABEL_SET_MAX
+    finally:
+        sys.path.pop(0)
+    problems = []
+    lines = text.splitlines()
+    any_exemplar = False
+    for lineno, line in enumerate(lines, 1):
+        if " # " not in line or line.startswith("#"):
+            continue
+        any_exemplar = True
+        m = _EXEMPLAR_LINE_RE.match(line)
+        if m is None:
+            problems.append(
+                f"{where}:{lineno}: malformed exemplar line {line!r}")
+            continue
+        if "_bucket" not in m.group("name"):
+            problems.append(
+                f"{where}:{lineno}: exemplar on non-bucket sample "
+                f"{m.group('name')!r}")
+        pairs = _EX_PAIR_RE.findall(m.group("ex"))
+        total = sum(len(n) + len(v) for n, v in pairs)
+        if total > EXEMPLAR_LABEL_SET_MAX:
+            problems.append(
+                f"{where}:{lineno}: exemplar label set is {total} chars "
+                f"(cap {EXEMPLAR_LABEL_SET_MAX})")
+        try:
+            float(m.group("ex_value"))
+        except ValueError:
+            problems.append(
+                f"{where}:{lineno}: exemplar value "
+                f"{m.group('ex_value')!r} is not a number")
+    if any_exemplar and (not lines or lines[-1].strip() != "# EOF"):
+        problems.append(
+            f"{where}: exemplars present but no `# EOF` terminator")
+    rendered = fleet.render_families(fleet.parse_prometheus(text))
+    if rendered.rstrip("\n") != text.rstrip("\n"):
+        problems.append(
+            f"{where}: fleet parse -> render round trip is not "
+            "byte-identical")
+    return problems
+
+
+def lint_exemplars() -> "list[str]":
+    """Render a live exemplar-enabled exposition (and its fleet-merged
+    re-render) and run M6 over both."""
+    fleet = _fleet()
+    sys.path.insert(0, ROOT)
+    try:
+        from mmlspark_tpu.observability.metrics import MetricsRegistry
+    finally:
+        sys.path.pop(0)
+    reg = MetricsRegistry()
+    h = reg.histogram("mmlspark_tpu_serving_latency_seconds", "latency",
+                      labels=("server",), exemplars=True)
+    h.labels(server="srv0").observe(
+        0.004, exemplar={"trace_id": "ab" * 16, "route": "resident",
+                         "bucket": "8"})
+    h.labels(server="srv0").observe(
+        2.5, exemplar={"trace_id": "cd" * 16, "route": "host"})
+    text = reg.render_prometheus()
+    problems = lint_exposition(text, where="registry render")
+    merged = fleet.render_families(fleet.parse_prometheus(text))
+    problems.extend(lint_exposition(merged, where="fleet re-render"))
+    return problems
+
+
+def _m6_run(idx) -> "list[Finding]":
+    return [Finding("M6", "mmlspark_tpu/observability/metrics.py", 0,
+                    "-", "exemplar-exposition", p)
+            for p in lint_exemplars()]
+
+
+def _m6_selftest() -> "list[str]":
+    problems = []
+    seeded = ("mmlspark_tpu_x_seconds_bucket{le=\"1.0\"} 1 "
+              "# {trace_id=\"t\"} notanumber")
+    if not lint_exposition(seeded, where="seeded"):
+        problems.append("seeded malformed exemplar was NOT caught")
+    live = lint_exemplars()
+    if live:
+        problems.append(f"live exposition failed M6: {live}")
+    return problems
+
+
+# -- M7: profiler phase vocabulary ---------------------------------------- #
+
+
+def lint_profiler_phases(series: "dict | None" = None) -> "list[str]":
+    """M7: every ``*_seconds`` profiler histogram declares the ``phase``
+    label, and a live ledger only emits phase values from the fixed
+    PHASES vocabulary. Pass `series` to check a manifest statically
+    (selftest); None runs the full live exercise."""
+    sys.path.insert(0, ROOT)
+    try:
+        from mmlspark_tpu.observability.metrics import MetricsRegistry
+        from mmlspark_tpu.observability.profiler import (PHASE_LABEL,
+                                                         PHASES,
+                                                         PROFILER_SERIES,
+                                                         Profiler)
+    finally:
+        sys.path.pop(0)
+    problems = []
+    manifest = PROFILER_SERIES if series is None else series
+    for name, (kind, labelnames) in sorted(manifest.items()):
+        if name.endswith("_seconds") and kind == "histogram" \
+                and PHASE_LABEL not in labelnames:
+            problems.append(
+                f"profiler series {name!r} is a timing histogram without "
+                f"a {PHASE_LABEL!r} label — attribution cannot group it "
+                "by phase")
+    if series is not None:
+        return problems
+    reg = MetricsRegistry()
+    prof = Profiler(registry=reg, enabled=True)
+    led = prof.ledger("lint", "seg0")
+    for ph in PHASES:
+        led.add(ph, 0.001)
+    led.note_pad(6, 8)
+    led.note_shard("TPU_0", 0.002, rows=6)
+    led.done(rtt_s=0.01)
+    prof.flush()  # commits drain on a background thread
+    try:
+        led.add("not_a_phase", 0.001)
+    except ValueError:
+        pass
+    else:
+        problems.append(
+            "PhaseLedger.add accepted a phase outside PHASES — the "
+            "vocabulary is not enforced at the recording site")
+    vocab = set(PHASES)
+    seen_phases = 0
+    for name, fam in reg.snapshot().items():
+        for sample in fam.get("samples", []):
+            phase = (sample.get("labels") or {}).get(PHASE_LABEL)
+            if phase is None:
+                continue
+            seen_phases += 1
+            if phase not in vocab:
+                problems.append(
+                    f"live profiler emitted phase label {phase!r} on "
+                    f"{name!r} — outside the fixed vocabulary "
+                    f"{'|'.join(PHASES)}")
+    if not seen_phases:
+        problems.append(
+            "live profiler ledger committed no phase-labeled samples — "
+            "the M7 dynamic check is vacuous")
+    return problems
+
+
+def _m7_run(idx) -> "list[Finding]":
+    return [Finding("M7", "mmlspark_tpu/observability/profiler.py", 0,
+                    "-", "phase-vocabulary", p)
+            for p in lint_profiler_phases()]
+
+
+def _m7_selftest() -> "list[str]":
+    problems = []
+    seeded = {"mmlspark_tpu_x_seconds": ("histogram", ("segment",))}
+    if not lint_profiler_phases(series=seeded):
+        problems.append("seeded phase-less histogram was NOT caught")
+    live = lint_profiler_phases()
+    if live:
+        problems.append(f"live profiler exercise failed M7: {live}")
+    return problems
+
+
+register(Rule(
+    id="M1", title="metric-name charset (^mmlspark_tpu_[a-z0-9_]+$)",
+    run=_literal_rule_run("M1"),
+    selftest=_literal_selftest("M1", "mmlspark_tpu_Bad-Name",
+                               "mmlspark_tpu_rows_total")))
+register(Rule(
+    id="M2", title="metric-name unit suffix (Prometheus base units)",
+    run=_literal_rule_run("M2"),
+    selftest=_literal_selftest("M2", "mmlspark_tpu_rows",
+                               "mmlspark_tpu_rows_total")))
+register(Rule(
+    id="M3", title="cross-replica merge policy resolvable for every "
+    "family",
+    run=_literal_rule_run("M3"),
+    selftest=_literal_selftest(
+        "M3", "mmlspark_tpu_rows_count", "mmlspark_tpu_rows_total",
+        resolver=lambda name: ("sum" if name.endswith("_total")
+                               else None))))
+register(Rule(
+    id="M4", title="_ratio gauges need an explicit merge policy",
+    run=_literal_rule_run("M4"),
+    selftest=_literal_selftest(
+        "M4", "mmlspark_tpu_zzz_selftest_ratio",
+        "mmlspark_tpu_dataplane_pad_waste_ratio")))
+register(Rule(
+    id="M5", title="gateway/autoscaler gauges need an explicit merge "
+    "policy",
+    run=_literal_rule_run("M5"),
+    selftest=_literal_selftest(
+        "M5", "mmlspark_tpu_gateway_zzz_selftest_depth",
+        "mmlspark_tpu_gateway_zzz_selftest_total")))
+register(Rule(
+    id="M6", title="OpenMetrics exemplar syntax + fleet round-trip "
+    "(live exposition)",
+    run=_m6_run, selftest=_m6_selftest))
+register(Rule(
+    id="M7", title="profiler phase vocabulary (manifest + live ledger)",
+    run=_m7_run, selftest=_m7_selftest))

@@ -1,331 +1,34 @@
 #!/usr/bin/env python
-"""Static lint for metric-name literals.
+"""Static lint for metric-name literals — now a shim over graftlint.
 
-The registry already rejects malformed names at runtime
-(observability/metrics.py METRIC_NAME_RE), but a metric on a rarely-taken
-path — a breaker transition, a retry-budget exhaustion — may never be
-constructed in CI, so a bad name would ship and only explode in
-production. This walks every Python source under mmlspark_tpu/ plus
-bench.py, extracts every string literal starting with ``mmlspark_tpu_``
-(f-strings included: ``{...}`` placeholders are stripped before
-validation, so ``f"mmlspark_tpu_executable_cache_{key}_total"`` checks
-the static skeleton), and enforces:
+The seven rules that lived here (charset, unit suffix, merge-policy
+resolution, explicit ``_ratio`` policies, explicit control-plane gauge
+policies, OpenMetrics exemplar syntax, profiler phase vocabulary) moved
+into the graftlint registry as rules M1–M7
+(``tools/graftlint/rules_metrics.py``), where they run alongside the
+concurrency (R1–R3) and device-hazard (R4–R6) rules under one runner,
+one baseline file, and per-rule exit codes.
 
-  1. charset: ``^mmlspark_tpu_[a-z0-9_]+$`` — the registry's rule.
-  2. unit suffix: the name must end in one of UNIT_SUFFIXES, the
-     Prometheus base-unit convention (counters ``_total``, timings
-     ``_seconds``, sizes ``_bytes``, plus the dimensionless ``_ratio`` /
-     ``_depth`` / ``_count`` / ``_rate`` gauges this codebase uses).
-  3. merge policy: every family name must resolve to a cross-replica
-     merge policy via ``observability.fleet.merge_policy_for`` — a gauge
-     that neither appears in GAUGE_MERGE_POLICIES nor matches a suffix
-     default would silently aggregate wrong in the fleet ``/metrics``.
-  4. ``_ratio`` gauges need an EXPLICIT GAUGE_MERGE_POLICIES entry, not
-     just the suffix fallback: ratios split between worst-case signals
-     (fusion ratio, shard skew → max) and best-case budgets (SLO budget
-     remaining → min), so the author must state which one — the suffix
-     default silently picking max is exactly the aggregation bug this
-     lint exists to stop.
-  5. ``gateway_*`` / ``autoscaler_*`` gauges need an EXPLICIT entry too:
-     those series come from the DRIVER-SIDE control plane (one routing
-     gateway, one autoscaler), not from replicas, so per-replica suffix
-     defaults (``_count`` → sum) would multiply them by the number of
-     scrape sources. Counters and ``_seconds`` histogram families are
-     exempt — both genuinely sum.
-  6. OpenMetrics exemplar syntax (checked against a LIVE exposition the
-     lint renders from an exemplar-enabled registry, then again after a
-     fleet merge): every exemplar rides a ``_bucket`` sample as
-     ``# {labels} value``, its combined label-set length stays within
-     ``EXEMPLAR_LABEL_SET_MAX`` (the OpenMetrics 128-char cap), the
-     exposition ends with the ``# EOF`` terminator whenever exemplars
-     are present, and ``fleet.parse_prometheus`` →
-     ``fleet.render_families`` round-trips the text byte-identically —
-     a renderer drift here would corrupt exemplars at the aggregator.
-  7. profiler phase vocabulary: every ``*_seconds`` histogram the
-     profiler publishes (``observability.profiler.PROFILER_SERIES``)
-     must carry a ``phase`` label, and a live Profiler driven through a
-     full ledger must only ever emit phase label VALUES from the fixed
-     vocabulary ``observability.profiler.PHASES`` — a free-form phase
-     string would mint an unbounded label set and split the attribution
-     table across misspellings.
-
-Usage: python tools/metric_lint.py    # exit 1 with a report if any fail
+This entry point is kept so ``python tools/metric_lint.py`` (muscle
+memory, older docs, external CI configs) still works: it runs exactly
+the M rules and exits non-zero on any finding — the same contract as
+before. Prefer ``python -m tools.graftlint`` for the full gate and
+``python -m tools.graftlint --rules M1,M2`` for rule selection. See
+docs/analysis.md for the rule catalog.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 
-ROOT = os.path.normpath(os.path.join(os.path.dirname(__file__), ".."))
-SCAN = [os.path.join(ROOT, "mmlspark_tpu"), os.path.join(ROOT, "bench.py")]
+# run as a script from anywhere: put the repo root on sys.path so the
+# tools package (and mmlspark_tpu next to it) resolve
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-NAME_RE = re.compile(r"^mmlspark_tpu_[a-z0-9_]+$")
-UNIT_SUFFIXES = ("_total", "_seconds", "_bytes", "_ratio", "_depth",
-                 "_count", "_rate")
-# any single- or double-quoted literal (optionally an f-string) whose
-# contents begin with the namespace prefix
-LITERAL_RE = re.compile(
-    r"""[fF]?("mmlspark_tpu_[^"\n]*"|'mmlspark_tpu_[^'\n]*')""")
-PLACEHOLDER_RE = re.compile(r"\{[^{}]*\}")
-
-# histogram sample suffixes: `X_bucket`/`X_sum`/`X_count` literals refer
-# to samples of family X, whose policy is checked under its own name
-_HISTOGRAM_SAMPLE_RE = re.compile(r"_seconds(_bucket|_sum|_count)$")
-
-
-def _merge_policy_for(name: str) -> "str | None":
-    sys.path.insert(0, ROOT)
-    try:
-        from mmlspark_tpu.observability.fleet import merge_policy_for
-    finally:
-        sys.path.pop(0)
-    # counters are always summable; everything else goes through the
-    # gauge resolution path (histogram families end in _seconds → "last"
-    # would be wrong, but histograms are identified by kind at merge
-    # time and always sum — the lint only needs SOME policy to resolve)
-    kind = "counter" if name.endswith("_total") else "gauge"
-    return merge_policy_for(name, kind)
-
-
-def _explicit_policy(name: str) -> "str | None":
-    sys.path.insert(0, ROOT)
-    try:
-        from mmlspark_tpu.observability.fleet import GAUGE_MERGE_POLICIES
-    finally:
-        sys.path.pop(0)
-    return GAUGE_MERGE_POLICIES.get(name)
-
-
-def iter_sources() -> list[str]:
-    paths = []
-    for entry in SCAN:
-        if os.path.isfile(entry):
-            paths.append(entry)
-            continue
-        for root, _dirs, names in os.walk(entry):
-            paths.extend(os.path.join(root, n) for n in names
-                         if n.endswith(".py"))
-    return sorted(paths)
-
-
-def lint_file(path: str) -> list[str]:
-    problems = []
-    with open(path) as fh:
-        for lineno, line in enumerate(fh, 1):
-            for match in LITERAL_RE.finditer(line):
-                name = PLACEHOLDER_RE.sub("x", match.group(1)[1:-1])
-                where = f"{os.path.relpath(path, ROOT)}:{lineno}"
-                if not NAME_RE.match(name):
-                    problems.append(
-                        f"{where}: {name!r} violates "
-                        "^mmlspark_tpu_[a-z0-9_]+$")
-                    continue
-                if not name.endswith(UNIT_SUFFIXES):
-                    problems.append(
-                        f"{where}: {name!r} lacks a unit suffix "
-                        f"({', '.join(UNIT_SUFFIXES)})")
-                    continue
-                base = _HISTOGRAM_SAMPLE_RE.sub("_seconds", name)
-                if _merge_policy_for(base) is None:
-                    problems.append(
-                        f"{where}: {name!r} has no cross-replica merge "
-                        "policy (add it to observability.fleet."
-                        "GAUGE_MERGE_POLICIES or use a suffix with a "
-                        "default)")
-                    continue
-                if (name.endswith("_ratio")
-                        and _explicit_policy(name) is None):
-                    problems.append(
-                        f"{where}: ratio gauge {name!r} relies on the "
-                        "suffix-default merge policy — declare max/min "
-                        "intent explicitly in observability.fleet."
-                        "GAUGE_MERGE_POLICIES")
-                    continue
-                if (name.startswith(("mmlspark_tpu_gateway_",
-                                     "mmlspark_tpu_autoscaler_"))
-                        and not name.endswith("_total")
-                        and not base.endswith("_seconds")
-                        and _explicit_policy(name) is None):
-                    problems.append(
-                        f"{where}: control-plane gauge {name!r} relies "
-                        "on a per-replica suffix default — gateway/"
-                        "autoscaler series are driver singletons; add "
-                        "an explicit observability.fleet."
-                        "GAUGE_MERGE_POLICIES entry")
-    return problems
-
-
-# -- rule 6: OpenMetrics exemplar syntax -------------------------------- #
-
-# `name{labels} value # {exemplar-labels} exemplar-value`
-_EXEMPLAR_LINE_RE = re.compile(
-    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)(?P<labels>\{[^}]*\})? "
-    r"(?P<value>\S+) # \{(?P<ex>[^}]*)\} (?P<ex_value>\S+)$")
-_EX_PAIR_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
-
-
-def lint_exposition(text: str, where: str = "exposition") -> list[str]:
-    """Rule 6 over one rendered exposition: exemplar syntax, the
-    128-char label-set cap, the `# EOF` terminator, and a byte-identical
-    fleet parse -> render round trip."""
-    sys.path.insert(0, ROOT)
-    try:
-        from mmlspark_tpu.observability.fleet import (parse_prometheus,
-                                                      render_families)
-        from mmlspark_tpu.observability.metrics import \
-            EXEMPLAR_LABEL_SET_MAX
-    finally:
-        sys.path.pop(0)
-    problems = []
-    lines = text.splitlines()
-    any_exemplar = False
-    for lineno, line in enumerate(lines, 1):
-        if " # " not in line or line.startswith("#"):
-            continue
-        any_exemplar = True
-        m = _EXEMPLAR_LINE_RE.match(line)
-        if m is None:
-            problems.append(
-                f"{where}:{lineno}: malformed exemplar line {line!r}")
-            continue
-        if "_bucket" not in m.group("name"):
-            problems.append(
-                f"{where}:{lineno}: exemplar on non-bucket sample "
-                f"{m.group('name')!r}")
-        pairs = _EX_PAIR_RE.findall(m.group("ex"))
-        total = sum(len(n) + len(v) for n, v in pairs)
-        if total > EXEMPLAR_LABEL_SET_MAX:
-            problems.append(
-                f"{where}:{lineno}: exemplar label set is {total} chars "
-                f"(cap {EXEMPLAR_LABEL_SET_MAX})")
-        try:
-            float(m.group("ex_value"))
-        except ValueError:
-            problems.append(
-                f"{where}:{lineno}: exemplar value "
-                f"{m.group('ex_value')!r} is not a number")
-    if any_exemplar and (not lines or lines[-1].strip() != "# EOF"):
-        problems.append(
-            f"{where}: exemplars present but no `# EOF` terminator")
-    rendered = render_families(parse_prometheus(text))
-    if rendered.rstrip("\n") != text.rstrip("\n"):
-        problems.append(
-            f"{where}: fleet parse -> render round trip is not "
-            "byte-identical")
-    return problems
-
-
-def lint_exemplars() -> list[str]:
-    """Render a live exemplar-enabled exposition (and its fleet-merged
-    re-render) and run rule 6 over both."""
-    sys.path.insert(0, ROOT)
-    try:
-        from mmlspark_tpu.observability.fleet import (parse_prometheus,
-                                                      render_families)
-        from mmlspark_tpu.observability.metrics import MetricsRegistry
-    finally:
-        sys.path.pop(0)
-    reg = MetricsRegistry()
-    h = reg.histogram("mmlspark_tpu_serving_latency_seconds", "latency",
-                      labels=("server",), exemplars=True)
-    h.labels(server="srv0").observe(
-        0.004, exemplar={"trace_id": "ab" * 16, "route": "resident",
-                         "bucket": "8"})
-    h.labels(server="srv0").observe(
-        2.5, exemplar={"trace_id": "cd" * 16, "route": "host"})
-    text = reg.render_prometheus()
-    problems = lint_exposition(text, where="registry render")
-    merged = render_families(parse_prometheus(text))
-    problems.extend(lint_exposition(merged, where="fleet re-render"))
-    return problems
-
-
-# -- rule 7: profiler phase vocabulary ---------------------------------- #
-
-
-def lint_profiler_phases() -> list[str]:
-    """Rule 7: the profiler's ``*_seconds`` histograms must declare the
-    ``phase`` label (statically, via its PROFILER_SERIES manifest), and
-    a live ledger driven through every phase must emit only label values
-    from the fixed PHASES vocabulary."""
-    sys.path.insert(0, ROOT)
-    try:
-        from mmlspark_tpu.observability.metrics import MetricsRegistry
-        from mmlspark_tpu.observability.profiler import (PHASE_LABEL,
-                                                         PHASES,
-                                                         PROFILER_SERIES,
-                                                         Profiler)
-    finally:
-        sys.path.pop(0)
-    problems = []
-    for name, (kind, labelnames) in sorted(PROFILER_SERIES.items()):
-        if name.endswith("_seconds") and kind == "histogram" \
-                and PHASE_LABEL not in labelnames:
-            problems.append(
-                f"profiler series {name!r} is a timing histogram without "
-                f"a {PHASE_LABEL!r} label — attribution cannot group it "
-                "by phase")
-    # live exercise: one ledger touching every phase, then inspect the
-    # actual label values the registry recorded
-    reg = MetricsRegistry()
-    prof = Profiler(registry=reg, enabled=True)
-    led = prof.ledger("lint", "seg0")
-    for ph in PHASES:
-        led.add(ph, 0.001)
-    led.note_pad(6, 8)
-    led.note_shard("TPU_0", 0.002, rows=6)
-    led.done(rtt_s=0.01)
-    prof.flush()  # commits drain on a background thread
-    try:
-        led.add("not_a_phase", 0.001)
-    except ValueError:
-        pass
-    else:
-        problems.append(
-            "PhaseLedger.add accepted a phase outside PHASES — the "
-            "vocabulary is not enforced at the recording site")
-    vocab = set(PHASES)
-    seen_phases = 0
-    for name, fam in reg.snapshot().items():
-        for sample in fam.get("samples", []):
-            phase = (sample.get("labels") or {}).get(PHASE_LABEL)
-            if phase is None:
-                continue
-            seen_phases += 1
-            if phase not in vocab:
-                problems.append(
-                    f"live profiler emitted phase label {phase!r} on "
-                    f"{name!r} — outside the fixed vocabulary "
-                    f"{'|'.join(PHASES)}")
-    if not seen_phases:
-        problems.append(
-            "live profiler ledger committed no phase-labeled samples — "
-            "the rule 7 dynamic check is vacuous")
-    return problems
-
-
-def main() -> None:
-    checked = 0
-    problems: list[str] = []
-    for path in iter_sources():
-        found = lint_file(path)
-        problems.extend(found)
-        with open(path) as fh:
-            checked += sum(1 for line in fh
-                           for _ in LITERAL_RE.finditer(line))
-    problems.extend(lint_exemplars())
-    problems.extend(lint_profiler_phases())
-    if problems:
-        print(f"metric_lint: {len(problems)} problem(s):")
-        for p in problems:
-            print(f"  {p}")
-        raise SystemExit(1)
-    print(f"metric_lint: {checked} metric-name literal(s) OK; "
-          "exemplar exposition OK (rule 6); "
-          "profiler phase vocabulary OK (rule 7)")
-
+from tools.graftlint.engine import main  # noqa: E402
 
 if __name__ == "__main__":
-    main()
+    raise SystemExit(
+        main(["--rules", "M1,M2,M3,M4,M5,M6,M7"] + sys.argv[1:]))
