@@ -2107,6 +2107,83 @@ def _transformer_extra(transformer: "dict | None") -> dict:
     }
 
 
+def bench_automl_sweep() -> dict:
+    """Distributed-sweep rows: the SAME 6-trial 2-rung hyperband sweep
+    (GBDT, shared binned dataset) run serially (P=1) and across 4
+    preemptible worker processes (P=4), plus a third P=4 run where a
+    chaos hook SIGKILLs a worker mid-trial — the preemption recovery
+    overhead is that run's wall time over the undisturbed P=4 time.
+    Rung barriers make the computed fit set parallelism-invariant, so
+    all three runs must land the byte-identical SweepResult digest.
+
+    Each worker's XLA is pinned to one thread — the deployment model is
+    one execution slot (chip) per worker, so P=1 must not get a 4-core
+    head start over the per-worker slots. Even so this is NOT a
+    CPU-speedup claim: on a host with fewer cores than workers (CI runs
+    on one) P=4 CANNOT beat P=1, and the paired trials/min rows exist to
+    track regressions in sweep orchestration cost (claim/heartbeat/
+    barrier overhead) while `speedup_p4` is ungated diagnostics; real
+    speedup needs a device per worker."""
+    import tempfile
+
+    from mmlspark_tpu.automl.sweep import HyperbandPruner, SweepScheduler
+    from mmlspark_tpu.core.schema import Table
+    from mmlspark_tpu.gbdt import GBDTClassifier
+
+    rng = np.random.default_rng(17)
+    # sized so one fold fit is O(1s): worker spawn (~1-2s/process) and
+    # rung-barrier idling must be a tax on real work, not the whole
+    # measurement — a toy fit would benchmark process startup
+    x = rng.normal(size=(2048, 16))
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float64)
+    table = Table({"features": x, "label": y})
+    est = GBDTClassifier(features_col="features", label_col="label",
+                         num_iterations=8, num_leaves=15, seed=7)
+    space = [{"learning_rate": lr, "num_leaves": nl}
+             for lr in (0.05, 0.1, 0.2) for nl in (4, 8)]
+
+    def run(workers: int, ckpt: str, chaos: "dict | None" = None):
+        sched = SweepScheduler(
+            [est], trials=[(0, p) for p in space],
+            evaluation_metric="accuracy", label_col="label", num_folds=2,
+            seed=0, checkpoint_dir=ckpt, workers=workers,
+            pruner=HyperbandPruner(min_resource=4, max_resource=8, eta=2),
+            rung_timeout_s=240.0, chaos=chaos)
+        t0 = time.perf_counter()
+        res = sched.run(table)
+        return res, time.perf_counter() - t0
+
+    # spawned workers read env at jax import; the driver's own backend
+    # is already initialized, so only the workers are pinned
+    old_flags = os.environ.get("XLA_FLAGS")
+    os.environ["XLA_FLAGS"] = ((old_flags + " ") if old_flags else "") + \
+        "--xla_cpu_multi_thread_eigen=false intra_op_parallelism_threads=1"
+    try:
+        with tempfile.TemporaryDirectory() as d:
+            r1, s1 = run(1, os.path.join(d, "p1"))
+            r4, s4 = run(4, os.path.join(d, "p4"))
+            rc, sc = run(4, os.path.join(d, "chaos"),
+                         chaos={"nth": 3, "mode": "before_save"})
+    finally:
+        if old_flags is None:
+            os.environ.pop("XLA_FLAGS", None)
+        else:
+            os.environ["XLA_FLAGS"] = old_flags
+    if not (r1.digest == r4.digest == rc.digest):
+        raise RuntimeError("sweep digests diverged across parallelism")
+    fits = len(r1.results)
+    return {
+        "fits": fits,
+        "p1_trials_per_sec": fits / s1,
+        "p4_trials_per_sec": fits / s4,
+        "p1_trials_per_min": 60.0 * fits / s1,
+        "p4_trials_per_min": 60.0 * fits / s4,
+        "speedup_p4": s1 / s4,
+        "recovery_overhead": sc / s4,
+        "resumed_trials": rc.resumed_trials,
+    }
+
+
 def bench_streaming_parallel() -> dict:
     """Partition-parallel streaming speedup: the SAME keyed stateful
     pipeline run at P=1 (plain StreamingQuery) and P=2/P=4
@@ -2387,6 +2464,11 @@ def _run_suite(platform: str) -> dict:
         print(f"bench: recommendation topk bench failed ({e!r})",
               file=sys.stderr)
         rec_topk = None
+    try:
+        automl_sweep = bench_automl_sweep()
+    except Exception as e:  # noqa: BLE001 — sweep row is auxiliary
+        print(f"bench: automl sweep bench failed ({e!r})", file=sys.stderr)
+        automl_sweep = None
     _write_metrics_snapshot()
 
     resident = runner.get("resident_images_per_sec", 0.0)
@@ -2557,6 +2639,25 @@ def _run_suite(platform: str) -> dict:
                 rec_topk, "offline_rows_per_sec"),
             "recommendation_topk_resident_vs_host_by_rung": (
                 rec_topk["resident_vs_host_by_rung"] if rec_topk else None),
+            "automl_sweep_p1_trials_per_sec": round(
+                automl_sweep["p1_trials_per_sec"], 3)
+                if automl_sweep else None,
+            "automl_sweep_p4_trials_per_sec": round(
+                automl_sweep["p4_trials_per_sec"], 3)
+                if automl_sweep else None,
+            "automl_sweep_p1_trials_per_min": round(
+                automl_sweep["p1_trials_per_min"], 1)
+                if automl_sweep else None,
+            "automl_sweep_p4_trials_per_min": round(
+                automl_sweep["p4_trials_per_min"], 1)
+                if automl_sweep else None,
+            "automl_sweep_speedup_p4": round(
+                automl_sweep["speedup_p4"], 3) if automl_sweep else None,
+            "automl_sweep_preemption_recovery_overhead": round(
+                automl_sweep["recovery_overhead"], 3)
+                if automl_sweep else None,
+            "automl_sweep_fits": (
+                automl_sweep["fits"] if automl_sweep else None),
             "headroom_note": (
                 "gbdt fit is HBM-bound (see gbdt_modeled_hbm_* vs chip peak); "
                 "end-to-end runner throughput is host->device transfer bound: "

@@ -27,6 +27,13 @@ from .tune import (
     DefaultHyperparams,
 )
 from .find_best import FindBestModel, BestModel
+from .sweep import (
+    HyperbandPruner,
+    SweepScheduler,
+    SweepResult,
+    SweepWorkerFactory,
+    SweepModelFactory,
+)
 from .lime import superpixels, SuperpixelTransformer, ImageLIME
 
 __all__ = [
@@ -49,6 +56,11 @@ __all__ = [
     "DefaultHyperparams",
     "FindBestModel",
     "BestModel",
+    "HyperbandPruner",
+    "SweepScheduler",
+    "SweepResult",
+    "SweepWorkerFactory",
+    "SweepModelFactory",
     "superpixels",
     "SuperpixelTransformer",
     "ImageLIME",

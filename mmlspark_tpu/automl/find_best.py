@@ -38,9 +38,18 @@ class FindBestModel(HasLabelCol, Estimator):
         d.pop("models", None)
         return d
 
+    _KNOWN_METRICS = tuple(MetricConstants.CLASSIFICATION_METRICS
+                           + MetricConstants.REGRESSION_METRICS)
+
     def _fit(self, table: Table) -> "BestModel":
         models: list[Transformer] = self.get("models")
         metric = self.get("evaluation_metric")
+        # validate the metric BEFORE scoring: a typo'd name must not cost
+        # N full model evaluations before the KeyError lands
+        if metric not in self._KNOWN_METRICS:
+            raise ValueError(
+                f"evaluation_metric {metric!r} is not rankable; choose one "
+                f"of {sorted(self._KNOWN_METRICS)}")
         maximize = metric in _MAXIMIZE
         stats = ComputeModelStatistics(
             label_col=self.get("label_col"), scored_labels_col="prediction"
@@ -54,8 +63,25 @@ class FindBestModel(HasLabelCol, Estimator):
             if metric not in row:
                 raise KeyError(f"metric {metric!r} not in {row.columns}")
             rows.append({c: np.asarray(row[c])[0] for c in row.columns})
-        values = [float(r[metric]) for r in rows]
-        best = int(np.argmax(values) if maximize else np.argmin(values))
+        values = np.asarray([float(r[metric]) for r in rows], np.float64)
+        # NaN metrics never win: np.argmax/argmin over a NaN-containing
+        # array returns the NaN's index, silently selecting a garbage
+        # model. Skip them with a warning; only an all-NaN board raises.
+        finite = ~np.isnan(values)
+        if not finite.any():
+            raise ValueError(
+                f"every candidate scored NaN on {metric!r}; no model is "
+                "selectable")
+        if not finite.all():
+            import warnings
+
+            bad = [i for i, ok in enumerate(finite) if not ok]
+            warnings.warn(
+                f"skipping {len(bad)} model(s) with NaN {metric!r} "
+                f"(indexes {bad})", stacklevel=2)
+        masked = np.where(finite, values,
+                          -np.inf if maximize else np.inf)
+        best = int(np.argmax(masked) if maximize else np.argmin(masked))
         out = BestModel()
         out.best_model = models[best]
         out.best_model_metrics = rows[best]

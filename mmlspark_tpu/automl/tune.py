@@ -224,6 +224,18 @@ class TuneHyperparameters(HasLabelCol, Estimator):
     trial_restarts = Param(
         0, "transient-failure retries per trial (RestartPolicy budget)",
         ptype=int)
+    # distributed preemptible sweeps (automl/sweep.py): workers > 0 runs
+    # the trials on a fleet of preemptible worker PROCESSES with
+    # rung-synchronized hyperband early stopping instead of the
+    # in-process thread pool; requires checkpoint_dir (spec, ledger, and
+    # sub-checkpoints live there). The sweep digest is byte-identical at
+    # any worker count.
+    workers = Param(
+        0, "preemptible sweep worker processes (0 = in-process threads)",
+        ptype=int)
+    pruner = Param(
+        None, "sweep.HyperbandPruner for rung-synchronized early "
+        "stopping (workers > 0; None = pruner defaults)")
 
     # programmatic override for the Param-built default restart policy
     restart_policy = None
@@ -269,6 +281,8 @@ class TuneHyperparameters(HasLabelCol, Estimator):
                 "evaluation_metric='all' cannot rank trials; pick one metric "
                 f"(e.g. {sorted(_MAXIMIZE)})"
             )
+        if int(self.get("workers") or 0) > 0:
+            return self._fit_distributed(table, models, trials, metric)
         stats = ComputeModelStatistics(
             label_col=self.get("label_col"),
             scored_labels_col="prediction",
@@ -384,6 +398,49 @@ class TuneHyperparameters(HasLabelCol, Estimator):
         with ThreadPoolExecutor(max_workers=self.get("parallelism")) as pool:
             results = list(pool.map(run_trial, enumerate(trials)))
 
+        return self._pick_and_refit(table, models, trials, results, folds,
+                                    maximize)
+
+    def _fit_distributed(self, table: Table, models, trials,
+                         metric: str) -> "TuneHyperparametersModel":
+        """workers > 0: delegate to the preemptible sweep fleet. The
+        winner comes back refit on the full table (sweep semantics:
+        refit always happens — it IS the deployable artifact)."""
+        from .sweep import SweepScheduler
+
+        if not self.get("checkpoint_dir"):
+            raise ValueError(
+                "workers > 0 needs checkpoint_dir: the sweep spec, trial "
+                "ledger, and per-(trial, rung, fold) sub-checkpoints are "
+                "how preempted workers resume")
+        sched = SweepScheduler(
+            models, trials=trials,
+            evaluation_metric=metric,
+            label_col=self.get("label_col"),
+            num_folds=int(self.get("num_folds")),
+            seed=int(self.get("seed")),
+            checkpoint_dir=self.get("checkpoint_dir"),
+            workers=int(self.get("workers")),
+            pruner=self.get("pruner"),
+        )
+        res = sched.run(table)
+        out = TuneHyperparametersModel()
+        out.best_model = res.best_model.best_model
+        out.best_metric = res.best_metric
+        out.best_params = dict(res.best_params)
+        final = len(sched.pruner.rung_budgets()) - 1
+        out.all_results = [
+            {"model": mi, "params": pm,
+             "metric": res.results.get(f"{ti}:{final}", float("nan"))}
+            for ti, (mi, pm) in enumerate(sched.trials)
+        ]
+        out.sweep_result = res
+        return out
+
+    def _pick_and_refit(self, table, models, trials, results, folds,
+                        maximize) -> "TuneHyperparametersModel":
+        ckpt_dir = self.get("checkpoint_dir")
+
         best_i = int(np.argmax(results) if maximize else np.argmin(results))
         best_mi, best_pm = trials[best_i]
         refit_est = models[best_mi].copy(best_pm)
@@ -414,6 +471,10 @@ class TuneHyperparametersModel(Model):
     best_metric: float = float("nan")
     best_params: dict[str, Any] = {}
     all_results: list = []
+    # set only by the distributed path (workers > 0): the full
+    # automl.sweep.SweepResult, including the determinism digest,
+    # pruning record, and worker lineage
+    sweep_result: Any = None
 
     def _transform(self, table: Table) -> Table:
         return self.best_model.transform(table)

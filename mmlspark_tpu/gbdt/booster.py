@@ -176,14 +176,26 @@ class Booster:
         k = opts.num_class if opts.objective == "multiclass" else 1
 
         warm = opts.init_model
+        shared_hit = None
         if warm is not None:
             mapper = warm.bin_mapper
         else:
-            mapper = BinMapper(
-                max_bin=opts.max_bin,
-                categorical_indexes=tuple(opts.categorical_indexes),
-                bin_construct_sample_cnt=opts.bin_construct_sample_cnt,
-            ).fit(x)
+            # AutoML sweeps seed a SharedBinContext: when this fit's rows
+            # are a slice of the seeded full table under the same binning
+            # config, reuse its mapper + device-resident binned matrix
+            # (a device gather) instead of re-sketching and re-binning
+            from .shared_bins import lookup_shared_bins, note_bin_build
+
+            shared_hit = lookup_shared_bins(x, opts)
+            if shared_hit is not None:
+                mapper = shared_hit.mapper
+            else:
+                mapper = BinMapper(
+                    max_bin=opts.max_bin,
+                    categorical_indexes=tuple(opts.categorical_indexes),
+                    bin_construct_sample_cnt=opts.bin_construct_sample_cnt,
+                ).fit(x)
+                note_bin_build()
         use_device_bin = (
             opts.device_binning and not mapper.category_maps
             and not is_sparse(x)
@@ -201,7 +213,8 @@ class Booster:
             mapper = _copy.copy(mapper)
             mapper.upper_bounds = np.float64(
                 np.float32(mapper.upper_bounds))
-        bins_np = None if use_device_bin else mapper.transform(x)
+        bins_np = (None if use_device_bin or shared_hit is not None
+                   else mapper.transform(x))
         num_bins = max(int(mapper.num_bins.max(initial=2)), 2)
 
         # pad rows so the data mesh axis divides evenly
@@ -232,6 +245,14 @@ class Booster:
             use_u8 = False
         if use_device_bin:
             bd = mapper.transform_device(x)
+            if pad:
+                bd = jnp.concatenate(
+                    [bd, jnp.zeros((pad, f), bd.dtype)])
+            bins_dev = bd.astype(jnp.uint8 if use_u8 else jnp.int32)
+        elif shared_hit is not None:
+            # binning is row-wise, so the gathered rows of the shared
+            # full-table matrix ARE this fit's binned matrix
+            bd = shared_hit.device_bins()
             if pad:
                 bd = jnp.concatenate(
                     [bd, jnp.zeros((pad, f), bd.dtype)])

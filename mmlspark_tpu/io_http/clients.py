@@ -357,12 +357,31 @@ class TargetPool:
              policy: "RetryPolicy | None" = None,
              strategy: str = "round_robin", key: "str | None" = None,
              retry_connect: bool = True,
-             on_failover=None) -> HTTPResponseData:
+             on_failover=None, target: "str | None" = None) -> HTTPResponseData:
         """Route one request to a picked live target. On a CONNECTION
         failure (status 0 — no HTTP answer, so resending is safe even
         mid-POST) the request is retried once against a different live
         target: a crashed replica costs a retry, not an error.
-        `on_failover(url, resp)` observes the failed first attempt."""
+        `on_failover(url, resp)` observes the failed first attempt.
+
+        `target` pins the request to one specific member instead of
+        picking: lease accounting and the per-URL breaker still apply,
+        but there is no failover — a claim/heartbeat protocol addressed
+        to worker X must fail, not silently reach worker Y. The target
+        must be a pool member (a directed send is still a routing
+        decision, so membership is the authority); an unknown or
+        ejected target answers 503 without a network attempt."""
+        if target is not None:
+            with self._lock:
+                t = self._targets.get(target)
+            if t is None or not self._is_live(t):
+                return HTTPResponseData(
+                    503, "target not live", entity=None,
+                    headers={"Retry-After": "1"})
+            with self.lease(target):
+                return http_send(self._rebase(req, target), timeout=timeout,
+                                 policy=policy,
+                                 breaker=self.breaker_for(target))
         tried: list[str] = []
         resp = HTTPResponseData(503, "no live targets", entity=None,
                                 headers={"Retry-After": "1"})

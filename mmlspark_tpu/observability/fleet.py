@@ -351,6 +351,15 @@ GAUGE_MERGE_POLICIES: dict[str, str] = {
     # the furthest — worst age is the pageable signal, not the fleet
     # average or the "_seconds" last-wins default
     "mmlspark_tpu_checkpoint_last_age_seconds": "max",
+    # AutoML sweeps (automl/sweep.py) run ON THE DRIVER: the scheduler
+    # is a singleton control plane, so its gauges are authoritative
+    # values, never per-replica shares — "last" wins over every additive
+    # suffix default. The score gauge is in metric units (AUC, mse, ...)
+    # and feeds HyperbandPruner, not a fleet aggregate.
+    "mmlspark_tpu_sweep_trial_score_rate": "last",
+    "mmlspark_tpu_sweep_rung_survivors_count": "last",
+    "mmlspark_tpu_sweep_workers_live_count": "last",
+    "mmlspark_tpu_sweep_inflight_trials_depth": "last",
 }
 
 _SUFFIX_POLICIES: tuple[tuple[str, str], ...] = (

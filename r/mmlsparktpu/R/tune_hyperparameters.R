@@ -15,9 +15,11 @@
 #' @param trial_submeshes disjoint data submeshes for parallel trials
 #' @param checkpoint_dir sweep checkpoint directory (trial ledger + per-trial dirs)
 #' @param trial_restarts transient-failure retries per trial (RestartPolicy budget)
+#' @param workers preemptible sweep worker processes (0 = in-process threads)
+#' @param pruner sweep.HyperbandPruner for rung-synchronized early stopping (workers > 0; None = pruner defaults)
 #' @param only.model return the fitted model without transforming x (the reference's unfit.model)
 #' @export
-ml_tune_hyperparameters <- function(x, label_col = "label", models, evaluation_metric = "accuracy", num_folds = 3L, parallelism = 4L, seed = 0L, param_space, num_runs = 10L, refit = TRUE, trial_submeshes = 0L, checkpoint_dir = NULL, trial_restarts = 0L, only.model = FALSE)
+ml_tune_hyperparameters <- function(x, label_col = "label", models, evaluation_metric = "accuracy", num_folds = 3L, parallelism = 4L, seed = 0L, param_space, num_runs = 10L, refit = TRUE, trial_submeshes = 0L, checkpoint_dir = NULL, trial_restarts = 0L, workers = 0L, pruner = NULL, only.model = FALSE)
 {
   params <- list()
   if (!is.null(label_col)) params$label_col <- as.character(label_col)
@@ -32,5 +34,7 @@ ml_tune_hyperparameters <- function(x, label_col = "label", models, evaluation_m
   if (!is.null(trial_submeshes)) params$trial_submeshes <- as.integer(trial_submeshes)
   if (!is.null(checkpoint_dir)) params$checkpoint_dir <- as.character(checkpoint_dir)
   if (!is.null(trial_restarts)) params$trial_restarts <- as.integer(trial_restarts)
+  if (!is.null(workers)) params$workers <- as.integer(workers)
+  if (!is.null(pruner)) params$pruner <- pruner
   .tpu_apply_stage("mmlspark_tpu.automl.tune.TuneHyperparameters", params, x, is_estimator = TRUE, only.model = only.model)
 }

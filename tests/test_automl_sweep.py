@@ -1,0 +1,400 @@
+"""Distributed preemptible AutoML sweeps (ISSUE 16): HyperbandPruner
+rung math on a private registry, the worker claim/heartbeat/status
+protocol driven in-process, FindBestModel NaN handling, shared-bin
+determinism, directed TargetPool sends, and the slow-tier chaos e2e —
+a P=2 sweep with an unannounced SIGKILL mid-trial (and, separately, a
+kill mid-sub-checkpoint fsync) must prune like, score like, and pick
+the byte-identical winner of an undisturbed serial P=1 sweep, then
+hot-swap that winner into a live gateway-fronted fleet under client
+load with zero visible errors and byte-identical response bodies.
+
+Pruner/protocol tests never spawn a process; the only real process work
+is in the slow tier (real ServingFleet workers, real SIGKILL).
+"""
+
+import json
+import math
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.pipeline import PipelineStage
+from mmlspark_tpu.core.schema import Table
+from mmlspark_tpu.automl import FindBestModel
+from mmlspark_tpu.automl.sweep import (
+    HyperbandPruner,
+    SweepModelFactory,
+    SweepScheduler,
+    SweepWorkerFactory,
+    _score_gauge,
+)
+from mmlspark_tpu.gbdt import GBDTClassifier
+from mmlspark_tpu.gbdt.binning import BinMapper
+from mmlspark_tpu.gbdt.shared_bins import (
+    SharedBinContext,
+    bin_counters,
+    set_shared_bin_context,
+)
+from mmlspark_tpu.io_http.schema import HTTPRequestData
+from mmlspark_tpu.observability.metrics import MetricsRegistry
+
+
+def sweep_table(n=120, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 4))
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float64)
+    return Table({"features": x, "label": y})
+
+
+def make_scheduler(ckpt, workers, chaos=None, **kw):
+    est = GBDTClassifier(features_col="features", label_col="label",
+                         num_iterations=8, num_leaves=4, seed=7)
+    space = [{"learning_rate": lr, "num_leaves": nl}
+             for lr in (0.05, 0.1, 0.2) for nl in (4, 8)]
+    return SweepScheduler(
+        [est], trials=[(0, p) for p in space],
+        evaluation_metric="accuracy", label_col="label", num_folds=2,
+        seed=0, checkpoint_dir=str(ckpt), workers=workers,
+        pruner=HyperbandPruner(min_resource=4, max_resource=8, eta=2),
+        rung_timeout_s=240.0, chaos=chaos, **kw)
+
+
+# --------------------------------------------------------------------- #
+# hyperband pruner (pure rung math, private registry, no processes)     #
+# --------------------------------------------------------------------- #
+
+
+class TestHyperbandPruner:
+    def test_budget_geometry(self):
+        assert HyperbandPruner(4, 8, eta=2).rung_budgets() == [4, 8]
+        assert HyperbandPruner(2, 18, eta=3).rung_budgets() == [2, 6, 18]
+        # final rung always trains at max_resource, even off-geometry
+        assert HyperbandPruner(2, 7, eta=2).rung_budgets() == [2, 4, 7]
+        assert HyperbandPruner(5, 5, eta=2).rung_budgets() == [5]
+
+    def test_invalid_config_raises(self):
+        with pytest.raises(ValueError):
+            HyperbandPruner(10, 5)
+        with pytest.raises(ValueError):
+            HyperbandPruner(0, 5)
+        with pytest.raises(ValueError):
+            HyperbandPruner(1, 5, eta=1)
+
+    def _seed(self, reg, rung, scores):
+        g = _score_gauge(reg)
+        for ti, v in scores.items():
+            g.labels(trial=str(ti), rung=str(rung)).set(v)
+
+    def test_keeps_top_ceil_over_eta(self):
+        reg = MetricsRegistry()
+        self._seed(reg, 0, {0: 0.9, 1: 0.5, 2: float("nan"), 3: 0.7})
+        keep = HyperbandPruner(2, 8, eta=2).decide(
+            0, [0, 1, 2, 3], maximize=True, registry=reg)
+        assert keep == [0, 3]  # NaN pruned first, then worst
+
+    def test_minimize_keeps_lowest(self):
+        reg = MetricsRegistry()
+        self._seed(reg, 1, {0: 0.9, 1: 0.5, 2: 0.7})
+        keep = HyperbandPruner(2, 8, eta=3).decide(
+            1, [0, 1, 2], maximize=False, registry=reg)
+        assert keep == [1]
+
+    def test_ties_break_by_trial_id(self):
+        reg = MetricsRegistry()
+        self._seed(reg, 0, {4: 0.5, 7: 0.5, 9: 0.5})
+        keep = HyperbandPruner(2, 8, eta=2).decide(
+            0, [4, 7, 9], maximize=True, registry=reg)
+        assert keep == [4, 7]
+
+    def test_barrier_violation_raises(self):
+        reg = MetricsRegistry()
+        self._seed(reg, 0, {0: 0.9})
+        with pytest.raises(RuntimeError, match="not a barrier"):
+            HyperbandPruner(2, 8, eta=2).decide(
+                0, [0, 1], maximize=True, registry=reg)
+
+    def test_all_nan_raises(self):
+        reg = MetricsRegistry()
+        self._seed(reg, 0, {0: float("nan"), 1: float("nan")})
+        with pytest.raises(RuntimeError, match="NaN"):
+            HyperbandPruner(2, 8, eta=2).decide(
+                0, [0, 1], maximize=True, registry=reg)
+
+    def test_rung_isolation(self):
+        # rung 1 decisions never read rung 0 gauges
+        reg = MetricsRegistry()
+        self._seed(reg, 0, {0: 0.1, 1: 0.9})
+        self._seed(reg, 1, {0: 0.9, 1: 0.1})
+        keep = HyperbandPruner(2, 8, eta=2).decide(
+            1, [0, 1], maximize=True, registry=reg)
+        assert keep == [0]
+
+
+# --------------------------------------------------------------------- #
+# FindBestModel NaN handling (satellite 1)                              #
+# --------------------------------------------------------------------- #
+
+
+class _ConstModel(PipelineStage):
+    """Scores every row with a constant; label == 1.23 rows make a
+    perfect model, NaN makes an unusable one."""
+
+    def __init__(self, value):
+        self._v = float(value)
+        self.calls = 0
+
+    def transform(self, table):
+        self.calls += 1
+        return table.with_column(
+            "prediction", np.full(len(table), self._v, np.float64))
+
+
+class TestFindBestModelNaN:
+    def _table(self):
+        return Table({"x": np.zeros(8), "label": np.full(8, 1.23)})
+
+    def test_nan_model_skipped_with_warning(self):
+        good, bad = _ConstModel(1.23), _ConstModel(float("nan"))
+        fb = FindBestModel(models=[bad, good],
+                           evaluation_metric="mean_squared_error")
+        with pytest.warns(UserWarning, match="NaN"):
+            best = fb.fit(self._table())
+        assert best.best_model is good
+
+    def test_all_nan_raises(self):
+        fb = FindBestModel(
+            models=[_ConstModel(float("nan")), _ConstModel(float("nan"))],
+            evaluation_metric="mean_squared_error")
+        with pytest.raises(ValueError, match="NaN"):
+            fb.fit(self._table())
+
+    def test_unknown_metric_rejected_before_scoring(self):
+        m = _ConstModel(1.0)
+        with pytest.raises(ValueError, match="not rankable"):
+            FindBestModel(models=[m], evaluation_metric="acuracy").fit(
+                self._table())
+        assert m.calls == 0  # a typo must not cost a full evaluation
+
+
+# --------------------------------------------------------------------- #
+# shared binned dataset                                                 #
+# --------------------------------------------------------------------- #
+
+
+class TestSharedBins:
+    def test_row_gather_identity(self):
+        # the invariant the whole cache rests on: binning is row-wise
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=(60, 5))
+        idx = np.asarray([3, 17, 42, 3])
+        mapper = BinMapper(max_bin=16).fit(x)
+        np.testing.assert_array_equal(
+            mapper.transform(x[idx]), mapper.transform(x)[idx])
+
+    def test_seed_once_lookup_hits(self):
+        rng = np.random.default_rng(4)
+        x = rng.normal(size=(50, 3))
+        before = bin_counters()
+        ctx = SharedBinContext()
+        ctx.seed(x, max_bin=16)
+        ctx.seed(x, max_bin=16)  # idempotent: no second build
+        hit = ctx.lookup(x[10:30], max_bin=16, categorical_indexes=(),
+                         bin_construct_sample_cnt=200_000)
+        assert hit is not None
+        np.testing.assert_array_equal(
+            np.asarray(hit.device_bins()),
+            hit.mapper.transform(x)[10:30])
+        after = bin_counters()
+        assert after["builds"] - before["builds"] == 1.0
+        assert after["hits"] - before["hits"] == 1.0
+
+    def test_config_mismatch_misses(self):
+        rng = np.random.default_rng(5)
+        x = rng.normal(size=(30, 3))
+        ctx = SharedBinContext()
+        ctx.seed(x, max_bin=16)
+        # a trial sweeping max_bin must re-bin, not inherit boundaries
+        assert ctx.lookup(x, max_bin=32, categorical_indexes=(),
+                          bin_construct_sample_cnt=200_000) is None
+        # foreign rows never match
+        assert ctx.lookup(x + 1.0, max_bin=16, categorical_indexes=(),
+                          bin_construct_sample_cnt=200_000) is None
+
+
+# --------------------------------------------------------------------- #
+# worker protocol (handler driven in-process, no fleet)                 #
+# --------------------------------------------------------------------- #
+
+
+def _reply(handler, body):
+    out = handler(Table({"request": [HTTPRequestData.from_json("/", body)]}))
+    r = out["reply"][0]
+    return r.status_code, json.loads(r.entity.decode())
+
+
+class TestWorkerProtocol:
+    @pytest.fixture()
+    def handler(self, tmp_path):
+        sched = make_scheduler(tmp_path, workers=1)
+        sched._write_spec(sweep_table())
+        try:
+            yield SweepWorkerFactory(str(tmp_path))()
+        finally:
+            set_shared_bin_context(None)
+
+    def _await_done(self, handler, timeout=90.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            code, doc = _reply(handler, {"op": "heartbeat"})
+            assert code == 200
+            if doc["state"] in ("done", "failed"):
+                return doc
+            time.sleep(0.05)
+        raise AssertionError("trial never finished")
+
+    def test_unknown_op_is_an_error_reply_not_a_crash(self, handler):
+        code, doc = _reply(handler, {"op": "explode"})
+        assert code == 500 and "error" in doc
+        # the worker survives to serve the next op
+        code, doc = _reply(handler, {"op": "heartbeat"})
+        assert code == 200 and doc["state"] == "idle"
+
+    def test_claim_fit_report_and_idempotence(self, handler):
+        before = bin_counters()
+        code, doc = _reply(handler, {"op": "claim", "trial": 0, "rung": 0,
+                                     "budget": 4})
+        assert code == 200 and doc == {"ok": True}
+        done = self._await_done(handler)
+        assert done["state"] == "done"
+        assert math.isfinite(done["metric"])
+
+        # a re-sent claim after a driver hiccup must not fit twice
+        code, doc = _reply(handler, {"op": "claim", "trial": 0, "rung": 0,
+                                     "budget": 4})
+        assert code == 200
+        assert doc["done"] is True and doc["metric"] == done["metric"]
+
+        # second trial: shared bins mean NO second BinMapper build
+        _reply(handler, {"op": "claim", "trial": 1, "rung": 0, "budget": 4})
+        self._await_done(handler)
+        code, doc = _reply(handler, {"op": "status"})
+        assert code == 200
+        assert set(doc["done"]) == {"0:0", "1:0"}
+        counters = doc["counters"]
+        assert counters["builds"] - before["builds"] == 1.0
+        assert counters["hits"] - before["hits"] == 4.0  # 2 trials x 2 folds
+
+    def test_busy_worker_rejects_second_trial(self, handler):
+        code, doc = _reply(handler, {"op": "claim", "trial": 2, "rung": 0,
+                                     "budget": 8})
+        assert doc == {"ok": True}
+        code, doc = _reply(handler, {"op": "claim", "trial": 3, "rung": 0,
+                                     "budget": 8})
+        if "busy" in doc:  # fit can legitimately finish first on a fast box
+            assert code == 200 and doc["trial"] == 2
+        self._await_done(handler)
+
+
+# --------------------------------------------------------------------- #
+# the slow tier: real workers, real SIGKILL, live hot-swap              #
+# --------------------------------------------------------------------- #
+
+
+@pytest.fixture(scope="module")
+def serial_sweep(tmp_path_factory):
+    """The undisturbed P=1 ground truth every chaos run must match."""
+    ckpt = tmp_path_factory.mktemp("sweep-serial")
+    return make_scheduler(ckpt, workers=1).run(sweep_table())
+
+
+@pytest.mark.slow
+class TestSweepEndToEnd:
+    def test_serial_sweep_prunes_and_picks(self, serial_sweep):
+        res = serial_sweep
+        assert res.pruned and sum(len(v) for v in res.pruned.values()) >= 1
+        assert res.best_trial in res.survivors
+        assert math.isfinite(res.best_metric)
+        # bins built exactly once in the (single) worker
+        assert [c["builds"] for c in res.worker_counters] == [1.0]
+
+    def test_sigkill_mid_trial_matches_serial(self, serial_sweep, tmp_path):
+        # the 3rd sub-checkpoint save SIGKILLs its worker with no
+        # warning; the driver must respawn, re-queue, and converge on
+        # the byte-identical winner
+        sched = make_scheduler(tmp_path, workers=2,
+                               chaos={"nth": 3, "mode": "before_save"})
+        res = sched.run(sweep_table())
+        assert (tmp_path / "_chaos_fired").exists()
+        assert res.resumed_trials >= 1
+        assert res.digest == serial_sweep.digest
+        assert res.best_blob == serial_sweep.best_blob
+        assert res.pruned == serial_sweep.pruned
+        # every worker that trained built bins exactly once
+        assert all(c["builds"] == 1.0 for c in res.worker_counters)
+
+    def test_kill_mid_sub_checkpoint_matches_serial(self, serial_sweep,
+                                                    tmp_path):
+        # fsync dies mid-snapshot: the torn file must be fallen past on
+        # resume, never loaded
+        sched = make_scheduler(tmp_path, workers=2,
+                               chaos={"nth": 3, "mode": "during_save"})
+        res = sched.run(sweep_table())
+        assert (tmp_path / "_chaos_fired").exists()
+        assert res.digest == serial_sweep.digest
+        assert res.best_blob == serial_sweep.best_blob
+
+    def test_hot_swap_under_load_zero_errors(self, serial_sweep, tmp_path):
+        from mmlspark_tpu.io_http.gateway import ServingGateway
+        from mmlspark_tpu.io_http.serving import ServingFleet
+        from mmlspark_tpu.io_http.clients import http_send
+
+        res = serial_sweep
+        modules = (type(res.best_model.best_model).__module__,)
+        warm = HTTPRequestData.from_json("/", {"features": [0.0] * 4})
+        fleet = ServingFleet(
+            SweepModelFactory(res.best_blob, modules=modules),
+            n_hosts=2, max_batch_size=1, warmup_request=warm).start()
+        gw = ServingGateway(checkpoint_dir=str(tmp_path / "journal"),
+                            strategy="round_robin")
+        gw.attach_fleet(fleet)
+        gw.start()
+
+        rows = np.asarray(sweep_table()["features"])[:8]
+        statuses, bodies, stop = [], [], threading.Event()
+
+        def post(i):
+            req = HTTPRequestData.from_json(
+                gw.url, {"features": [float(v) for v in rows[i % 8]]})
+            resp = http_send(req, retries=1)
+            statuses.append(resp.status_code)
+            bodies.append((i % 8, resp.entity))
+
+        def loader():
+            i = 0
+            while not stop.is_set():
+                post(i)
+                i += 1
+
+        try:
+            for i in range(8):  # baseline bodies, pre-swap
+                post(i)
+            baseline = dict(bodies)
+            t = threading.Thread(target=loader, daemon=True)
+            t.start()
+            # zero-downtime cutover of the sweep winner while clients
+            # hammer the gateway
+            swapped = res.hot_swap(fleet)
+            assert swapped == 2
+            time.sleep(0.5)
+            stop.set()
+            t.join(timeout=30)
+            assert len(statuses) > 16
+            assert all(s == 200 for s in statuses)
+            # byte-identical responses across the cutover
+            assert all(body == baseline[k] for k, body in bodies)
+        finally:
+            stop.set()
+            gw.stop()
+            fleet.stop()

@@ -1117,6 +1117,140 @@ def perf_selftest() -> int:
     return 0
 
 
+# -- sweep -------------------------------------------------------------- #
+
+def diagnose_sweep(ckpt_dir: str) -> str:
+    """Trial ledger table for one AutoML sweep checkpoint directory.
+    Built only from what the sweep durably wrote (spec.json + the
+    `_sweep_ledger` TrainingCheckpointer snapshots) — exactly what a
+    resumed `SweepScheduler.run` would see, so a live sweep can be
+    watched from a second terminal with no coordination."""
+    from mmlspark_tpu.resilience.elastic import TrainingCheckpointer
+
+    if not os.path.isdir(ckpt_dir):
+        return f"(no sweep checkpoint directory at {ckpt_dir})"
+    spec = {}
+    try:
+        with open(os.path.join(ckpt_dir, "spec.json"),
+                  encoding="utf-8") as fh:
+            spec = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        pass
+    doc = {}
+    loaded = TrainingCheckpointer(
+        os.path.join(ckpt_dir, "_sweep_ledger"), keep=2).load_latest()
+    if loaded is not None:
+        try:
+            doc = json.loads(loaded[0].decode("utf-8"))
+        except ValueError:
+            doc = {}
+    if doc.get("kind") != "sweep-ledger":
+        doc = {}
+
+    results = doc.get("results", {})
+    pruned = doc.get("pruned", {})
+    lineage = doc.get("lineage", {})
+    budgets = [int(b) for b in (doc.get("budgets")
+                                or spec.get("budgets") or [])]
+    n_trials = int(doc.get("n_trials")
+                   or len(spec.get("trials") or ()) or 0)
+    pruned_at = {int(ti): rung for rung, tis in pruned.items()
+                 for ti in tis}
+
+    out = [
+        f"sweep: {ckpt_dir} trials={n_trials} "
+        f"metric={spec.get('metric', '?')} "
+        f"rungs={budgets or '?'} workers={spec.get('n_workers', '?')} "
+        f"resumed_trials={doc.get('resumed_trials', 0)} "
+        f"scores={len(results)}"
+    ]
+    rows = []
+    for ti in range(n_trials):
+        events = lineage.get(str(ti), [])
+        last = events[-1] if events else {}
+        scores = {int(k.split(":")[1]): v for k, v in results.items()
+                  if int(k.split(":")[0]) == ti}
+        if ti in pruned_at:
+            state = f"pruned@r{pruned_at[ti]}"
+        elif budgets and len(budgets) - 1 in scores:
+            state = "done"
+        elif last.get("event") == "assigned":
+            state = "running"
+        elif last.get("event") == "failed":
+            state = "failed"
+        else:
+            state = "pending" if not scores else "waiting"
+        n_lost = sum(1 for e in events if e.get("event") == "lost")
+        rows.append([
+            str(ti), state,
+            str(1 + max(scores, default=-1)) + f"/{len(budgets) or '?'}",
+            " ".join(_fmt(scores[r], 4) for r in sorted(scores)) or "-",
+            str(last.get("worker", "-") or "-"),
+            str(n_lost) if n_lost else "-",
+        ])
+    if rows:
+        out.append(_render_table(rows, [
+            "trial", "state", "rungs", "scores", "last_worker", "lost"]))
+    else:
+        out.append("(no trials ledgered yet)")
+    return "\n".join(out)
+
+
+def sweep_selftest() -> int:
+    """Build a known sweep ledger on disk (the same writer the scheduler
+    uses), diagnose it, and assert every state the table can show:
+    scored, pruned, resumed-after-loss, and still-pending trials."""
+    import tempfile
+
+    from mmlspark_tpu.resilience.elastic import TrainingCheckpointer
+
+    checks: dict[str, bool] = {}
+    with tempfile.TemporaryDirectory() as d:
+        checks["empty dir reports cleanly"] = (
+            "(no trials ledgered yet)" in diagnose_sweep(d))
+        with open(os.path.join(d, "spec.json"), "w",
+                  encoding="utf-8") as fh:
+            json.dump({"kind": "sweep-spec", "metric": "accuracy",
+                       "n_workers": 2, "budgets": [4, 8],
+                       "trials": [[0, {}]] * 4}, fh)
+        doc = {
+            "kind": "sweep-ledger",
+            "results": {"0:0": 0.9, "1:0": 0.5, "2:0": 0.7,
+                        "0:1": 0.92, "2:1": 0.71},
+            "pruned": {"0": [1]},
+            "lineage": {
+                "0": [{"event": "assigned", "rung": 0,
+                       "worker": "http://w1/"},
+                      {"event": "lost", "rung": 0, "worker": "http://w1/"},
+                      {"event": "assigned", "rung": 1,
+                       "worker": "http://w2/"}],
+                "1": [{"event": "pruned", "rung": 0}],
+            },
+            "resumed_trials": 1, "n_trials": 4, "budgets": [4, 8],
+        }
+        TrainingCheckpointer(os.path.join(d, "_sweep_ledger"),
+                             keep=2).save(
+            json.dumps(doc).encode("utf-8"), tag="ledger-0005")
+        report = diagnose_sweep(d)
+        print(report)
+        checks["header counts"] = ("trials=4" in report
+                                   and "resumed_trials=1" in report
+                                   and "scores=5" in report)
+        lines = {ln.split()[0]: ln for ln in report.splitlines()
+                 if ln and ln.split()[0].isdigit()}
+        checks["winner done"] = "done" in lines["0"]
+        checks["loss counted"] = lines["0"].rstrip().endswith("1")
+        checks["pruned at rung"] = "pruned@r0" in lines["1"]
+        checks["pending trial"] = "pending" in lines["3"]
+        checks["scores render"] = "0.9000 0.9200" in lines["0"]
+    failed = [name for name, ok in checks.items() if not ok]
+    if failed:
+        print(f"sweep selftest FAILED: {failed}", file=sys.stderr)
+        return 1
+    print(f"sweep selftest OK ({len(checks)} checks)")
+    return 0
+
+
 # -- selftest ----------------------------------------------------------- #
 
 def _selftest_handler(table):
@@ -1285,6 +1419,10 @@ def main(argv: "list[str] | None" = None) -> int:
                          "checkpoint directory (with --selftest: real "
                          "store + checkpointed fit + corruption "
                          "fallback assertions)")
+    ap.add_argument("--sweep", nargs="?", const="", metavar="DIR",
+                    help="trial ledger table for an AutoML sweep "
+                         "checkpoint directory (with --selftest: build "
+                         "a known ledger and assert every table state)")
     ap.add_argument("--selftest", action="store_true",
                     help="run a 2-replica fleet and diagnose it (with "
                          "--postmortem/--streaming: the matching "
@@ -1294,11 +1432,19 @@ def main(argv: "list[str] | None" = None) -> int:
     args = ap.parse_args(argv)
     modes = [args.rendezvous, args.urls, args.gateway, args.serving,
              args.postmortem, args.streaming, args.perf, args.checkpoints,
-             args.selftest or None]
+             args.sweep, args.selftest or None]
     if not any(m for m in modes):
         ap.error("pick a mode: --rendezvous/--urls/--gateway/--serving/"
                  "--postmortem/--streaming/--perf/--checkpoints/"
-                 "--selftest")
+                 "--sweep/--selftest")
+    if args.sweep is not None:
+        if args.selftest:
+            return sweep_selftest()
+        if not args.sweep:
+            ap.error("--sweep needs a sweep checkpoint directory "
+                     "(or --selftest)")
+        print(diagnose_sweep(args.sweep))
+        return 0
     if args.checkpoints is not None:
         if args.selftest:
             return checkpoints_selftest()
