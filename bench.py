@@ -2184,6 +2184,69 @@ def bench_automl_sweep() -> dict:
     }
 
 
+def bench_trainer_elastic() -> dict:
+    """Elastic data-parallel training rows: the SAME GBDT fit over 2
+    REAL fleet worker processes at a fixed world, and again with a
+    forced world resize every 3 boosting rounds (kill a worker at one
+    boundary, respawn it at the next) — paired wall times plus the
+    re-shard barrier cost per membership event. Byte-identity of the two
+    final models is asserted: the elastic contract says the membership
+    schedule must never change the bits, so any divergence here is a
+    correctness failure, not noise. Like the sweep rows this is NOT a
+    speedup claim on CI hosts — the paired rows exist to track the
+    orchestration cost (drain + checkpoint + configure) per re-shard."""
+    import tempfile
+
+    from mmlspark_tpu.resilience.elastic_fleet import ElasticGBDTFit
+
+    rng = np.random.default_rng(23)
+    x = rng.normal(size=(2048, 12))
+    y = x[:, 0] * 2.0 - x[:, 1] + 0.1 * rng.normal(size=2048)
+    rounds = 10
+
+    def run(d, hook=None):
+        fit = ElasticGBDTFit(
+            d, objective="regression", num_iterations=rounds,
+            num_leaves=15, max_bin=63, min_data_in_leaf=5, seed=3,
+            n_workers=2, num_virtual=16, step_hook=hook,
+            request_timeout_s=120.0)
+        t0 = time.perf_counter()
+        fit.fit(x, y)
+        return fit, time.perf_counter() - t0
+
+    with tempfile.TemporaryDirectory() as d:
+        fit_fixed, s_fixed = run(os.path.join(d, "fixed"))
+
+        state = {"last": -1}
+
+        def hook(fit):
+            # one membership change per 3rd step boundary: kill slot 0,
+            # then respawn it at the next trigger, alternating
+            if fit.step and fit.step % 3 == 0 and fit.step != state["last"]:
+                state["last"] = fit.step
+                dead = fit.fleet.dead_slots()
+                if dead:
+                    fit.fleet.respawn(dead[0])
+                else:
+                    fit.fleet.kill(0)
+
+        fit_resize, s_resize = run(os.path.join(d, "resize"), hook)
+
+    if fit_fixed.model_digest() != fit_resize.model_digest():
+        raise RuntimeError(
+            "elastic digests diverged across world-size schedules")
+    # the initial world formation is a join too; resize events are the rest
+    n_events = max(len(fit_resize.reshards) - 1, 1)
+    return {
+        "rounds": rounds,
+        "fixed_steps_per_sec": rounds / s_fixed,
+        "resize_steps_per_sec": rounds / s_resize,
+        "resize_events": n_events,
+        "resize_overhead": s_resize / s_fixed,
+        "reshard_cost_seconds": max(s_resize - s_fixed, 0.0) / n_events,
+    }
+
+
 def bench_streaming_parallel() -> dict:
     """Partition-parallel streaming speedup: the SAME keyed stateful
     pipeline run at P=1 (plain StreamingQuery) and P=2/P=4
@@ -2469,6 +2532,12 @@ def _run_suite(platform: str) -> dict:
     except Exception as e:  # noqa: BLE001 — sweep row is auxiliary
         print(f"bench: automl sweep bench failed ({e!r})", file=sys.stderr)
         automl_sweep = None
+    try:
+        trainer_elastic = bench_trainer_elastic()
+    except Exception as e:  # noqa: BLE001 — elastic row is auxiliary
+        print(f"bench: trainer elastic bench failed ({e!r})",
+              file=sys.stderr)
+        trainer_elastic = None
     _write_metrics_snapshot()
 
     resident = runner.get("resident_images_per_sec", 0.0)
@@ -2658,6 +2727,21 @@ def _run_suite(platform: str) -> dict:
                 if automl_sweep else None,
             "automl_sweep_fits": (
                 automl_sweep["fits"] if automl_sweep else None),
+            "trainer_elastic_fixed_steps_per_sec": round(
+                trainer_elastic["fixed_steps_per_sec"], 3)
+                if trainer_elastic else None,
+            "trainer_elastic_resize_steps_per_sec": round(
+                trainer_elastic["resize_steps_per_sec"], 3)
+                if trainer_elastic else None,
+            "trainer_elastic_resize_overhead": round(
+                trainer_elastic["resize_overhead"], 3)
+                if trainer_elastic else None,
+            "trainer_elastic_reshard_cost_seconds": round(
+                trainer_elastic["reshard_cost_seconds"], 3)
+                if trainer_elastic else None,
+            "trainer_elastic_resize_events": (
+                trainer_elastic["resize_events"]
+                if trainer_elastic else None),
             "headroom_note": (
                 "gbdt fit is HBM-bound (see gbdt_modeled_hbm_* vs chip peak); "
                 "end-to-end runner throughput is host->device transfer bound: "

@@ -609,6 +609,22 @@ class Booster:
         }
 
     @staticmethod
+    def from_tree_dicts(
+        trees: "list[dict[str, np.ndarray]]",
+        tree_classes: "list[int]",
+        mapper: BinMapper,
+        opts: TrainOptions,
+        init: float,
+        feature_names: "list[str]",
+    ) -> "Booster":
+        """Assemble a Booster from externally-grown per-tree dicts (the
+        `TreeBuilder.to_dict` layout) — the entry point for distributed
+        growers (resilience.elastic_fleet) whose trees are built outside
+        `Booster.train` but must score/serialize exactly like its own."""
+        return Booster._from_tree_dicts(
+            trees, tree_classes, mapper, opts, init, feature_names)
+
+    @staticmethod
     def _from_tree_dicts(
         trees: list[dict[str, np.ndarray]],
         tree_classes: list[int],

@@ -108,6 +108,42 @@ class _GBDTParams(HasFeaturesCol, HasLabelCol, HasWeightCol, HasPredictionCol):
         0, "boosting rounds between snapshots (0 = checkpointing off)",
         ptype=int,
     )
+    # Elastic data-parallel fit over ServingFleet worker PROCESSES
+    # (resilience/elastic_fleet.py): workers hold binned shards and ship
+    # per-virtual-shard histograms, the driver decides every split, and
+    # the fleet may grow or shrink mid-fit without changing the model.
+    elastic_workers = Param(
+        0, "fit data-parallel over N elastic fleet workers (0 = in-process)",
+        ptype=int,
+    )
+    elastic_num_virtual = Param(
+        32, "virtual shards for the elastic fit (fixes the histogram merge "
+        "order independently of the live worker count)", ptype=int,
+    )
+
+    def _check_elastic_supported(self) -> None:
+        """The elastic grower covers the deterministic depth-wise core;
+        reject options it would silently ignore."""
+        if self.get("boosting_type") != "gbdt":
+            raise ValueError("elastic_workers supports boosting_type='gbdt'")
+        if self.get("bagging_freq") or self.get("bagging_fraction") != 1.0:
+            raise ValueError("elastic_workers does not support bagging")
+        if self.get("feature_fraction") != 1.0:
+            raise ValueError(
+                "elastic_workers does not support feature_fraction")
+        if self.get("early_stopping_round"):
+            raise ValueError(
+                "elastic_workers does not support early stopping")
+        if self.get("categorical_slot_indexes"):
+            raise ValueError(
+                "elastic_workers does not support categorical features")
+        if self.get("lambda_l1"):
+            raise ValueError("elastic_workers does not support lambda_l1")
+        if self.get("model_string"):
+            raise ValueError(
+                "elastic_workers does not support warm starts (model_string)")
+        if self.get("weight_col"):
+            raise ValueError("elastic_workers does not support weight_col")
 
     def _train_options(self, objective: str, num_class: int = 1) -> TrainOptions:
         init_model = None
@@ -227,9 +263,22 @@ class GBDTClassifier(_GBDTParams, Estimator):
             objective = "binary" if num_class <= 2 else "multiclass"
         opts = self._train_options(objective, num_class=num_class)
         opts.is_unbalance = self.get("is_unbalance")
-        booster = Booster.train(
-            x, y_idx, opts, weights=w, valid=valid, mesh=mesh, log=self._log()
-        )
+        if int(self.get("elastic_workers") or 0) > 0:
+            self._check_elastic_supported()
+            if objective != "binary":
+                raise ValueError(
+                    "elastic_workers supports the binary objective only")
+            if self.get("is_unbalance"):
+                raise ValueError(
+                    "elastic_workers does not support is_unbalance")
+            from ..resilience.elastic_fleet import elastic_fit_gbdt
+
+            booster = elastic_fit_gbdt(self, x, y_idx, objective)
+        else:
+            booster = Booster.train(
+                x, y_idx, opts, weights=w, valid=valid, mesh=mesh,
+                log=self._log()
+            )
         booster.class_labels = [float(c) for c in classes]
         model = GBDTClassificationModel(
             features_col=self.get("features_col"),
@@ -336,9 +385,15 @@ class GBDTRegressor(_GBDTParams, Estimator):
         opts.alpha = self.get("alpha")
         opts.tweedie_variance_power = self.get("tweedie_variance_power")
         opts.fair_c = self.get("fair_c")
-        booster = Booster.train(
-            x, y, opts, weights=w, valid=valid, mesh=mesh, log=self._log()
-        )
+        if int(self.get("elastic_workers") or 0) > 0:
+            self._check_elastic_supported()
+            from ..resilience.elastic_fleet import elastic_fit_gbdt
+
+            booster = elastic_fit_gbdt(self, x, y, self.get("objective"))
+        else:
+            booster = Booster.train(
+                x, y, opts, weights=w, valid=valid, mesh=mesh, log=self._log()
+            )
         model = GBDTRegressionModel(
             features_col=self.get("features_col"),
             prediction_col=self.get("prediction_col"),

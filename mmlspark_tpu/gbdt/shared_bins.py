@@ -29,6 +29,7 @@ sweeping `max_bin` must re-bin, not inherit the wrong boundaries.
 from __future__ import annotations
 
 import hashlib
+import json
 from typing import Any
 
 import numpy as np
@@ -36,7 +37,7 @@ import numpy as np
 from ..observability.sanitizer import make_lock
 
 __all__ = ["SharedBinContext", "get_shared_bin_context",
-           "set_shared_bin_context", "bin_counters"]
+           "set_shared_bin_context", "bin_counters", "mapper_digest"]
 
 _COUNTERS = (
     ("mmlspark_tpu_gbdt_bin_builds_total",
@@ -191,6 +192,15 @@ def get_shared_bin_context() -> "SharedBinContext | None":
 def note_bin_build() -> None:
     """Count a normal (non-shared) in-train BinMapper build."""
     _count("mmlspark_tpu_gbdt_bin_builds_total")
+
+
+def mapper_digest(mapper: Any) -> str:
+    """Canonical digest of a BinMapper's boundaries. Elastic workers
+    verify the mapper shipped in the training spec against this before
+    binning locally: identical boundaries on every member are the
+    precondition for the cross-process histogram merge to be exact."""
+    doc = json.dumps(mapper.to_dict(), sort_keys=True)
+    return hashlib.blake2b(doc.encode("utf-8"), digest_size=16).hexdigest()
 
 
 def lookup_shared_bins(x: Any, opts: Any) -> "_SharedHit | None":

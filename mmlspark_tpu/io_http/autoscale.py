@@ -56,6 +56,7 @@ class FleetAutoscaler:
         cooldown_s: float = 30.0,
         clock: Any = None,
         metrics: Any = None,
+        extra_up: "dict[str, float] | None" = None,
     ):
         if not 1 <= min_replicas <= max_replicas:
             raise ValueError(
@@ -69,6 +70,11 @@ class FleetAutoscaler:
         self.up_p99_s = float(up_p99_s)
         self.up_shed_rate = float(up_shed_rate)
         self.up_burn_rate = float(up_burn_rate)
+        # domain-specific up-thresholds beyond the four serving SLOs:
+        # {signal_key: threshold} — elastic training adds step-time p99
+        # and straggler-wait here; extra signals obey the same
+        # down_fraction calm band as the built-ins
+        self.extra_up = {k: float(v) for k, v in (extra_up or {}).items()}
         # calm = every signal under down_fraction * its up threshold —
         # the hysteresis BAND between the up and down trigger points
         self.down_fraction = float(down_fraction)
@@ -127,6 +133,10 @@ class FleetAutoscaler:
             reasons.append("shed_rate")
         if sig.get("burn_rate", 0.0) > self.up_burn_rate:
             reasons.append("burn_rate")
+        for key, threshold in self.extra_up.items():
+            v = sig.get(key, 0.0)
+            if v == v and v > threshold:  # NaN-safe
+                reasons.append(key)
         return reasons
 
     def _calm(self, sig: dict) -> bool:
@@ -134,10 +144,18 @@ class FleetAutoscaler:
         p99 = sig.get("p99_latency_s", 0.0)
         if p99 != p99:
             p99 = 0.0
-        return (sig.get("queue_depth", 0.0) <= self.up_queue_depth * f
+        if not (sig.get("queue_depth", 0.0) <= self.up_queue_depth * f
                 and p99 <= self.up_p99_s * f
                 and sig.get("shed_rate", 0.0) <= self.up_shed_rate * f
-                and sig.get("burn_rate", 0.0) <= self.up_burn_rate * f)
+                and sig.get("burn_rate", 0.0) <= self.up_burn_rate * f):
+            return False
+        for key, threshold in self.extra_up.items():
+            v = sig.get(key, 0.0)
+            if v != v:
+                v = 0.0
+            if v > threshold * f:
+                return False
+        return True
 
     # -- control loop --------------------------------------------------- #
 

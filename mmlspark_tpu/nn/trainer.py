@@ -94,9 +94,33 @@ class DNNLearner(HasFeaturesCol, HasLabelCol, Estimator):
         ptype=int,
     )
 
+    # Elastic data-parallel fit over ServingFleet worker PROCESSES
+    # (resilience/elastic_fleet.py): the driver owns the batch order and
+    # optimizer, workers own gradient shards, and the fleet may grow or
+    # shrink mid-fit without changing the resulting model's bytes.
+    elastic_workers = Param(
+        0, "fit data-parallel over N elastic fleet workers (0 = in-process)",
+        ptype=int,
+    )
+    elastic_num_virtual = Param(
+        32, "virtual shards for the elastic fit (fixes the gradient merge "
+        "order independently of the live worker count)", ptype=int,
+    )
+
     init_bundle: ModelBundle | None = None  # programmatic warm start
 
     def _fit(self, table: Table) -> "DNNModel":
+        if int(self.get("elastic_workers") or 0) > 0:
+            if self.init_bundle is not None or self.get("init_bundle_path"):
+                raise ValueError(
+                    "elastic_workers does not support warm starts "
+                    "(init_bundle / init_bundle_path)")
+            if self.get("trainable_prefixes"):
+                raise ValueError(
+                    "elastic_workers does not support trainable_prefixes")
+            from ..resilience.elastic_fleet import elastic_fit_dnn
+
+            return elastic_fit_dnn(self, table)
         x_col = table[self.get("features_col")]
         x = np.stack(x_col) if isinstance(x_col, list) else np.asarray(x_col)
         y = np.asarray(table[self.get("label_col")])

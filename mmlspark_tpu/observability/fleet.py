@@ -326,6 +326,10 @@ GAUGE_MERGE_POLICIES: dict[str, str] = {
     "mmlspark_tpu_gateway_inflight_depth": "sum",
     "mmlspark_tpu_autoscaler_target_replicas_count": "last",
     "mmlspark_tpu_autoscaler_calm_ticks_count": "last",
+    # elastic training world size lives on the ONE driver (the fleet
+    # members it counts don't export it) — "last" over the _count
+    # default (sum), which would multiply it by scrape sources
+    "mmlspark_tpu_training_world_size_count": "last",
     # hot-path serving: batches in flight between dispatch and reply
     # fetch genuinely add across replicas (rule 5: write the intent
     # down, don't inherit it from the _depth suffix default)

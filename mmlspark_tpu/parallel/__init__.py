@@ -19,6 +19,7 @@ from .mesh import (
     local_device_count,
 )
 from . import collectives
+from . import dp
 from .ring_attention import (
     dense_attention,
     ring_attention,
@@ -50,6 +51,7 @@ __all__ = [
     "shard_rows",
     "local_device_count",
     "collectives",
+    "dp",
     "dense_attention",
     "ring_attention",
     "ulysses_attention",

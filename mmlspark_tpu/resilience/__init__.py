@@ -34,6 +34,8 @@ from .supervisor import (PartitionSupervisor, QuerySupervisor,
 from .elastic import (Preempted, PreemptionGuard, RESUMABLE_EXIT_CODE,
                       TrainingCheckpointer, get_active_guard,
                       set_active_guard)
+from .elastic_fleet import (ElasticDNNFit, ElasticGBDTFit,
+                            ElasticWorkerFactory)
 
 __all__ = [
     "Clock",
@@ -62,4 +64,7 @@ __all__ = [
     "RESUMABLE_EXIT_CODE",
     "get_active_guard",
     "set_active_guard",
+    "ElasticWorkerFactory",
+    "ElasticDNNFit",
+    "ElasticGBDTFit",
 ]

@@ -259,12 +259,32 @@ class TrainingCheckpointer:
             return False, "checksum-mismatch", None
         return True, "ok", payload
 
-    def load_latest(self) -> "tuple[bytes, dict] | None":
+    def load_latest(self, max_world_epoch: "int | None" = None
+                    ) -> "tuple[bytes, dict] | None":
         """Newest snapshot that verifies, or None. Corrupt/truncated
         snapshots are skipped (counted + flight-recorded) and the walk
         falls back to the next-newest verified one — a kill mid-write
-        costs at most the last `checkpoint_every_n` of progress."""
+        costs at most the last `checkpoint_every_n` of progress.
+
+        `max_world_epoch` fences elastic training resumes: a snapshot
+        whose `meta["world_epoch"]` is NEWER than the caller's world
+        epoch was written by a LATER membership generation — the caller
+        is a zombie (a preempted shard resurrected after the fleet moved
+        on) and must not adopt state from a future it never joined.
+        Refused snapshots are counted and the walk falls back to one the
+        caller's epoch may legitimately see."""
         for entry in reversed(self._manifest["entries"]):
+            if max_world_epoch is not None:
+                snap_epoch = entry.get("meta", {}).get("world_epoch")
+                if snap_epoch is not None and \
+                        int(snap_epoch) > int(max_world_epoch):
+                    _count("mmlspark_tpu_checkpoint_refused_total",
+                           "snapshots refused: newer world epoch than the "
+                           "restoring driver (zombie fence)")
+                    _record("checkpoint.refused", dir=self.directory,
+                            seq=entry["seq"], snapshot_epoch=int(snap_epoch),
+                            caller_epoch=int(max_world_epoch))
+                    continue
             path = os.path.join(self.directory, entry["file"])
             ok, detail, payload = self.verify_file(path)
             if ok and entry.get("blake2b") not in (

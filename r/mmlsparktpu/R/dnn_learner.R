@@ -23,9 +23,11 @@
 #' @param fused_epochs scan a whole epoch in one dispatch
 #' @param fused_epoch_budget_mb max table MB resident on device for the fused epoch path
 #' @param prefetch_depth minibatches prepared ahead in the streamed epoch loop (0 = sync)
+#' @param elastic_workers fit data-parallel over N elastic fleet workers (0 = in-process)
+#' @param elastic_num_virtual virtual shards for the elastic fit (fixes the gradient merge order independently of the live worker count)
 #' @param only.model return the fitted model without transforming x (the reference's unfit.model)
 #' @export
-ml_dnn_learner <- function(x, label_col = "label", features_col = "features", architecture = "mlp", model_config = NULL, loss = "softmax_ce", optimizer = "adam", learning_rate = 0.001, epochs = 5L, batch_size = 128L, use_mesh = TRUE, seed = 0L, checkpoint_dir = NULL, checkpoint_every_n = 1L, init_bundle_path = NULL, bfloat16 = TRUE, remat = FALSE, trainable_prefixes = NULL, fused_epochs = TRUE, fused_epoch_budget_mb = 512L, prefetch_depth = 2L, only.model = FALSE)
+ml_dnn_learner <- function(x, label_col = "label", features_col = "features", architecture = "mlp", model_config = NULL, loss = "softmax_ce", optimizer = "adam", learning_rate = 0.001, epochs = 5L, batch_size = 128L, use_mesh = TRUE, seed = 0L, checkpoint_dir = NULL, checkpoint_every_n = 1L, init_bundle_path = NULL, bfloat16 = TRUE, remat = FALSE, trainable_prefixes = NULL, fused_epochs = TRUE, fused_epoch_budget_mb = 512L, prefetch_depth = 2L, elastic_workers = 0L, elastic_num_virtual = 32L, only.model = FALSE)
 {
   params <- list()
   if (!is.null(label_col)) params$label_col <- as.character(label_col)
@@ -48,5 +50,7 @@ ml_dnn_learner <- function(x, label_col = "label", features_col = "features", ar
   if (!is.null(fused_epochs)) params$fused_epochs <- as.logical(fused_epochs)
   if (!is.null(fused_epoch_budget_mb)) params$fused_epoch_budget_mb <- as.integer(fused_epoch_budget_mb)
   if (!is.null(prefetch_depth)) params$prefetch_depth <- as.integer(prefetch_depth)
+  if (!is.null(elastic_workers)) params$elastic_workers <- as.integer(elastic_workers)
+  if (!is.null(elastic_num_virtual)) params$elastic_num_virtual <- as.integer(elastic_num_virtual)
   .tpu_apply_stage("mmlspark_tpu.nn.trainer.DNNLearner", params, x, is_estimator = TRUE, only.model = only.model)
 }

@@ -41,13 +41,15 @@
 #' @param seed master rng seed
 #' @param checkpoint_dir preemption-tolerant training: snapshot the booster-so-far here and resume from the newest verified snapshot (resilience/elastic)
 #' @param checkpoint_every_n boosting rounds between snapshots (0 = checkpointing off)
+#' @param elastic_workers fit data-parallel over N elastic fleet workers (0 = in-process)
+#' @param elastic_num_virtual virtual shards for the elastic fit (fixes the histogram merge order independently of the live worker count)
 #' @param objective regression|l1|l2|huber|fair|poisson|quantile|mape|gamma|tweedie
 #' @param alpha huber/quantile alpha
 #' @param tweedie_variance_power tweedie variance power (1..2)
 #' @param fair_c fair-loss c
 #' @param only.model return the fitted model without transforming x (the reference's unfit.model)
 #' @export
-ml_gbdt_regressor <- function(x, prediction_col = "prediction", weight_col = NULL, label_col = "label", features_col = "features", boosting_type = "gbdt", num_iterations = 100L, learning_rate = 0.1, num_leaves = 31L, max_bin = 255L, max_depth = -1L, min_data_in_leaf = 20L, min_sum_hessian_in_leaf = 0.001, lambda_l1 = 0.0, lambda_l2 = 0.0, min_gain_to_split = 0.0, bagging_fraction = 1.0, bagging_freq = 0L, bagging_seed = 3L, feature_fraction = 1.0, early_stopping_round = 0L, validation_fraction = 0.0, categorical_slot_indexes = NULL, bin_dtype = "int32", device_binning = FALSE, bin_construct_sample_cnt = 200000L, cat_smooth = 10.0, cat_l2 = 10.0, max_cat_threshold = 32L, model_string = NULL, boost_from_average = TRUE, use_mesh = FALSE, tree_learner = "data_parallel", top_k = 20L, deterministic = FALSE, verbosity = 1L, seed = 0L, checkpoint_dir = NULL, checkpoint_every_n = 0L, objective = "regression", alpha = 0.9, tweedie_variance_power = 1.5, fair_c = 1.0, only.model = FALSE)
+ml_gbdt_regressor <- function(x, prediction_col = "prediction", weight_col = NULL, label_col = "label", features_col = "features", boosting_type = "gbdt", num_iterations = 100L, learning_rate = 0.1, num_leaves = 31L, max_bin = 255L, max_depth = -1L, min_data_in_leaf = 20L, min_sum_hessian_in_leaf = 0.001, lambda_l1 = 0.0, lambda_l2 = 0.0, min_gain_to_split = 0.0, bagging_fraction = 1.0, bagging_freq = 0L, bagging_seed = 3L, feature_fraction = 1.0, early_stopping_round = 0L, validation_fraction = 0.0, categorical_slot_indexes = NULL, bin_dtype = "int32", device_binning = FALSE, bin_construct_sample_cnt = 200000L, cat_smooth = 10.0, cat_l2 = 10.0, max_cat_threshold = 32L, model_string = NULL, boost_from_average = TRUE, use_mesh = FALSE, tree_learner = "data_parallel", top_k = 20L, deterministic = FALSE, verbosity = 1L, seed = 0L, checkpoint_dir = NULL, checkpoint_every_n = 0L, elastic_workers = 0L, elastic_num_virtual = 32L, objective = "regression", alpha = 0.9, tweedie_variance_power = 1.5, fair_c = 1.0, only.model = FALSE)
 {
   params <- list()
   if (!is.null(prediction_col)) params$prediction_col <- as.character(prediction_col)
@@ -88,6 +90,8 @@ ml_gbdt_regressor <- function(x, prediction_col = "prediction", weight_col = NUL
   if (!is.null(seed)) params$seed <- as.integer(seed)
   if (!is.null(checkpoint_dir)) params$checkpoint_dir <- as.character(checkpoint_dir)
   if (!is.null(checkpoint_every_n)) params$checkpoint_every_n <- as.integer(checkpoint_every_n)
+  if (!is.null(elastic_workers)) params$elastic_workers <- as.integer(elastic_workers)
+  if (!is.null(elastic_num_virtual)) params$elastic_num_virtual <- as.integer(elastic_num_virtual)
   if (!is.null(objective)) params$objective <- as.character(objective)
   if (!is.null(alpha)) params$alpha <- as.double(alpha)
   if (!is.null(tweedie_variance_power)) params$tweedie_variance_power <- as.double(tweedie_variance_power)

@@ -1,0 +1,703 @@
+"""Elastic data-parallel training (ISSUE 17): partition-invariant shard
+math in `parallel.dp`, the elastic worker protocol (world-epoch fencing,
+preemption drain), driver digest parity — the SAME model bytes at any
+world size and under kill/add chaos — the zombie-fencing checkpoint
+refusal, autoscaler SLO wiring, metrics, and the diagnose table.
+
+The fast tier drives the full driver protocol through in-process
+handlers (`_LocalFleet`, the harness `tools/diagnose.py --training
+--selftest` uses); the slow tier repeats the chaos schedule against
+REAL `ServingFleet` worker processes, including a SIGKILL landing
+inside the re-shard barrier itself.
+"""
+
+import hashlib
+import json
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.schema import Table
+from mmlspark_tpu.io_http.autoscale import FleetAutoscaler
+from mmlspark_tpu.io_http.schema import HTTPRequestData
+from mmlspark_tpu.observability.fleet import GAUGE_MERGE_POLICIES
+from mmlspark_tpu.observability.metrics import (MetricsRegistry,
+                                                set_default_registry)
+from mmlspark_tpu.parallel import dp
+from mmlspark_tpu.resilience.elastic import TrainingCheckpointer
+from mmlspark_tpu.resilience.elastic_fleet import (WORLD_SIZE_GAUGE,
+                                                   ElasticDNNFit,
+                                                   ElasticGBDTFit,
+                                                   ElasticWorkerFactory)
+from mmlspark_tpu.resilience.policy import FakeClock
+
+
+# --------------------------------------------------------------------- #
+# harness: in-process fleet speaking the real worker protocol           #
+# --------------------------------------------------------------------- #
+
+
+class _LocalFleet:
+    """Handler-per-URL stand-in for ServingFleet: the full driver
+    protocol (configure/grad/hist/split/...) with zero processes."""
+
+    def __init__(self, checkpoint_dir):
+        self.checkpoint_dir = checkpoint_dir
+        self.handlers = {}
+        self._n = 0
+
+    def add(self):
+        url = f"http://local/{self._n:03d}"
+        self._n += 1
+        self.handlers[url] = ElasticWorkerFactory(
+            self.checkpoint_dir, guard=False)()
+        return url
+
+    def remove_first(self):
+        del self.handlers[sorted(self.handlers)[0]]
+
+    urls = property(lambda self: list(self.handlers))
+    n_live = property(lambda self: len(self.handlers))
+
+    def watch(self, cb):
+        pass
+
+    def dump_all(self, trigger=""):
+        return 0
+
+    def stop(self):
+        pass
+
+
+def _post_fn(fleet):
+    def post(url, body):
+        handler = fleet.handlers.get(url)
+        if handler is None:
+            raise RuntimeError("dead member")
+        out = handler(Table(
+            {"request": [HTTPRequestData.from_json("/", body)]}))
+        rep = out["reply"][0]
+        doc = json.loads(bytes(rep.entity).decode("utf-8"))
+        if rep.status_code != 200:
+            raise RuntimeError(doc.get("error", "handler error"))
+        return doc
+    return post
+
+
+def _raw_post(handler, body):
+    """(status_code, doc) — for protocol tests that want the 500s too."""
+    out = handler(Table(
+        {"request": [HTTPRequestData.from_json("/", body)]}))
+    rep = out["reply"][0]
+    return rep.status_code, json.loads(bytes(rep.entity).decode("utf-8"))
+
+
+def _gbdt_fit(d, x, y, n_workers, *, num_virtual=8, iters=5, hook=None,
+              metrics=None, checkpoint_every_n=0, barrier_hook=None):
+    fleet = _LocalFleet(d)
+    fit = ElasticGBDTFit(
+        d, objective="regression", num_iterations=iters, num_leaves=7,
+        max_bin=15, min_data_in_leaf=1, seed=0, n_workers=n_workers,
+        num_virtual=num_virtual, fleet=fleet, post=_post_fn(fleet),
+        step_hook=hook, barrier_hook=barrier_hook, metrics=metrics,
+        checkpoint_every_n=checkpoint_every_n)
+    for _ in range(n_workers):
+        fleet.add()
+    booster = fit.fit(x, y)
+    return fit, booster
+
+
+def _dnn_fit(d, x, y, n_workers, *, num_virtual=8, epochs=2, hook=None):
+    fleet = _LocalFleet(d)
+    fit = ElasticDNNFit(
+        d, architecture="mlp", model_config={"features": [8]},
+        loss="softmax_ce", learning_rate=0.05, epochs=epochs,
+        batch_size=8, seed=0, n_workers=n_workers,
+        num_virtual=num_virtual, fleet=fleet, post=_post_fn(fleet),
+        step_hook=hook)
+    for _ in range(n_workers):
+        fleet.add()
+    bundle = fit.fit(x, y)
+    return fit, bundle
+
+
+def _reg_data(n=96, f=4, seed=11):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, f))
+    y = x[:, 0] * 2.0 - x[:, 1] + 0.05 * rng.normal(size=n)
+    return x, y
+
+
+def _cls_data(n=48, f=4, seed=5):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, f)).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.int64)
+    return x, y
+
+
+# --------------------------------------------------------------------- #
+# dp: the partition-invariant shard math                                #
+# --------------------------------------------------------------------- #
+
+
+class TestShardMath:
+    def test_virtual_shard_is_content_addressed(self):
+        for rid in (0, 1, 17, 123456789):
+            want = int.from_bytes(hashlib.blake2b(
+                str(rid).encode(), digest_size=8).digest(), "big") % 32
+            assert dp.virtual_shard_of(rid, 32) == want
+        a = dp.shard_assignment(64, 16)
+        assert a.dtype == np.int32 and a.shape == (64,)
+        assert all(a[i] == dp.virtual_shard_of(i, 16) for i in range(64))
+
+    @pytest.mark.parametrize("world", [1, 2, 3, 4, 5, 7])
+    def test_shards_partition_exactly(self, world):
+        owned = [dp.shards_of_member(r, world, 32) for r in range(world)]
+        flat = [s for lst in owned for s in lst]
+        assert sorted(flat) == list(range(32))      # each shard once
+        for r, lst in enumerate(owned):
+            for s in lst:
+                assert dp.owner_of_shard(s, world) == r
+
+    def test_rank_outside_world_raises(self):
+        with pytest.raises(ValueError):
+            dp.shards_of_member(3, 3, 32)
+        with pytest.raises(ValueError):
+            dp.owner_of_shard(0, 0)
+
+    def test_fold_partials_ignores_insertion_order(self):
+        rng = np.random.default_rng(0)
+        parts = {s: rng.normal(size=5) for s in (9, 0, 3, 14)}
+        a = dp.fold_partials(dict(sorted(parts.items())), 16)
+        b = dp.fold_partials(dict(reversed(sorted(parts.items()))), 16)
+        assert a.tobytes() == b.tobytes()
+        with pytest.raises(ValueError):
+            dp.fold_partials({}, 16)
+
+    def test_global_batch_order_matches_trainer_stream(self):
+        order = dp.global_batch_order(10, 4, 2, seed=7)
+        assert order.shape == (4, 4) and order.dtype == np.int64
+        rng = np.random.default_rng(7)
+        want = []
+        for _ in range(2):
+            perm = rng.permutation(10)
+            want += [perm[0:4], perm[4:8]]          # full batches only
+        np.testing.assert_array_equal(order, np.stack(want))
+        # P is not an argument: two draws are identical by construction
+        np.testing.assert_array_equal(order, dp.global_batch_order(10, 4, 2, 7))
+
+    def test_global_batch_order_small_n_clamps_batch(self):
+        order = dp.global_batch_order(3, 8, 1, seed=0)
+        assert order.shape == (1, 3)
+        assert sorted(order[0].tolist()) == [0, 1, 2]
+
+    def test_wire_codec_roundtrip(self):
+        for a in (np.arange(12, dtype=np.float32).reshape(3, 4),
+                  np.array([1, -2, 3], np.int64),
+                  np.zeros((2, 0), np.float64)):
+            b = dp.decode_array(dp.encode_array(a))
+            assert b.dtype == a.dtype and b.shape == a.shape
+            np.testing.assert_array_equal(a, b)
+
+    def test_hist_partial_matches_naive_reference(self):
+        rng = np.random.default_rng(3)
+        n, f, nb = 40, 3, 6
+        bins = rng.integers(0, nb, size=(n, f)).astype(np.int32)
+        grad = rng.normal(size=n)
+        hess = rng.uniform(0.1, 1.0, size=n)
+        node = rng.integers(0, 3, size=n).astype(np.int32)
+        got = dp.hist_partial(bins, grad, hess, node, [2, 0], nb)
+        assert got.shape == (2, f, nb, 3)
+        want = np.zeros_like(got)
+        for slot, nd in enumerate([0, 2]):          # ascending node order
+            for i in range(n):
+                if node[i] != nd:
+                    continue
+                for j in range(f):
+                    want[slot, j, bins[i, j], 0] += grad[i]
+                    want[slot, j, bins[i, j], 1] += hess[i]
+                    want[slot, j, bins[i, j], 2] += 1
+        np.testing.assert_allclose(got, want, rtol=0, atol=1e-12)
+
+    def test_best_split_picks_first_max_and_honors_floors(self):
+        hist = np.zeros((1, 3, 3))
+        hist[0, 0] = [-4.0, 4.0, 4.0]               # bin 0: 4 rows, g=-4
+        hist[0, 2] = [4.0, 4.0, 4.0]                # bin 2: 4 rows, g=+4
+        parent = (0.0, 8.0, 8.0)
+        sp = dp.best_split(hist, parent, min_data_in_leaf=1)
+        assert sp is not None
+        assert (sp["feature"], sp["bin"]) == (0, 0)  # tie -> first max
+        assert sp["gain"] == pytest.approx(4.0)
+        assert sp["left"] == (-4.0, 4.0, 4.0)
+        assert sp["right"] == (4.0, 4.0, 4.0)
+        assert dp.best_split(hist, parent, min_data_in_leaf=5) is None
+        # the last bin's "left" is everything: never a split
+        assert dp.best_split(hist[:, :1, :], parent) is None
+
+    def test_tree_builder_roundtrip_through_walk(self):
+        t = dp.TreeBuilder(5)
+        left, right = t.alloc_pair()
+        t.set_split(0, feature=1, threshold_bin=2, left=left, right=right,
+                    gain=1.0)
+        t.set_leaf(left, -0.5)
+        t.set_leaf(right, 0.5)
+        d = t.to_dict()
+        bins = np.array([[0, 1], [0, 4]], np.int32)  # f1: 1<=2 left, 4 right
+        np.testing.assert_allclose(
+            dp.walk_tree_dict(d, bins), [-0.5, 0.5])
+
+
+# --------------------------------------------------------------------- #
+# satellite 1: zombie fencing in TrainingCheckpointer.load_latest       #
+# --------------------------------------------------------------------- #
+
+
+class TestZombieFence:
+    def _store(self, tmp_path):
+        ck = TrainingCheckpointer(str(tmp_path / "ck"))
+        for epoch in (1, 2, 3):
+            ck.save(f"epoch-{epoch}".encode(), tag=f"e{epoch}",
+                    meta={"world_epoch": epoch, "kind": "gbdt"})
+        return ck
+
+    def test_unfenced_load_returns_newest(self, tmp_path):
+        ck = self._store(tmp_path)
+        payload, entry = ck.load_latest()
+        assert payload == b"epoch-3"
+        assert entry["meta"]["world_epoch"] == 3
+
+    def test_newer_world_epoch_is_refused(self, tmp_path):
+        ck = self._store(tmp_path)
+        payload, entry = ck.load_latest(max_world_epoch=2)
+        assert payload == b"epoch-2"                # fell back one entry
+        assert entry["meta"]["world_epoch"] == 2
+
+    def test_all_newer_means_no_snapshot(self, tmp_path):
+        ck = self._store(tmp_path)
+        assert ck.load_latest(max_world_epoch=0) is None
+
+    def test_refusals_are_counted(self, tmp_path):
+        ck = self._store(tmp_path)
+        reg = MetricsRegistry()
+        old = set_default_registry(reg)
+        try:
+            ck.load_latest(max_world_epoch=1)
+        finally:
+            set_default_registry(old)
+        text = reg.render_prometheus()
+        assert "mmlspark_tpu_checkpoint_refused_total 2" in text
+
+    def test_snapshot_without_epoch_meta_is_not_fenced(self, tmp_path):
+        ck = TrainingCheckpointer(str(tmp_path / "ck"))
+        ck.save(b"legacy", tag="old")
+        payload, _ = ck.load_latest(max_world_epoch=0)
+        assert payload == b"legacy"
+
+
+# --------------------------------------------------------------------- #
+# worker protocol: fencing, errors, preemption drain                    #
+# --------------------------------------------------------------------- #
+
+
+class TestWorkerProtocol:
+    def _spec_dir(self, tmp_path):
+        """A checkpoint dir holding a real GBDT spec (written by a
+        micro-fit) that fresh handlers can lazy-load."""
+        d = str(tmp_path / "proto")
+        x, y = _reg_data(n=40)
+        _gbdt_fit(d, x, y, 1, iters=1, num_virtual=4)
+        return d
+
+    def test_configure_status_roundtrip(self, tmp_path):
+        h = ElasticWorkerFactory(self._spec_dir(tmp_path), guard=False)()
+        code, doc = _raw_post(h, {
+            "op": "configure", "world_epoch": 7, "shards": [0, 1, 2, 3],
+            "model": {"init_score": 0.0, "trees": []}})
+        assert code == 200 and doc == {"ok": True, "world_epoch": 7}
+        code, doc = _raw_post(h, {"op": "status"})
+        assert code == 200
+        assert doc["kind"] == "gbdt" and doc["world_epoch"] == 7
+        assert doc["shards"] == [0, 1, 2, 3]
+
+    def test_stale_epoch_is_fenced_not_computed(self, tmp_path):
+        h = ElasticWorkerFactory(self._spec_dir(tmp_path), guard=False)()
+        _raw_post(h, {"op": "configure", "world_epoch": 7,
+                      "shards": [0, 1, 2, 3],
+                      "model": {"init_score": 0.0, "trees": []}})
+        code, doc = _raw_post(h, {"op": "hist", "world_epoch": 6,
+                                  "nodes": [0], "step": 0})
+        assert code == 200 and doc.get("stale") is True
+        assert doc["world_epoch"] == 7              # the epoch it holds
+
+    def test_unknown_op_is_a_500_reply_not_a_crash(self, tmp_path):
+        h = ElasticWorkerFactory(self._spec_dir(tmp_path), guard=False)()
+        _raw_post(h, {"op": "configure", "world_epoch": 7,
+                      "shards": [0, 1, 2, 3],
+                      "model": {"init_score": 0.0, "trees": []}})
+        code, doc = _raw_post(h, {"op": "frobnicate", "world_epoch": 7})
+        assert code == 500 and "unknown op" in doc["error"]
+        # the handler survived: the next op still answers
+        code, _ = _raw_post(h, {"op": "status"})
+        assert code == 200
+
+    def test_preemption_drain_finishes_reply_then_exits_75(self, tmp_path):
+        """SIGTERM mid-serve: the in-flight reply flushes, then the
+        worker schedules exit(RESUMABLE_EXIT_CODE) — drain, not drop."""
+        exits = []
+
+        class _Factory(ElasticWorkerFactory):
+            _exit = staticmethod(exits.append)
+
+        old_handler = signal.getsignal(signal.SIGTERM)
+        try:
+            h = _Factory(self._spec_dir(tmp_path), guard=True)()
+            os.kill(os.getpid(), signal.SIGTERM)    # guard flips its Event
+            code, doc = _raw_post(h, {"op": "status"})
+            assert code == 200                      # reply still flushed
+            deadline = time.monotonic() + 5.0
+            while not exits and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert exits == [75]                    # EX_TEMPFAIL
+        finally:
+            signal.signal(signal.SIGTERM, old_handler)
+
+    def test_missing_spec_is_an_error_reply(self, tmp_path):
+        h = ElasticWorkerFactory(str(tmp_path / "nowhere"), guard=False)()
+        code, doc = _raw_post(h, {"op": "configure", "world_epoch": 1,
+                                  "shards": [0]})
+        assert code == 500 and "error" in doc
+
+
+# --------------------------------------------------------------------- #
+# driver: digest parity at any world size, chaos, resume                #
+# --------------------------------------------------------------------- #
+
+
+class TestDigestParity:
+    def test_gbdt_p1_vs_p3_byte_identical(self, tmp_path):
+        x, y = _reg_data()
+        fit1, b1 = _gbdt_fit(str(tmp_path / "p1"), x, y, 1)
+        fit3, b3 = _gbdt_fit(str(tmp_path / "p3"), x, y, 3)
+        assert fit1.model_digest() == fit3.model_digest()
+        np.testing.assert_array_equal(b1.predict(x), b3.predict(x))
+        # 5 boosting rounds must at least beat the constant predictor
+        assert np.sqrt(np.mean((b1.predict(x) - y) ** 2)) < np.std(y)
+
+    def test_dnn_p1_vs_p4_byte_identical(self, tmp_path):
+        """The acceptance byte-compare: the batch-order stream and the
+        gradient fold cannot depend on P."""
+        x, y = _cls_data()
+        fit1, _ = _dnn_fit(str(tmp_path / "p1"), x, y, 1)
+        fit4, _ = _dnn_fit(str(tmp_path / "p4"), x, y, 4)
+        assert fit1.params_digest() == fit4.params_digest()
+        assert fit1.step == fit4.step > 0
+
+    def test_gbdt_chaos_kill_and_add_digest_identical(self, tmp_path):
+        x, y = _reg_data()
+        fit1, _ = _gbdt_fit(str(tmp_path / "calm"), x, y, 1, iters=6)
+
+        calls = {"n": 0}
+
+        def hook(fit):
+            calls["n"] += 1
+            if calls["n"] == 2:
+                fit.fleet.remove_first()            # death mid-fit
+            elif calls["n"] == 4:
+                fit.fleet.add()                     # join mid-fit
+
+        fitc, _ = _gbdt_fit(str(tmp_path / "chaos"), x, y, 2, iters=6,
+                            hook=hook)
+        assert fitc.model_digest() == fit1.model_digest()
+        causes = [r["cause"] for r in fitc.reshards]
+        assert "death" in causes and "join" in causes
+
+    def test_dnn_chaos_kill_and_add_digest_identical(self, tmp_path):
+        x, y = _cls_data()
+        fit1, _ = _dnn_fit(str(tmp_path / "calm"), x, y, 1)
+
+        calls = {"n": 0}
+
+        def hook(fit):
+            calls["n"] += 1
+            if calls["n"] == 3:
+                fit.fleet.remove_first()
+            elif calls["n"] == 6:
+                fit.fleet.add()
+
+        fitc, _ = _dnn_fit(str(tmp_path / "chaos"), x, y, 2, hook=hook)
+        assert fitc.params_digest() == fit1.params_digest()
+        causes = [r["cause"] for r in fitc.reshards]
+        assert "death" in causes and "join" in causes
+
+    def test_gbdt_resume_from_checkpoint_same_digest(self, tmp_path):
+        d = str(tmp_path / "resume")
+        x, y = _reg_data()
+        first, _ = _gbdt_fit(d, x, y, 2, iters=6, checkpoint_every_n=3)
+        second, _ = _gbdt_fit(d, x, y, 2, iters=6, checkpoint_every_n=3)
+        assert second.reshards[0]["cause"] == "resume"
+        assert second.model_digest() == first.model_digest()
+        # the resumed incarnation fences zombies by outrunning the epoch
+        assert second.world_epoch > first.world_epoch
+
+    def test_ctor_validation(self, tmp_path):
+        with pytest.raises(ValueError, match="checkpoint_dir"):
+            ElasticGBDTFit("")
+        with pytest.raises(ValueError, match="n_workers"):
+            ElasticGBDTFit(str(tmp_path / "a"), n_workers=0)
+        with pytest.raises(ValueError, match="num_virtual"):
+            ElasticGBDTFit(str(tmp_path / "b"), n_workers=4, num_virtual=2)
+        with pytest.raises(ValueError, match="objective"):
+            ElasticGBDTFit(str(tmp_path / "c"), objective="poisson")
+
+    def test_estimator_param_validation(self, tmp_path):
+        from mmlspark_tpu.gbdt.estimators import GBDTRegressor
+        from mmlspark_tpu.nn.trainer import DNNLearner
+        from mmlspark_tpu.nn.models import ModelBundle
+
+        x, y = _reg_data(n=32)
+        t = Table({"features": x, "label": y})
+        with pytest.raises(ValueError, match="bagging"):
+            GBDTRegressor(elastic_workers=1, bagging_fraction=0.5,
+                          checkpoint_dir=str(tmp_path / "g")).fit(t)
+        with pytest.raises(ValueError, match="feature_fraction"):
+            GBDTRegressor(elastic_workers=1, feature_fraction=0.5,
+                          checkpoint_dir=str(tmp_path / "g2")).fit(t)
+        learner = DNNLearner(elastic_workers=1,
+                             checkpoint_dir=str(tmp_path / "d"))
+        learner.init_bundle = ModelBundle.init("mlp", (4,), features=[4],
+                                               num_outputs=2)
+        with pytest.raises(ValueError, match="warm start"):
+            learner.fit(Table({"features": x.astype(np.float32),
+                               "label": (y > 0).astype(np.int64)}))
+
+
+# --------------------------------------------------------------------- #
+# autoscaler wiring + metrics + diagnose table                          #
+# --------------------------------------------------------------------- #
+
+
+class _StubFleet:
+    def __init__(self, n=1):
+        self.n = n
+
+    n_live = property(lambda self: self.n)
+
+    def dead_slots(self):
+        return []
+
+    def scale_to(self, n):
+        self.n = n
+        return []
+
+
+def _training_sig(**over):
+    sig = {"queue_depth": 0.0, "p99_latency_s": 0.0, "shed_rate": 0.0,
+           "burn_rate": 0.0, "step_p99_latency_s": 0.0,
+           "straggler_wait_s": 0.0}
+    sig.update(over)
+    return sig
+
+
+class TestAutoscalerWiring:
+    def _scaler(self, fleet, sig, **kw):
+        kw.setdefault("hysteresis_ticks", 2)
+        kw.setdefault("cooldown_s", 30.0)
+        return FleetAutoscaler(fleet, lambda: dict(sig),
+                               clock=FakeClock(), **kw)
+
+    @pytest.mark.parametrize("key,value", [
+        ("step_p99_latency_s", 2.0), ("straggler_wait_s", 0.9)])
+    def test_training_slo_pressure_scales_up(self, key, value):
+        fleet = _StubFleet(1)
+        sig = _training_sig(**{key: value})
+        scaler = self._scaler(fleet, sig, extra_up={
+            "step_p99_latency_s": 1.0, "straggler_wait_s": 0.5})
+        assert scaler.tick() == "up"
+        assert fleet.n_live == 2
+
+    def test_elevated_training_signal_blocks_scale_down(self):
+        fleet = _StubFleet(3)
+        sig = _training_sig(step_p99_latency_s=0.8)  # above 1.0 * 0.5
+        scaler = self._scaler(fleet, sig, extra_up={
+            "step_p99_latency_s": 1.0, "straggler_wait_s": 0.5})
+        for _ in range(5):
+            assert scaler.tick() == "none"          # never calm enough
+        assert fleet.n_live == 3
+        sig["step_p99_latency_s"] = 0.0             # truly calm now
+        assert scaler.tick() == "none"
+        assert scaler.tick() == "down"
+
+    def test_fit_builds_wired_autoscaler(self, tmp_path):
+        fit = ElasticGBDTFit(str(tmp_path / "a"), fleet=_StubFleet(2))
+        scaler = fit.autoscaler(up_step_p99_s=2.0, up_straggler_s=0.25)
+        assert scaler.fleet is fit.fleet
+        assert scaler.extra_up == {"step_p99_latency_s": 2.0,
+                                   "straggler_wait_s": 0.25}
+        sig = fit.signals()
+        for key in ("queue_depth", "p99_latency_s", "shed_rate",
+                    "burn_rate", "step_p99_latency_s", "straggler_wait_s"):
+            assert key in sig
+
+
+class TestMetrics:
+    def test_world_size_gauge_has_explicit_merge_policy(self):
+        assert GAUGE_MERGE_POLICIES[WORLD_SIZE_GAUGE] == "last"
+
+    def test_fit_emits_world_size_reshard_and_straggler(self, tmp_path):
+        reg = MetricsRegistry()
+        x, y = _reg_data(n=48)
+
+        def hook(fit):
+            if fit.step == 2 and fit.fleet.n_live > 1:
+                fit.fleet.remove_first()
+
+        fit, _ = _gbdt_fit(str(tmp_path / "m"), x, y, 2, iters=4,
+                           hook=hook, metrics=reg)
+        text = reg.render_prometheus()
+        assert f"{WORLD_SIZE_GAUGE} 1" in text      # last world was P=1
+        assert 'mmlspark_tpu_training_reshard_total{cause="join"} 1' in text
+        assert 'mmlspark_tpu_training_reshard_total{cause="death"} 1' in text
+        assert "mmlspark_tpu_training_straggler_wait_seconds" in text
+
+
+class TestDiagnoseTable:
+    def _diagnose(self):
+        import pathlib
+        import sys
+
+        tools = str(pathlib.Path(__file__).parents[1] / "tools")
+        if tools not in sys.path:
+            sys.path.insert(0, tools)
+        import diagnose
+
+        return diagnose
+
+    def test_renders_status_members_and_reshards(self, tmp_path):
+        doc = {
+            "kind": "dnn", "world_epoch": 4, "world_size": 2, "step": 9,
+            "members": [
+                {"rank": 0, "url": "http://a", "step": 9, "lag": 0,
+                 "rtt_s": 0.002},
+                {"rank": 1, "url": "http://b", "step": None, "lag": None,
+                 "rtt_s": None},
+            ],
+            "last_reshard": {"cause": "join", "world_epoch": 4},
+            "reshards": [{"cause": "join", "world_epoch": 4, "step": 8,
+                          "world_size": 2, "barrier_retries": 0}],
+            "straggler_wait_s": 0.001,
+        }
+        with open(tmp_path / "elastic_status.json", "w") as fh:
+            json.dump(doc, fh)
+        out = self._diagnose().diagnose_training(str(tmp_path))
+        assert "elastic dnn fit" in out
+        assert "world_epoch=4" in out and "P=2" in out and "step=9" in out
+        assert "http://a" in out and "http://b" in out
+        assert " - " in out                          # None lag renders "-"
+        assert "re-shards" in out and " join " in out
+
+    def test_missing_dir_and_missing_status(self, tmp_path):
+        dg = self._diagnose()
+        assert "no training checkpoint directory" in dg.diagnose_training(
+            str(tmp_path / "nope"))
+        assert "no elastic_status.json" in dg.diagnose_training(
+            str(tmp_path))
+
+    def test_live_status_from_real_fit(self, tmp_path):
+        d = str(tmp_path / "live")
+        x, y = _reg_data(n=48)
+        _gbdt_fit(d, x, y, 2, iters=3)
+        out = self._diagnose().diagnose_training(d)
+        assert "elastic gbdt fit" in out and "step=3" in out
+        assert "http://local/" in out
+
+
+# --------------------------------------------------------------------- #
+# slow tier: REAL worker processes, SIGKILL chaos, barrier kills        #
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.slow
+class TestRealFleetChaos:
+    """The ISSUE 17 chaos acceptance: kill AND add real workers every few
+    steps for a DNN and a GBDT fit; the final model must be
+    byte-identical to an undisturbed P=1 run — including when the
+    SIGKILL lands inside the re-shard barrier itself."""
+
+    def _chaos_hook(self, every=3):
+        state = {"last": -1}
+
+        def hook(fit):
+            if fit.step and fit.step % every == 0 and \
+                    fit.step != state["last"]:
+                state["last"] = fit.step
+                dead = fit.fleet.dead_slots()
+                if dead:
+                    fit.fleet.respawn(dead[0])      # add a real worker
+                else:
+                    fit.fleet.kill(0)               # SIGKILL a real worker
+        return hook
+
+    def test_gbdt_real_process_kill_add_digest_identical(self, tmp_path):
+        x, y = _reg_data(n=256, f=6)
+        base, _ = _gbdt_fit(str(tmp_path / "base"), x, y, 1, iters=8)
+
+        fit = ElasticGBDTFit(
+            str(tmp_path / "real"), objective="regression",
+            num_iterations=8, num_leaves=7, max_bin=15,
+            min_data_in_leaf=1, seed=0, n_workers=2, num_virtual=8,
+            request_timeout_s=120.0, step_hook=self._chaos_hook())
+        fit.fit(x, y)
+        assert fit.model_digest() == base.model_digest()
+        causes = [r["cause"] for r in fit.reshards]
+        assert "death" in causes and "join" in causes
+
+    def test_dnn_real_process_kill_add_digest_identical(self, tmp_path):
+        x, y = _cls_data(n=48)
+        base, _ = _dnn_fit(str(tmp_path / "base"), x, y, 1)
+
+        fit = ElasticDNNFit(
+            str(tmp_path / "real"), architecture="mlp",
+            model_config={"features": [8]}, loss="softmax_ce",
+            learning_rate=0.05, epochs=2, batch_size=8, seed=0,
+            n_workers=2, num_virtual=8, request_timeout_s=120.0,
+            step_hook=self._chaos_hook(every=4))
+        fit.fit(x, y)
+        assert fit.params_digest() == base.params_digest()
+        causes = [r["cause"] for r in fit.reshards]
+        assert "death" in causes and "join" in causes
+
+    def test_sigkill_inside_reshard_barrier(self, tmp_path):
+        """A worker dies WHILE the barrier is re-configuring the world:
+        the barrier must converge against the shrunken membership and
+        the model must still match the undisturbed run."""
+        x, y = _reg_data(n=256, f=6)
+        base, _ = _gbdt_fit(str(tmp_path / "base"), x, y, 1, iters=8)
+
+        state = {"killed_step": False, "killed_barrier": False}
+
+        def step_hook(fit):
+            if fit.step == 2 and not state["killed_step"]:
+                state["killed_step"] = True
+                fit.fleet.kill(0)                   # death -> barrier
+
+        def barrier_hook(fit):
+            if state["killed_step"] and not state["killed_barrier"]:
+                state["killed_barrier"] = True
+                live = fit.fleet.live_slots()
+                fit.fleet.kill(live[0])             # SIGKILL IN the barrier
+
+        fit = ElasticGBDTFit(
+            str(tmp_path / "real"), objective="regression",
+            num_iterations=8, num_leaves=7, max_bin=15,
+            min_data_in_leaf=1, seed=0, n_workers=3, num_virtual=8,
+            request_timeout_s=120.0, step_hook=step_hook,
+            barrier_hook=barrier_hook)
+        fit.fit(x, y)
+        assert state["killed_barrier"]
+        assert fit.model_digest() == base.model_digest()
+        # the barrier completed against the world the kills left behind
+        sizes = [r["world_size"] for r in fit.reshards]
+        assert 1 in sizes

@@ -15,6 +15,9 @@
 #        corruption fallback and lineage table assertions)
 #      + sweep-ledger smoke (known AutoML sweep ledger rendered; every
 #        trial state the table can show asserted)
+#      + elastic-training smoke (real elastic GBDT fit with a worker
+#        kill and a join mid-fit; world-epoch/member/re-shard table
+#        assertions)
 #   3. bench regression gate over the BENCH_*/MULTICHIP_* trajectory
 #   4. pipeline-fusion segment report (fails if an exemplar stops fusing)
 #   5. full test suite on the 8-virtual-device CPU mesh
@@ -33,12 +36,13 @@ python tools/diagnose.py --streaming --selftest
 python tools/diagnose.py --perf --selftest
 python tools/diagnose.py --checkpoints --selftest
 python tools/diagnose.py --sweep --selftest
+python tools/diagnose.py --training --selftest
 python tools/bench_gate.py --selftest
 python tools/fusion_report.py
 python -m pytest tests/ -q
 MMLSPARK_TPU_SANITIZE=1 python -m pytest -q \
     tests/test_serving.py tests/test_streaming.py tests/test_io_http.py \
     tests/test_resilience.py tests/test_observability.py \
-    tests/test_automl_sweep.py
+    tests/test_automl_sweep.py tests/test_elastic_fleet.py
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
 MMLSPARK_TPU_BENCH_FORCE_CPU=1 python bench.py

@@ -1251,6 +1251,153 @@ def sweep_selftest() -> int:
     return 0
 
 
+def diagnose_training(ckpt_dir: str) -> str:
+    """Live table for one elastic training checkpoint directory: world
+    epoch, member list with per-worker step lag, and the recent re-shard
+    history. Built only from the driver's durably-written
+    `elastic_status.json` (rewritten atomically every step), so a
+    running fit can be watched from a second terminal."""
+    if not os.path.isdir(ckpt_dir):
+        return f"(no training checkpoint directory at {ckpt_dir})"
+    try:
+        with open(os.path.join(ckpt_dir, "elastic_status.json"),
+                  encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return f"(no elastic_status.json under {ckpt_dir} yet)"
+
+    last = doc.get("last_reshard") or {}
+    out = [
+        f"elastic {doc.get('kind', '?')} fit: {ckpt_dir} "
+        f"world_epoch={doc.get('world_epoch', '?')} "
+        f"P={doc.get('world_size', '?')} step={doc.get('step', '?')} "
+        f"straggler_wait={_fmt(doc.get('straggler_wait_s'), 4)}s "
+        f"last_reshard={last.get('cause', '-')}"
+    ]
+    rows = []
+    for m in doc.get("members", ()):
+        rows.append([
+            str(m.get("rank", "?")), str(m.get("url", "?")),
+            _fmt(m.get("step")) if m.get("step") is not None else "-",
+            _fmt(m.get("lag")) if m.get("lag") is not None else "-",
+            _fmt((m.get("rtt_s") or 0) * 1e3, 1)
+            if m.get("rtt_s") is not None else "-",
+        ])
+    if rows:
+        out.append(_render_table(
+            rows, ["rank", "url", "step", "lag", "rtt_ms"]))
+    else:
+        out.append("(no members configured yet)")
+    reshards = doc.get("reshards", ())
+    if reshards:
+        out.append("re-shards (most recent last):")
+        out.append(_render_table(
+            [[str(r.get("world_epoch", "?")), str(r.get("cause", "?")),
+              _fmt(r.get("step")), _fmt(r.get("world_size")),
+              _fmt(r.get("barrier_retries"))]
+             for r in reshards],
+            ["epoch", "cause", "step", "P", "barrier_retries"]))
+    return "\n".join(out)
+
+
+def training_selftest() -> int:
+    """Run a REAL (in-process) elastic GBDT fit whose step hook kills a
+    worker and adds another, then diagnose the directory the driver
+    wrote and assert every fact the table must show: world epoch,
+    members, step lag, and the re-shard causes."""
+    import tempfile
+
+    import numpy as np
+
+    from mmlspark_tpu.core.schema import Table
+    from mmlspark_tpu.io_http.schema import HTTPRequestData
+    from mmlspark_tpu.resilience.elastic_fleet import (
+        ElasticGBDTFit, ElasticWorkerFactory)
+
+    class _LocalFleet:
+        """In-process handler-per-URL stand-in for ServingFleet: the full
+        driver protocol with none of the processes."""
+
+        def __init__(self, checkpoint_dir):
+            self.checkpoint_dir = checkpoint_dir
+            self.handlers = {}
+            self._n = 0
+
+        def add(self):
+            url = f"http://local/{self._n:03d}"
+            self._n += 1
+            self.handlers[url] = ElasticWorkerFactory(
+                self.checkpoint_dir, guard=False)()
+            return url
+
+        urls = property(lambda self: list(self.handlers))
+        n_live = property(lambda self: len(self.handlers))
+
+        def watch(self, cb):
+            pass
+
+        def dump_all(self, trigger=""):
+            return 0
+
+        def stop(self):
+            pass
+
+    def _post(fleet):
+        def post(url, body):
+            handler = fleet.handlers.get(url)
+            if handler is None:
+                raise RuntimeError("dead member")
+            out = handler(Table(
+                {"request": [HTTPRequestData.from_json("/", body)]}))
+            rep = out["reply"][0]
+            doc = json.loads(bytes(rep.entity).decode("utf-8"))
+            if rep.status_code != 200:
+                raise RuntimeError(doc.get("error", "handler error"))
+            return doc
+        return post
+
+    checks: dict[str, bool] = {}
+    with tempfile.TemporaryDirectory() as d:
+        checks["empty dir reports cleanly"] = (
+            "(no training checkpoint directory" in diagnose_training(
+                os.path.join(d, "missing")))
+        fleet = _LocalFleet(d)
+        seen = {"n": 0}
+
+        def hook(fit):
+            seen["n"] += 1
+            if seen["n"] == 2 and fleet.n_live > 1:
+                del fleet.handlers[fleet.urls[0]]
+            elif seen["n"] == 4:
+                fleet.add()
+
+        fit = ElasticGBDTFit(
+            d, objective="regression", num_iterations=6, num_leaves=7,
+            max_bin=15, min_data_in_leaf=1, seed=0, n_workers=2,
+            num_virtual=8, fleet=fleet, post=_post(fleet),
+            step_hook=hook)
+        fleet.add(), fleet.add()
+        rng = np.random.default_rng(7)
+        x = rng.normal(size=(80, 3))
+        fit.fit(x, x[:, 0] * 2 + rng.normal(size=80) * 0.1)
+        report = diagnose_training(d)
+        print(report)
+        checks["kind + dir header"] = "elastic gbdt fit" in report
+        checks["final step"] = "step=6" in report
+        checks["kill re-sharded"] = " death " in report
+        checks["join re-sharded"] = " join " in report
+        checks["members rendered"] = "http://local/" in report
+        checks["epoch advanced"] = any(
+            f"world_epoch={e}" in report for e in range(3, 10))
+        checks["lag column"] = "lag" in report
+    failed = [name for name, ok in checks.items() if not ok]
+    if failed:
+        print(f"training selftest FAILED: {failed}", file=sys.stderr)
+        return 1
+    print(f"training selftest OK ({len(checks)} checks)")
+    return 0
+
+
 # -- selftest ----------------------------------------------------------- #
 
 def _selftest_handler(table):
@@ -1423,6 +1570,12 @@ def main(argv: "list[str] | None" = None) -> int:
                     help="trial ledger table for an AutoML sweep "
                          "checkpoint directory (with --selftest: build "
                          "a known ledger and assert every table state)")
+    ap.add_argument("--training", nargs="?", const="", metavar="DIR",
+                    help="elastic training live table (world epoch, "
+                         "members, step lag, re-shard causes) for a "
+                         "training checkpoint directory (with "
+                         "--selftest: real in-process elastic fit with "
+                         "a kill + a join, then assert the table)")
     ap.add_argument("--selftest", action="store_true",
                     help="run a 2-replica fleet and diagnose it (with "
                          "--postmortem/--streaming: the matching "
@@ -1432,11 +1585,19 @@ def main(argv: "list[str] | None" = None) -> int:
     args = ap.parse_args(argv)
     modes = [args.rendezvous, args.urls, args.gateway, args.serving,
              args.postmortem, args.streaming, args.perf, args.checkpoints,
-             args.sweep, args.selftest or None]
+             args.sweep, args.training, args.selftest or None]
     if not any(m for m in modes):
         ap.error("pick a mode: --rendezvous/--urls/--gateway/--serving/"
                  "--postmortem/--streaming/--perf/--checkpoints/"
-                 "--sweep/--selftest")
+                 "--sweep/--training/--selftest")
+    if args.training is not None:
+        if args.selftest:
+            return training_selftest()
+        if not args.training:
+            ap.error("--training needs a training checkpoint directory "
+                     "(or --selftest)")
+        print(diagnose_training(args.training))
+        return 0
     if args.sweep is not None:
         if args.selftest:
             return sweep_selftest()
