@@ -35,6 +35,7 @@ orchestrator processes that must never initialize jax).
 
 from __future__ import annotations
 
+import math
 import queue
 import threading
 import time
@@ -246,27 +247,44 @@ class ShapeBucketer:
     observed row count — the serving p99-recompile-spike fix. `pad`
     returns the padded array plus the row mask marking real rows (padding
     repeats the last row, the same convention the runner always used, so
-    padded rows are well-formed inputs that get sliced away)."""
+    padded rows are well-formed inputs that get sliced away).
+
+    `shards` > 1 makes the ladder SKEW-AWARE: the geometric progression is
+    built in PER-SHARD rows and scaled back up, so every rung splits into
+    `shards` equal slices — each shard carries exactly rung/shards rows
+    (⌈rows/shards⌉ padded to the same per-shard rung on every shard) and
+    the compiled per-shard shape set is the same closed ladder on every
+    device. A merely mesh-DIVISIBLE total can leave the geometric
+    progression stated in totals; per-shard construction states it in the
+    unit that actually compiles and balances. `multiple_of` still rounds
+    each rung so totals honor both constraints."""
 
     def __init__(self, max_size: int, min_size: int = 1, growth: int = 2,
-                 multiple_of: int = 1):
+                 multiple_of: int = 1, shards: int = 1):
         if max_size < 1:
             raise ValueError(f"max_size must be >= 1, got {max_size}")
         if growth < 2:
             raise ValueError(f"growth must be >= 2, got {growth}")
         m = max(int(multiple_of), 1)
-        self.max_size = ((int(max_size) + m - 1) // m) * m
+        s = max(int(shards), 1)
         self.multiple_of = m
+        self.shards = s
+        # per-shard rung rounding unit: smallest k with (shards*k) % m == 0,
+        # so scaled-up totals stay divisible by BOTH shards and multiple_of
+        per_m = m // math.gcd(m, s)
+        per_max = -(-int(max_size) // s)
+        per_max = ((per_max + per_m - 1) // per_m) * per_m
+        self.max_size = per_max * s
         ladder: list[int] = []
-        b = max(int(min_size), 1)
-        while b < self.max_size:
-            rounded = ((b + m - 1) // m) * m
+        b = max(-(-int(min_size) // s), 1)
+        while b < per_max:
+            rounded = ((b + per_m - 1) // per_m) * per_m
             if not ladder or rounded > ladder[-1]:
                 ladder.append(rounded)
             b *= growth
-        if not ladder or ladder[-1] != self.max_size:
-            ladder.append(self.max_size)
-        self.ladder: tuple[int, ...] = tuple(ladder)
+        if not ladder or ladder[-1] != per_max:
+            ladder.append(per_max)
+        self.ladder: tuple[int, ...] = tuple(r * s for r in ladder)
         # padded-vs-real row accounting per rung: at multiple_of=8 mesh
         # padding a small batch can be MOSTLY padding, and before this
         # nothing reported it — rung -> [rows_real, rows_padded]
@@ -302,6 +320,12 @@ class ShapeBucketer:
         return {rung: {"rows_real": real, "rows_padded": padded,
                        "ratio": padded / max(real + padded, 1)}
                 for rung, (real, padded) in sorted(self._pad_rows.items())}
+
+    @property
+    def per_shard_ladder(self) -> "tuple[int, ...]":
+        """The ladder in per-shard rows — every rung divided by `shards`
+        (exact by construction; the skew-aware balance invariant)."""
+        return tuple(r // self.shards for r in self.ladder)
 
     def bucket_for(self, n: int) -> int:
         """Smallest ladder bucket >= n (n must fit the ladder)."""

@@ -423,7 +423,11 @@ class GBDTRegressionModel(_BoosterModelMixin, HasFeaturesCol, HasPredictionCol, 
         objectives only — their transform_score is the identity, so the
         float64 output is an exact widening of the float32 margins. The
         `ready` check pins the binning bit-identity precondition: feature
-        values must be float32-representable."""
+        values must be float32-representable.  That check is VALUE-
+        dependent, so it also ships as the kernel's `ready_values` hook —
+        the serving hot path validates the schema once at warmup and then
+        re-runs only this per batch (a float32 batch skips the scan
+        entirely: it is representable by definition)."""
         from ..core.fusion import DeviceKernel
 
         b = self.booster
@@ -446,10 +450,8 @@ class GBDTRegressionModel(_BoosterModelMixin, HasFeaturesCol, HasPredictionCol, 
                 x = x[:, None]
             return {out_col: predict(p, x)}
 
-        def ready(table: Table):
-            col = table[in_col]
-            if not isinstance(col, np.ndarray):
-                return f"features column {in_col!r} is not a dense ndarray"
+        def ready_values(cols: dict):
+            col = np.asarray(cols[in_col])
             if col.dtype != np.float32:
                 col64 = col.astype(np.float64)
                 mismatch = col64.astype(np.float32).astype(np.float64) != col64
@@ -459,6 +461,12 @@ class GBDTRegressionModel(_BoosterModelMixin, HasFeaturesCol, HasPredictionCol, 
                     return (f"features in {in_col!r} are not float32-"
                             "representable (device binning would shift bins)")
             return True
+
+        def ready(table: Table):
+            col = table[in_col]
+            if not isinstance(col, np.ndarray):
+                return f"features column {in_col!r} is not a dense ndarray"
+            return ready_values({in_col: col})
 
         def mesh_fn(mesh):
             # same traversal body; rows shard over the data axis while the
@@ -470,7 +478,7 @@ class GBDTRegressionModel(_BoosterModelMixin, HasFeaturesCol, HasPredictionCol, 
             params=params, name="GBDTRegressionModel",
             out_dtypes={out_col: np.float64},
             out_meta={out_col: {SCORE_KIND: "prediction"}}, ready=ready,
-            mesh_fn=mesh_fn,
+            ready_values=ready_values, mesh_fn=mesh_fn,
             mesh_desc="rows P(data); binning table + tree SoAs replicated")
 
     def native_score_fn(self):

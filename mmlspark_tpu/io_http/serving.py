@@ -195,6 +195,20 @@ class _HotPath:
     def native_values(self, feats: np.ndarray) -> np.ndarray:
         return np.asarray(self.native_fn(feats), np.float64)
 
+    def value_check(self, feats: np.ndarray) -> str:
+        """Per-batch resident precondition — the VALUE-dependent subset of
+        `executor.check_ready`.  Schema validation (dense ndarray, column
+        contract) ran exactly once at warmup (`warm_rung`'s full
+        check_ready); live batches pay only each kernel's vectorized
+        `ready_values` hook — for GBDT, nothing at all on float32 payloads.
+        '' routes resident; a reason string declines the batch (the native
+        walk is exact for any float64 payload, so nothing is lost)."""
+        try:
+            return self.executor.check_ready_values(
+                {self.feature_col: feats})
+        except Exception as e:  # noqa: BLE001 — decline, never crash the loop
+            return f"value check failed: {e}"
+
     def fetch_values(self, outs, n_valid: int, ledger=None):
         """Block on one in-flight batch's device results and return
         whatever `replies_for` consumes — subclasses with a different
@@ -292,6 +306,11 @@ class _HotPath:
         trip-per-request bar is `round_trips_per_resident_request` (each
         resident BATCH costs exactly one upload+readback pair, shared by
         every request coalesced into it)."""
+        ex_stats: dict = {}
+        try:
+            ex_stats = self.executor.stats()
+        except Exception:  # noqa: BLE001 — stats are strictly optional
+            pass
         with self._lock:
             res_req = self.path_requests.get(self.resident_label, 0)
             return {
@@ -304,6 +323,9 @@ class _HotPath:
                                         for k, v in t.items()}
                                for b, t in sorted(self.timings_ms.items())},
                 "readback_lag": self.readback_lag,
+                "donate_buffers": bool(ex_stats.get("donate_buffers", False)),
+                "dispatch_overlap_fraction": round(float(
+                    ex_stats.get("dispatch_overlap_fraction", 0.0)), 4),
                 "paths": dict(self.path_requests),
                 "resident_batches": self.resident_batches,
                 "round_trips": self.executor.round_trips,
@@ -388,7 +410,9 @@ class ServingServer:
         # (mirroring _FusedSegment.run's mini-batch ladder).
         m = max(1, int(bucket_multiple_of))
         bmax = -(-max_batch_size // m) * m
-        self.bucketer = (ShapeBucketer(bmax, multiple_of=m)
+        # skew-aware ladder (`shards=m`): each rung splits into m equal
+        # per-shard slices, not just an m-divisible total
+        self.bucketer = (ShapeBucketer(bmax, shards=m)
                          if bucket_batches and max_batch_size > 1 else None)
         self.api_path = api_path
         # "continuous": batcher thread drains the queue and replies directly
@@ -1142,9 +1166,10 @@ class ServingServer:
         feats = hp.decoder.decode([ex.request for ex in batch], target)
         if feats is None:
             return False
-        if hp.executor.check_ready(Table({hp.feature_col: feats})):
+        if hp.value_check(feats):
             # non-empty reason (e.g. floats not f32-representable): this
-            # batch cannot run resident byte-identically
+            # batch cannot run resident byte-identically.  Schema checks
+            # were hoisted to warmup — only value-dependent hooks run here
             return False
         self._c_bucket.labels(server=self.server_label,
                               bucket=str(target)).inc()
@@ -1424,9 +1449,14 @@ def _build_hot_path(model, decoder: RequestDecoder,
         fn = get_fn() if callable(get_fn) else None
         if callable(fn):
             native_fn = fn
+    # the hot path inherits the model's dispatch-pipeline window when one
+    # is set (pipeline_depth generalizes readback_lag: same lag-K fetch,
+    # framed as the bounded in-flight dispatch count)
+    lag = model.get("pipeline_depth")
+    if lag is None:
+        lag = model.get("readback_lag")
     return _HotPath(rex, decoder, "features", output_col,
-                    native_fn=native_fn,
-                    readback_lag=model.get("readback_lag"))
+                    native_fn=native_fn, readback_lag=lag)
 
 
 def serve_model(

@@ -14,6 +14,7 @@ replicated.
 
 from __future__ import annotations
 
+import os
 from typing import Any
 
 import jax
@@ -257,7 +258,7 @@ class DeepModelTransformer(Model):
         readback lags one batch so it overlaps too. Shapes, batch order,
         and per-row outputs are identical at every prefetch depth."""
         n = x.shape[0]
-        bucketer = (ShapeBucketer(bs, multiple_of=d)
+        bucketer = (ShapeBucketer(bs, shards=d)
                     if self.get("shape_buckets") else None)
         if self._exec_cache is None:
             self._exec_cache = ExecutableCache()
@@ -380,13 +381,19 @@ class DeepModelTransformer(Model):
 
         mean = np.asarray(bundle.preprocess.get("mean", 0.0), np.float32)
         std = np.asarray(bundle.preprocess.get("std", 1.0), np.float32)
+        # gather schedule: XLA's monolithic all_gather by default; the
+        # hand-scheduled collective-permute ring (same bytes, each step
+        # independently schedulable) when the phase ledger showed the
+        # gather NOT overlapping compute on this mesh.  bench's TP rung
+        # measures both and prints which schedule hides the collective.
+        ring = os.environ.get("MMLSPARK_TPU_RING_GATHER", "") == "1"
 
         def tp_body(variables, x):
             p = variables["params"]
             h = x.reshape((x.shape[0], -1))
             for nm in names:
                 h = gathered_column_parallel(
-                    h, p[nm]["kernel"], p[nm]["bias"], MODEL_AXIS)
+                    h, p[nm]["kernel"], p[nm]["bias"], MODEL_AXIS, ring=ring)
                 if nm != "head":
                     h = jax.nn.relu(h)
             return h

@@ -28,6 +28,7 @@ from .mesh import MODEL_AXIS
 __all__ = [
     "column_parallel",
     "row_parallel",
+    "ring_all_gather",
     "gathered_column_parallel",
     "dense_column_specs",
     "make_tp_mlp",
@@ -55,7 +56,42 @@ def row_parallel(x_local, w_local, axis_name: str, b=None):
     return y
 
 
-def gathered_column_parallel(x, w_local, b_local, axis_name: str):
+def ring_all_gather(y, axis_name: str, axis: int = -1):
+    """Hand-scheduled tiled all_gather: N-1 neighbor `ppermute` steps
+    (collective-permute tiling) instead of one monolithic all_gather op.
+
+    The point is SCHEDULING, not values: XLA can only overlap a collective
+    with compute at the granularity of the ops it sees, and when its async
+    pass leaves `all-gather` synchronous the whole gather serializes
+    behind the matmul.  Decomposed into a ring of permutes, each step is
+    independently schedulable, so compute slides between steps — the
+    classic fallback when the phase ledger shows the gather NOT
+    overlapping (SNIPPETS.md [3] pattern; bench_fused_sharded's TP rung
+    measures both schedules and reports which one hides the collective).
+
+    Bit-exact by construction: blocks are moved, never added — chip i's
+    slice lands in slot i on every chip, the same disjoint concatenation
+    `all_gather(..., tiled=True)` produces."""
+    n = lax.psum(1, axis_name)  # static axis size (constant-folded)
+    if n == 1:
+        return y
+    axis = axis % y.ndim
+    # receive from the next chip each step: after step k this chip holds
+    # the slice owned by (idx + k) mod n, so the received order is the
+    # full ring rotated left by idx — one roll restores slot order
+    perm = [(i, (i - 1) % n) for i in range(n)]
+    blocks = [y]
+    blk = y
+    for _ in range(n - 1):
+        blk = lax.ppermute(blk, axis_name, perm)
+        blocks.append(blk)
+    out = jnp.concatenate(blocks, axis=axis)
+    idx = lax.axis_index(axis_name)
+    return jnp.roll(out, idx * y.shape[axis], axis=axis)
+
+
+def gathered_column_parallel(x, w_local, b_local, axis_name: str,
+                             ring: bool = False):
     """Column-parallel dense followed by a tiled all_gather, so every chip
     leaves with the FULL output features.
 
@@ -65,8 +101,14 @@ def gathered_column_parallel(x, w_local, b_local, axis_name: str):
     -contraction dot — identical arithmetic to the unsharded matmul — and
     the gather merely concatenates disjoint feature slices.  That is what
     lets the fused pipeline engine keep its byte-identity contract while
-    splitting matmul FLOPs/weights over the model axis."""
+    splitting matmul FLOPs/weights over the model axis.
+
+    `ring=True` swaps the monolithic gather for `ring_all_gather`'s
+    collective-permute tiling — same bytes, finer-grained schedule — for
+    meshes where XLA fails to overlap the all_gather with compute."""
     y = column_parallel(x, w_local, b_local)
+    if ring:
+        return ring_all_gather(y, axis_name, axis=y.ndim - 1)
     return lax.all_gather(y, axis_name, axis=y.ndim - 1, tiled=True)
 
 

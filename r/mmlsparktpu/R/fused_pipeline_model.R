@@ -9,9 +9,11 @@
 #' @param shape_buckets pad ragged chunk tails to a pow-2 bucket ladder so the compiled-shape set stays closed
 #' @param fused_label label for the fusion-ratio gauge
 #' @param readback_lag device batches kept in flight before device->host readback is forced (0 = fetch synchronously after every dispatch); also the lag of the serving hot path's overlapped reply fetch
+#' @param donate_buffers donate each chunk's device input buffers to the fused executable (jit donate_argnums on the batch tuple; params are never donated) so steady-state batches reuse device memory instead of allocating fresh — identical values, fewer allocations
+#' @param pipeline_depth sharded dispatches kept in flight per segment (the bounded dispatch->dispatch pipeline window: at most this+1 batches dispatched-but-unfetched, lag-K readback; 0 = fetch synchronously after every dispatch). None inherits readback_lag, keeping the pre-pipelining schedule
 #' @param use_mesh compile fused segments under the process mesh (parallel.mesh.get_mesh()) when no explicit mesh was set via fuse(model, mesh=...) / set_mesh()
 #' @export
-ml_fused_pipeline_model <- function(x, stages = NULL, mini_batch_size = 4096L, prefetch_depth = 2L, shape_buckets = TRUE, fused_label = "pipeline", readback_lag = 1L, use_mesh = FALSE)
+ml_fused_pipeline_model <- function(x, stages = NULL, mini_batch_size = 4096L, prefetch_depth = 2L, shape_buckets = TRUE, fused_label = "pipeline", readback_lag = 1L, donate_buffers = TRUE, pipeline_depth = NULL, use_mesh = FALSE)
 {
   params <- list()
   if (!is.null(stages)) params$stages <- as.list(stages)
@@ -20,6 +22,8 @@ ml_fused_pipeline_model <- function(x, stages = NULL, mini_batch_size = 4096L, p
   if (!is.null(shape_buckets)) params$shape_buckets <- as.logical(shape_buckets)
   if (!is.null(fused_label)) params$fused_label <- as.character(fused_label)
   if (!is.null(readback_lag)) params$readback_lag <- as.integer(readback_lag)
+  if (!is.null(donate_buffers)) params$donate_buffers <- as.logical(donate_buffers)
+  if (!is.null(pipeline_depth)) params$pipeline_depth <- as.integer(pipeline_depth)
   if (!is.null(use_mesh)) params$use_mesh <- as.logical(use_mesh)
   .tpu_apply_stage("mmlspark_tpu.core.fusion.FusedPipelineModel", params, x, is_estimator = FALSE)
 }

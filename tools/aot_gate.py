@@ -138,10 +138,13 @@ def runner_sharded_build(n, n_data, n_model=1):
 _RESIDENT = {}
 
 
-def _resident_executor(n_data=0):
+def _resident_executor(n_data=0, donate=True):
     """A ResidentExecutor over a tiny fitted GBDT model, fused under a
-    `n_data x 1` mesh (0 = single device). Cached per mesh shape."""
-    key = n_data
+    `n_data x 1` mesh (0 = single device). Cached per (mesh, donation)
+    cell: a donated (input-aliased) executable is a DIFFERENT XLA program
+    from the non-donated one, and serve_model can mint either
+    (donate_buffers defaults on, users may disable it)."""
+    key = (n_data, bool(donate))
     if key in _RESIDENT:
         return _RESIDENT[key]
     import numpy as np
@@ -164,7 +167,8 @@ def _resident_executor(n_data=0):
 
         mesh = make_mesh(n_data=n_data, n_model=1,
                          devices=jax.devices()[:n_data])
-    fused = fuse(PipelineModel([_RESIDENT["model"]]), mesh=mesh)
+    fused = fuse(PipelineModel([_RESIDENT["model"]]), mesh=mesh,
+                 donate_buffers=donate)
     rex = fused.resident_executor()
     if isinstance(rex, str):
         raise RuntimeError(f"no resident executor: {rex}")
@@ -172,17 +176,20 @@ def _resident_executor(n_data=0):
     return rex
 
 
-def serving_resident_build(n, n_data=0):
+def serving_resident_build(n, n_data=0, donate=True):
     """The serving hot path's resident executable at ONE bucket rung.
 
     io_http/serving.py routes live request batches straight onto these
     programs (params pinned on device, one upload per batch), and its
     warmup refuses to flip /readyz until the full ladder is compiled —
     so every rung the batcher can mint must AOT-compile, single-device
-    and under each mesh shape this host can form."""
+    and under each mesh shape this host can form, donated and not.
+    (Pipeline depth needs no axis of its own: lag-K readback re-dispatches
+    the SAME executable — depth only changes how many results are in
+    flight on the host, never the lowered program.)"""
     import numpy as np
 
-    rex = _resident_executor(n_data)
+    rex = _resident_executor(n_data, donate)
     return rex.aot_args({"features": np.zeros((1, 8), np.float64)}, n)
 
 
@@ -261,29 +268,39 @@ def main():
              lambda n=bucket: runner_bucket_build(n))
 
     # sharded ladder: every (bucket shape x mesh shape) the fused engine
-    # can mint on this host's devices, incl. one 2-D data x model mesh
+    # can mint on this host's devices, incl. one 2-D data x model mesh.
+    # Ladders come from ShapeBucketer(shards=...) — the skew-aware
+    # per-shard-balanced rungs serve_model and the fused engine actually
+    # mint under a mesh (NOT the old multiple_of= rounding).
     n_dev = len(jax.devices())
     mesh_shapes = [(d, 1) for d in (2, 4, 8) if d <= n_dev]
     if n_dev >= 8:
         mesh_shapes.append((4, 2))
     for n_data, n_model in mesh_shapes:
-        for bucket in ShapeBucketer(64, multiple_of=n_data).ladder:
+        for bucket in ShapeBucketer(64, shards=n_data).ladder:
             gate(f"runner_bucket_b{bucket}_mesh{n_data}x{n_model}",
                  lambda n=bucket, d=n_data, m=n_model:
                  runner_sharded_build(n, d, m))
 
     # serving hot path: the resident executor's bucket ladder (the exact
     # programs serve_model warmup compiles before /readyz flips),
-    # single-device and sharded over each pure-data mesh
+    # single-device and sharded over each pure-data mesh, in BOTH
+    # donation states — an input-aliased executable is a different
+    # program, and donate_buffers is a user-settable Param
     for bucket in ShapeBucketer(64).ladder:
         gate(f"serving_resident_b{bucket}",
              lambda n=bucket: serving_resident_build(n))
+        gate(f"serving_resident_b{bucket}_nodonate",
+             lambda n=bucket: serving_resident_build(n, donate=False))
     for n_data, n_model in mesh_shapes:
         if n_model != 1:
             continue  # the GBDT kernel shards rows over data only
-        for bucket in ShapeBucketer(64, multiple_of=n_data).ladder:
+        for bucket in ShapeBucketer(64, shards=n_data).ladder:
             gate(f"serving_resident_b{bucket}_mesh{n_data}x1",
                  lambda n=bucket, d=n_data: serving_resident_build(n, d))
+            gate(f"serving_resident_b{bucket}_mesh{n_data}x1_nodonate",
+                 lambda n=bucket, d=n_data:
+                 serving_resident_build(n, d, donate=False))
 
     # SAR recommender hot path: the device-resident top-k ladder
     # (recommendation/resident.py), single-device and sharded over each
@@ -294,7 +311,7 @@ def main():
     for n_data, n_model in mesh_shapes:
         if n_model != 1:
             continue  # the SAR kernel shards rows over data only
-        for bucket in ShapeBucketer(64, multiple_of=n_data).ladder:
+        for bucket in ShapeBucketer(64, shards=n_data).ladder:
             gate(f"sar_resident_b{bucket}_mesh{n_data}x1",
                  lambda n=bucket, d=n_data: sar_resident_build(n, d))
 

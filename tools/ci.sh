@@ -43,6 +43,8 @@ python -m pytest tests/ -q
 MMLSPARK_TPU_SANITIZE=1 python -m pytest -q \
     tests/test_serving.py tests/test_streaming.py tests/test_io_http.py \
     tests/test_resilience.py tests/test_observability.py \
-    tests/test_automl_sweep.py tests/test_elastic_fleet.py
+    tests/test_automl_sweep.py tests/test_elastic_fleet.py \
+    tests/test_dataplane.py tests/test_sharded_fusion.py \
+    tests/test_donated_pipelined.py
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
 MMLSPARK_TPU_BENCH_FORCE_CPU=1 python bench.py

@@ -938,6 +938,17 @@ def diagnose_perf(target: str) -> str:
             f"p50_ms={lat.get('p50_ms')}  p99_ms={lat.get('p99_ms')}",
             f"  compile_seconds_total={info.get('compile_seconds_total')}",
         ]
+        hp = info.get("hot_path") or {}
+        if hp:
+            # the donated/pipelined dispatch gauges: what fraction of
+            # fetches found their batch already complete (compute fully
+            # hidden behind pipeline work), and whether the resident
+            # executable aliases its input buffers
+            lines.append(
+                f"  hot_path: donate_buffers={hp.get('donate_buffers')}  "
+                f"dispatch_overlap_fraction="
+                f"{hp.get('dispatch_overlap_fraction')}  "
+                f"readback_lag={hp.get('readback_lag')}")
         for entry in (info.get("compile_ledger") or [])[:5]:
             lines.append(f"    compile {entry.get('seconds', 0.0):8.3f}s  "
                          f"{entry.get('shape', '')}")
@@ -1061,9 +1072,16 @@ def perf_selftest() -> int:
             checks["queue wait attributed"] = (
                 row["phase_us"].get("queue", 0.0) > 0.0)
         checks["report renders phase table"] = "dispatch/us" in report
+        checks["report carries dispatch overlap"] = (
+            "dispatch_overlap_fraction=" in report)
+        info_blob = json.loads(_fetch(srv.url + "/"))
         checks["info carries profiler block"] = (
-            json.loads(_fetch(srv.url + "/"))
-            .get("profiler", {}).get("enabled") is True)
+            info_blob.get("profiler", {}).get("enabled") is True)
+        hp_snap = info_blob.get("hot_path") or {}
+        checks["hot path reports dispatch overlap"] = isinstance(
+            hp_snap.get("dispatch_overlap_fraction"), (int, float))
+        checks["hot path reports donation"] = isinstance(
+            hp_snap.get("donate_buffers"), bool)
     finally:
         prof.disarm()
         srv.stop()

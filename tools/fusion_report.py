@@ -79,12 +79,23 @@ def main() -> int:
         from mmlspark_tpu.parallel.mesh import make_mesh
 
         mesh = make_mesh()
+    from mmlspark_tpu.core.fusion import fuse
+
     failures = []
     for title, model, expected_ratio in build_pipelines():
         plan = plan_fusion(model.get("stages"))
         fused_t, staged_t = plan.transfers_per_batch()
+        # runtime knobs come off the fused model the way serve_model would
+        # build it — a segment that stopped donating (or lost its dispatch
+        # pipeline) prints as donate=OFF / in_flight=1 right next to its
+        # sharding spec, so the regression is visible in CI output
+        fm = fuse(model, mesh=mesh)
+        depth = fm.get("pipeline_depth")
+        if depth is None:
+            depth = fm.get("readback_lag")
         print(f"== {title} ==")
-        print(plan.describe(mesh=mesh))
+        print(plan.describe(mesh=mesh, donate=fm.get("donate_buffers"),
+                            pipeline_depth=depth))
         print(f"   transfers/batch: fused={fused_t} staged={staged_t}")
         if plan.fusion_ratio < expected_ratio:
             failures.append(
