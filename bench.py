@@ -1576,6 +1576,88 @@ def bench_recorder_overhead() -> dict:
     }
 
 
+def bench_timeline_overhead() -> dict:
+    """The telemetry-timeline paired row: serving p50 with a
+    TimelineRecorder ARMED beside the server (background sampling loop:
+    registry snapshot -> delta-encode -> checksummed atomic segment
+    rewrite, at `interval_s` cadence) vs DISABLED (no recorder at all).
+    The recorder never touches the request path, so its per-request cost
+    is the amortized share of one sample a single request carries:
+    sample_cost * (p50 / interval_s). The sample cost itself is a
+    min-of-passes loop floor over real `sample()` calls against the
+    loaded serving registry (fsync + rewrite included — that IS the
+    cost), and the p50 comes from the same out-of-process-style
+    keep-alive loop as bench_recorder_overhead. Acceptance bar:
+    armed/disabled p50 ratio <= 1.02."""
+    import http.client
+    import json as _json
+    import shutil
+    import tempfile
+    import urllib.parse
+
+    from mmlspark_tpu.core.schema import Table
+    from mmlspark_tpu.io_http.schema import make_reply, parse_request
+    from mmlspark_tpu.io_http.serving import ServingServer
+    from mmlspark_tpu.observability import MetricsRegistry
+    from mmlspark_tpu.observability.timeline import TimelineRecorder
+
+    def handler(table: Table) -> Table:
+        t = parse_request(table)
+        return make_reply(
+            t.with_column("y", np.asarray(t["x"], dtype=float) * 2), "y")
+
+    interval_s = 5.0
+    reg = MetricsRegistry()
+    # 1) real p50 under serving load, recorder sampling in background at
+    #    its production cadence (its thread steal, if any, is in the p50)
+    tmp = tempfile.mkdtemp(prefix="mml_bench_timeline_")
+    srv = ServingServer(handler, metrics=reg, exemplars=False).start()
+    rec = TimelineRecorder(os.path.join(tmp, "segments"), reg,
+                           interval_s=interval_s, keep=4)
+    rec.start()
+    try:
+        p = urllib.parse.urlsplit(srv.url)
+        conn = http.client.HTTPConnection(p.hostname, p.port, timeout=30)
+        body = _json.dumps({"x": 2.0}).encode()
+        lat = []
+        for i in range(240):
+            t0 = time.perf_counter()
+            conn.request("POST", p.path or "/", body=body,
+                         headers={"Content-Type": "application/json"})
+            conn.getresponse().read()
+            if i >= 40:  # warm-up excluded
+                lat.append(time.perf_counter() - t0)
+        conn.close()
+    finally:
+        rec.stop()
+        srv.stop()
+    p50 = float(np.percentile(lat, 50))
+
+    # 2) cost of ONE sample against the loaded registry (loop floor)
+    clock = time.perf_counter
+
+    def sample_floor(calls: int = 50, passes: int = 3) -> float:
+        best = float("inf")
+        for _ in range(passes):
+            t0 = clock()
+            for _ in range(calls):
+                rec.sample()
+            best = min(best, clock() - t0)
+        return best / calls
+
+    sample_cost = sample_floor()
+    shutil.rmtree(tmp, ignore_errors=True)
+    # a request's amortized share of the background cadence
+    cost_armed = sample_cost * (p50 / interval_s)
+    return {
+        "serving_p50_ms": p50 * 1e3,
+        "ratio_armed": (p50 + cost_armed) / p50,
+        "armed_cost_us_per_request": cost_armed * 1e6,
+        "disabled_cost_us_per_request": 0.0,
+        "sample_cost_us": sample_cost * 1e6,
+    }
+
+
 def bench_profiler_overhead() -> dict:
     """The perf-attribution paired row: serving p50 with the phase
     ledger ARMED (real per-request ledger: queue/prepare/pad/compute
@@ -2640,6 +2722,12 @@ def _run_suite(platform: str) -> dict:
               file=sys.stderr)
         profiler = None
     try:
+        timeline_bench = bench_timeline_overhead()
+    except Exception as e:  # noqa: BLE001 — overhead row is auxiliary
+        print(f"bench: timeline overhead bench failed ({e!r})",
+              file=sys.stderr)
+        timeline_bench = None
+    try:
         ckpt_overhead = bench_trainer_checkpoint_overhead()
     except Exception as e:  # noqa: BLE001 — overhead row is auxiliary
         print(f"bench: trainer checkpoint overhead bench failed ({e!r})",
@@ -2788,6 +2876,18 @@ def _run_suite(platform: str) -> dict:
             "profiler_disabled_cost_us": round(
                 profiler["disabled_cost_us_per_request"], 3)
                 if profiler else None,
+            "timeline_overhead": round(
+                timeline_bench["ratio_armed"], 4)
+                if timeline_bench else None,
+            "timeline_serving_p50_ms": round(
+                timeline_bench["serving_p50_ms"], 3)
+                if timeline_bench else None,
+            "timeline_armed_cost_us": round(
+                timeline_bench["armed_cost_us_per_request"], 3)
+                if timeline_bench else None,
+            "timeline_sample_cost_us": round(
+                timeline_bench["sample_cost_us"], 3)
+                if timeline_bench else None,
             "trainer_checkpoint_overhead": round(
                 ckpt_overhead["ratio_checkpointed"], 4)
                 if ckpt_overhead else None,

@@ -57,6 +57,10 @@ class FleetAutoscaler:
         clock: Any = None,
         metrics: Any = None,
         extra_up: "dict[str, float] | None" = None,
+        timeline: Any = None,
+        trend_window_s: float = 60.0,
+        up_queue_slope: "float | None" = None,
+        up_p99_slope: "float | None" = None,
     ):
         if not 1 <= min_replicas <= max_replicas:
             raise ValueError(
@@ -75,6 +79,19 @@ class FleetAutoscaler:
         # and straggler-wait here; extra signals obey the same
         # down_fraction calm band as the built-ins
         self.extra_up = {k: float(v) for k, v in (extra_up or {}).items()}
+        # trend signals from the telemetry timeline (a TimelineStore or
+        # a TimelineRecorder): windowed least-squares slope of queue
+        # depth and p99 over `trend_window_s`, so scaling acts on where
+        # the fleet is HEADED, not only on where it is. Opt-in via the
+        # slope thresholds (units/second); trends only push UP — a
+        # falling queue never sheds capacity by itself, the calm band
+        # still owns scale-down.
+        self.timeline = getattr(timeline, "store", timeline)
+        self.trend_window_s = float(trend_window_s)
+        self.up_queue_slope = (float(up_queue_slope)
+                               if up_queue_slope is not None else None)
+        self.up_p99_slope = (float(up_p99_slope)
+                             if up_p99_slope is not None else None)
         # calm = every signal under down_fraction * its up threshold —
         # the hysteresis BAND between the up and down trigger points
         self.down_fraction = float(down_fraction)
@@ -105,6 +122,12 @@ class FleetAutoscaler:
             "mmlspark_tpu_autoscaler_scale_events_total",
             "scale actions taken, by direction",
             labels=("direction",))
+        self._g_qslope = reg.gauge(
+            "mmlspark_tpu_autoscaler_queue_slope_rate",
+            "windowed least-squares slope of fleet queue depth (per s)")
+        self._g_pslope = reg.gauge(
+            "mmlspark_tpu_autoscaler_p99_slope_rate",
+            "half-window delta of serving p99 latency (seconds per s)")
         self._g_target.set(self.fleet.n_live)
 
     # -- signal plumbing ------------------------------------------------ #
@@ -117,8 +140,40 @@ class FleetAutoscaler:
                 src.evaluate()
             except Exception:  # noqa: BLE001 — stale windows beat a crash
                 pass
-            return src.signals()
-        return src()
+            sig = src.signals()
+        else:
+            sig = src()
+        sig = dict(sig)
+        sig.update(self._trend())
+        return sig
+
+    def _trend(self) -> dict:
+        """Timeline trend signals: queue-depth slope over the trend
+        window plus the half-window-to-half-window p99 delta rate. Empty
+        (and pressure-neutral) without a timeline or while the history
+        is still shorter than the window."""
+        tl = self.timeline
+        if tl is None:
+            return {}
+        w = self.trend_window_s
+        try:
+            at = tl.last_time()
+            if at is None:
+                return {}
+            qs = tl.slope("mmlspark_tpu_serving_queue_depth", w, at=at)
+            half = w / 2.0
+            p99_now = tl.quantile_over(
+                "mmlspark_tpu_serving_latency_seconds", 0.99, half,
+                at=at)
+            p99_then = tl.quantile_over(
+                "mmlspark_tpu_serving_latency_seconds", 0.99, half,
+                at=at - half)
+            ps = (p99_now - p99_then) / half if half > 0 else 0.0
+        except Exception:  # noqa: BLE001 — trends are advisory inputs
+            return {}
+        self._g_qslope.set(qs)
+        self._g_pslope.set(ps)
+        return {"queue_depth_slope": qs, "p99_latency_slope": ps}
 
     def _pressure(self, sig: dict) -> list[str]:
         """Which up-thresholds the current signals cross (empty = calm
@@ -133,6 +188,13 @@ class FleetAutoscaler:
             reasons.append("shed_rate")
         if sig.get("burn_rate", 0.0) > self.up_burn_rate:
             reasons.append("burn_rate")
+        if (self.up_queue_slope is not None
+                and sig.get("queue_depth_slope", 0.0)
+                > self.up_queue_slope):
+            reasons.append("queue_depth_slope")
+        if (self.up_p99_slope is not None
+                and sig.get("p99_latency_slope", 0.0) > self.up_p99_slope):
+            reasons.append("p99_latency_slope")
         for key, threshold in self.extra_up.items():
             v = sig.get(key, 0.0)
             if v == v and v > threshold:  # NaN-safe
@@ -148,6 +210,14 @@ class FleetAutoscaler:
                 and p99 <= self.up_p99_s * f
                 and sig.get("shed_rate", 0.0) <= self.up_shed_rate * f
                 and sig.get("burn_rate", 0.0) <= self.up_burn_rate * f):
+            return False
+        if (self.up_queue_slope is not None
+                and sig.get("queue_depth_slope", 0.0)
+                > self.up_queue_slope * f):
+            return False
+        if (self.up_p99_slope is not None
+                and sig.get("p99_latency_slope", 0.0)
+                > self.up_p99_slope * f):
             return False
         for key, threshold in self.extra_up.items():
             v = sig.get(key, 0.0)

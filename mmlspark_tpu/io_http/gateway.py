@@ -79,6 +79,8 @@ class ServingGateway:
         exemplars: bool = True,
         flight_recorder_dir: "str | None" = None,
         recorder: Any = None,
+        timeline_dir: "str | None" = None,
+        timeline_interval_s: float = 5.0,
         **breaker_kw,
     ):
         if strategy not in ("least_loaded", "round_robin", "hash"):
@@ -126,6 +128,16 @@ class ServingGateway:
             recorder = FlightRecorder(dump_dir=flight_recorder_dir,
                                       process=f"gateway-{self.server_label}")
         self.recorder = recorder
+        # opt-in metrics history: the gateway samples its OWN registry
+        # (routing counters, inflight, latency) into segment files so
+        # `diagnose.py --history` can replay a routing incident
+        self.timeline = None
+        if timeline_dir is not None:
+            from ..observability.timeline import TimelineRecorder
+
+            self.timeline = TimelineRecorder(
+                timeline_dir, self.metrics, clock=self.clock,
+                interval_s=timeline_interval_s, recorder=recorder)
 
     # -- metrics -------------------------------------------------------- #
 
@@ -464,6 +476,8 @@ class ServingGateway:
         self.port = self._server.server_address[1]
         threading.Thread(target=self._server.serve_forever,
                          daemon=True).start()
+        if self.timeline is not None:
+            self.timeline.start()
         return self
 
     @property
@@ -472,6 +486,12 @@ class ServingGateway:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.timeline is not None:
+            try:
+                self.timeline.sample()       # the shutdown-edge sample
+            except Exception:  # noqa: BLE001 — telemetry stays optional
+                pass
+            self.timeline.stop()
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()

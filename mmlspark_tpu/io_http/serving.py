@@ -1977,6 +1977,9 @@ class ServingFleet:
                  rendezvous: bool = True, forwarding=None,
                  trace_dir: "str | None" = None,
                  flight_recorder_dir: "str | None" = None,
+                 timeline_dir: "str | None" = None,
+                 timeline_interval_s: float = 5.0,
+                 timeline_keep: int = 8,
                  stop_timeout_s: float = 15.0, clock: Any = None,
                  stale_after_s: float = 10.0, **server_kw):
         self.handler_factory = handler_factory
@@ -1993,6 +1996,17 @@ class ServingFleet:
         # when set, every replica arms a FlightRecorder dumping into this
         # directory (tools/diagnose.py --postmortem merges the dumps)
         self.flight_recorder_dir = flight_recorder_dir
+        # when set, a TimelineRecorder runs on the DRIVER beside the
+        # rendezvous aggregator, persisting the merged fleet scrape as
+        # segment files (tools/diagnose.py --history replays them);
+        # requires rendezvous=True — there is no fleet view without it
+        self.timeline_dir = timeline_dir
+        self.timeline_interval_s = float(timeline_interval_s)
+        self.timeline_keep = int(timeline_keep)
+        if timeline_dir is not None and not rendezvous:
+            raise ValueError("timeline_dir needs rendezvous=True "
+                             "(the recorder samples the aggregator)")
+        self.timeline: "Any | None" = None
         # how long stop() waits for the graceful drain-and-flush before
         # falling back to a hard kill
         self.stop_timeout_s = stop_timeout_s
@@ -2171,6 +2185,15 @@ class ServingFleet:
     def start(self) -> "ServingFleet":
         if self.rendezvous is not None:
             self.rendezvous.start()
+        if self.timeline_dir is not None and self.timeline is None:
+            from ..observability.recorder import get_recorder
+            from ..observability.timeline import TimelineRecorder
+
+            self.timeline = TimelineRecorder(
+                self.timeline_dir, self.rendezvous.aggregator,
+                clock=self.clock, interval_s=self.timeline_interval_s,
+                keep=self.timeline_keep, recorder=get_recorder())
+            self.timeline.start()
         # spawn all workers in parallel, then run each handshake
         started = []
         for slot in range(self.n_hosts):
@@ -2311,6 +2334,13 @@ class ServingFleet:
         pushed to the rendezvous, traces exported); workers that miss
         `stop_timeout_s` get the historical hard kill. The rendezvous
         stops LAST so the final flushes have somewhere to land."""
+        if self.timeline is not None:
+            try:
+                self.timeline.sample()       # the shutdown-edge sample
+            except Exception:  # noqa: BLE001 — telemetry stays optional
+                pass
+            self.timeline.stop()
+            self.timeline = None
         with self._fleet_lock:
             procs = list(self._procs)
         for p in procs:

@@ -27,6 +27,8 @@ from .fleet import (MetricFamily, MetricSample, FamilyList,
                     GAUGE_MERGE_POLICIES, FLEET_REPLICA, REPLICA_LABEL)
 from .slo import (SLO, SLOEngine, SeriesReader, availability_slo,
                   latency_slo)
+from .timeline import (TimelineStore, TimelineRecorder, AlertRule,
+                       AlertEngine, RegressionWatch, TIMELINE_SERIES)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "DEFAULT_BUCKETS",
@@ -47,4 +49,6 @@ __all__ = [
     "render_families", "merge_policy_for", "GAUGE_MERGE_POLICIES",
     "FLEET_REPLICA", "REPLICA_LABEL", "SLO", "SLOEngine", "SeriesReader",
     "availability_slo", "latency_slo",
+    "TimelineStore", "TimelineRecorder", "AlertRule", "AlertEngine",
+    "RegressionWatch", "TIMELINE_SERIES",
 ]

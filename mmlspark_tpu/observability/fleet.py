@@ -364,6 +364,26 @@ GAUGE_MERGE_POLICIES: dict[str, str] = {
     "mmlspark_tpu_sweep_rung_survivors_count": "last",
     "mmlspark_tpu_sweep_workers_live_count": "last",
     "mmlspark_tpu_sweep_inflight_trials_depth": "last",
+    # telemetry timeline (observability/timeline.py): alert state is
+    # 0 ok / 1 pending / 2 firing — ANY source firing means the fleet
+    # is firing, so "max", never the _count suffix default (sum, which
+    # would read two pending replicas as one firing)
+    "mmlspark_tpu_timeline_alert_state_count": "max",
+    # the stalest recorder is the one whose history has a hole — worst
+    # inter-sample gap is the pageable cadence-health signal
+    "mmlspark_tpu_timeline_last_sample_age_seconds": "max",
+    # segment inventory lives on the ONE driver-side recorder; "last"
+    # over the _count default (sum) for the same reason as the gateway
+    # singletons above
+    "mmlspark_tpu_timeline_segments_count": "last",
+    # newest alert-triggered dump wins: --history anchors the incident
+    # table on the latest black-box evidence
+    "mmlspark_tpu_timeline_dump_timestamp_seconds": "max",
+    # autoscaler trend signals are computed on the ONE driver from the
+    # timeline; worst (steepest) observed trend is the actionable view
+    # if several scrape sources ever report them
+    "mmlspark_tpu_autoscaler_queue_slope_rate": "max",
+    "mmlspark_tpu_autoscaler_p99_slope_rate": "max",
 }
 
 _SUFFIX_POLICIES: tuple[tuple[str, str], ...] = (

@@ -93,13 +93,20 @@ class FlightRecorder:
                       SLO-transition triggers); explicit `dump()` and
                       terminal triggers (`sigterm`, `exception`, ...)
                       via `trigger_dump(..., force=True)` ignore it
+    keep              dump-directory retention: after each dump, only
+                      the newest `keep` dumps from THIS process survive
+                      (mirrors TrainingCheckpointer's keep-N; None keeps
+                      everything). Prunes are counted in
+                      `recorder_dumps_pruned_total` so a flapping
+                      trigger eating its own history is visible.
     """
 
     def __init__(self, capacity: int = 4096, clock: Any = None,
                  enabled: bool = True, dump_dir: "str | None" = None,
                  process: str = "proc", tick_interval_s: float = 5.0,
                  spike_window_s: float = 1.0, spike_threshold: int = 50,
-                 dump_cooldown_s: float = 30.0, registry: Any = None):
+                 dump_cooldown_s: float = 30.0, registry: Any = None,
+                 keep: "int | None" = None):
         self.enabled = bool(enabled)
         self.dump_dir = dump_dir
         self.process = str(process)
@@ -107,6 +114,9 @@ class FlightRecorder:
         self.spike_window_s = float(spike_window_s)
         self.spike_threshold = int(spike_threshold)
         self.dump_cooldown_s = float(dump_cooldown_s)
+        if keep is not None and int(keep) < 1:
+            raise ValueError("keep must be >= 1 (or None to disable)")
+        self.keep = int(keep) if keep is not None else None
         # injectable registry the tick deltas and dump snapshot read from
         # (None: the process default at call time)
         self.registry = registry
@@ -354,12 +364,57 @@ class FlightRecorder:
             except OSError:
                 pass
             raise
+        if self.keep is not None:
+            self._prune_dumps()
         if self.on_dump is not None:
             try:
                 self.on_dump(trigger, path)
             except Exception:  # noqa: BLE001 — a broken hook keeps the dump
                 pass
         return path
+
+    def _prune_dumps(self) -> None:
+        """keep-N retention over THIS process's dumps, oldest first —
+        other processes sharing the directory own their own files. The
+        just-written dump is never pruned (keep >= 1)."""
+        prefix = f"{DUMP_PREFIX}{self.process}-"
+        try:
+            names = [n for n in os.listdir(self.dump_dir)
+                     if n.startswith(prefix) and n.endswith(".jsonl")]
+        except OSError:
+            return
+        if len(names) <= self.keep:
+            return
+
+        def _order(n: str) -> "tuple[float, str]":
+            try:
+                return (os.path.getmtime(os.path.join(self.dump_dir, n)),
+                        n)
+            except OSError:
+                return (0.0, n)
+
+        names.sort(key=_order)
+        pruned = 0
+        for n in names[:len(names) - self.keep]:
+            try:
+                os.unlink(os.path.join(self.dump_dir, n))
+                pruned += 1
+            except OSError:
+                pass
+        if not pruned:
+            return
+        try:
+            registry = self.registry
+            if registry is None:
+                from .metrics import get_registry
+
+                registry = get_registry()
+            registry.counter(
+                "mmlspark_tpu_recorder_dumps_pruned_total",
+                "flight-recorder dumps removed by keep-N retention",
+            ).inc(pruned)
+        except Exception:  # noqa: BLE001 — retention metrics are best-effort
+            pass
 
 
 def load_dump(path: str) -> "tuple[dict, list[dict]]":

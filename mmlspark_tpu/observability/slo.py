@@ -223,6 +223,10 @@ class SLOEngine:
         self._history: dict[str, deque] = {s.name: deque() for s in self.slos}
         # cumulative shed counters history for signals() shed_rate
         self._shed_history: deque = deque()
+        # per-evaluation worst burn history: signals() averages it over
+        # the short window so a one-tick spike cannot page the
+        # autoscaler (the instantaneous gauge still spikes, by design)
+        self._burn_history: deque = deque()
         self._last_results: dict[str, dict] = {}
         # optional flight recorder: every evaluation reports the alerting
         # set, and the recorder dumps on the not-alerting -> alerting
@@ -294,6 +298,10 @@ class SLOEngine:
                 + reader.counter("mmlspark_tpu_resilience_breaker_shed_total"))
         self._shed_history.append((now, shed, 0.0))
         self._prune(self._shed_history, now)
+        burn_now = max((max(res["burn_rates"].values(), default=0.0)
+                        for res in results.values()), default=0.0)
+        self._burn_history.append((now, burn_now, 0.0))
+        self._prune(self._burn_history, now)
         self._last_results = results
         if self._recorder is not None:
             try:
@@ -321,7 +329,13 @@ class SLOEngine:
 
     def signals(self) -> dict:
         """The scaling signals the ROADMAP autoscaler consumes, in one
-        dict: queue depth, p99 latency, shed rate, burn rate, budget."""
+        dict: queue depth, p99 latency, shed rate, burn rate, budget.
+
+        `burn_rate` is the per-evaluation worst burn AVERAGED over the
+        short window, not the instantaneous gauge: scaling decisions
+        must ride trends, and a single hot evaluation between two quiet
+        ones is noise, not load (the raw spike still reaches the
+        `slo_burn_rate` gauge and the burn-transition dump trigger)."""
         reader = SeriesReader(self.source)
         now = self._clock.monotonic()
         short = min(self.windows.values())
@@ -333,8 +347,10 @@ class SLOEngine:
         span = short
         if self._shed_history:
             span = max(min(now - self._shed_history[0][0], short), 1e-9)
-        burns = [max(res["burn_rates"].values(), default=0.0)
-                 for res in self._last_results.values()]
+        burn_pts = [b for t, b, _z in self._burn_history
+                    if t > now - short]
+        burn_windowed = (sum(burn_pts) / len(burn_pts)) if burn_pts \
+            else 0.0
         budgets = [res["budget_remaining"]
                    for res in self._last_results.values()]
         up = reader.gauge("mmlspark_tpu_fleet_replicas_up_count")
@@ -343,7 +359,7 @@ class SLOEngine:
             "p99_latency_s": reader.histogram_quantile(
                 "mmlspark_tpu_serving_latency_seconds", 0.99),
             "shed_rate": d_shed / span,
-            "burn_rate": max(burns, default=0.0),
+            "burn_rate": burn_windowed,
             "budget_remaining": min(budgets, default=1.0),
             "replicas_up": up,
         }

@@ -405,6 +405,7 @@ class ParallelStreamingQuery(StreamingQuery):
                  fleet: Any = None,
                  fleet_kw: "dict | None" = None,
                  worker_request_timeout_s: float = 60.0,
+                 timeline_dir: "str | None" = None,
                  **kw: Any) -> None:
         if workers not in ("thread", "fleet"):
             raise ValueError("workers must be 'thread' or 'fleet'")
@@ -505,6 +506,17 @@ class ParallelStreamingQuery(StreamingQuery):
         self._g_spill = _children(
             "mmlspark_tpu_streaming_state_spill_bytes",
             "state-backend bytes spilled to parquet, per partition")
+        # opt-in per-partition telemetry history: one timeline sample per
+        # committed batch (event-driven, no background thread — the
+        # commit IS the cadence), recording lag/depth/watermark per
+        # partition. This is the observed-history half of the ROADMAP's
+        # dynamic-rebalancing item: the rebalancer needs to know how
+        # skewed each partition HAS BEEN, not just how skewed it is now.
+        self._timeline = None
+        if timeline_dir is not None:
+            from ..observability.timeline import TimelineRecorder
+
+            self._timeline = TimelineRecorder(timeline_dir, reg)
 
     # -- recovery ---------------------------------------------------------- #
 
@@ -784,6 +796,11 @@ class ParallelStreamingQuery(StreamingQuery):
                 self._g_wm[p].set(float(info["watermark"]))
             self._g_spill[p].set(float(info.get("spilled_bytes") or 0))
             self._g_depth[p].set(float(info.get("queue_depth") or 0))
+        if self._timeline is not None:
+            try:
+                self._timeline.sample()
+            except Exception:  # noqa: BLE001 — history must not fail commits
+                pass
 
     def _commit(self, bid: int, end, rows: int,
                 duration_s: float = 0.0) -> None:

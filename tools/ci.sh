@@ -18,6 +18,9 @@
 #      + elastic-training smoke (real elastic GBDT fit with a worker
 #        kill and a join mid-fit; world-epoch/member/re-shard table
 #        assertions)
+#      + timeline-history smoke (recorded incident: alert fires after
+#        for_s on a fake clock, dump triggered, segment store replayed
+#        into a byte-stable --history report)
 #   3. bench regression gate over the BENCH_*/MULTICHIP_* trajectory
 #   4. pipeline-fusion segment report (fails if an exemplar stops fusing)
 #   5. full test suite on the 8-virtual-device CPU mesh
@@ -37,6 +40,7 @@ python tools/diagnose.py --perf --selftest
 python tools/diagnose.py --checkpoints --selftest
 python tools/diagnose.py --sweep --selftest
 python tools/diagnose.py --training --selftest
+python tools/diagnose.py --history --selftest
 python tools/bench_gate.py --selftest
 python tools/fusion_report.py
 python -m pytest tests/ -q
@@ -45,6 +49,6 @@ MMLSPARK_TPU_SANITIZE=1 python -m pytest -q \
     tests/test_resilience.py tests/test_observability.py \
     tests/test_automl_sweep.py tests/test_elastic_fleet.py \
     tests/test_dataplane.py tests/test_sharded_fusion.py \
-    tests/test_donated_pipelined.py
+    tests/test_donated_pipelined.py tests/test_timeline.py
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
 MMLSPARK_TPU_BENCH_FORCE_CPU=1 python bench.py

@@ -51,6 +51,21 @@ Four entry modes:
       real store plus a real checkpointed GBDT fit, including corruption
       fallback.
 
+  python tools/diagnose.py --history SEGMENT_DIR
+      Retrospective incident report from a telemetry timeline segment
+      directory (observability/timeline.py): segment inventory, every
+      recorded alert edge with its rule/severity/breaching series,
+      flight-recorder dump timestamps, and the breaching series' values
+      around the newest firing edge — all reconstructed from the
+      checksummed segment files alone, no live process needed.
+      `--history --selftest` drives a synthetic 3-segment incident and
+      asserts the reconstruction end to end, byte-stably.
+
+  python tools/diagnose.py --watch http://HOST:PORT
+      Refreshing one-screen live dashboard: re-scrape the /metrics URL
+      every --interval seconds, clear the screen, and reprint the fleet
+      table plus the between-scrape request rate.
+
   python tools/diagnose.py --selftest
       Spin up a real 2-replica ServingFleet in-process, push traffic
       through it, diagnose it, then stand up a hot-path serve_model
@@ -1557,6 +1572,239 @@ def selftest() -> int:
     return 0
 
 
+# --------------------------------------------------------------------- #
+# --history: retrospective incident table from timeline segments        #
+# --------------------------------------------------------------------- #
+
+_ALERT_STATE = "mmlspark_tpu_timeline_alert_state_count"
+_DUMP_TS = "mmlspark_tpu_timeline_dump_timestamp_seconds"
+_STATE_NAMES = {0: "ok", 1: "pending", 2: "firing"}
+
+
+def _history_scalar(v) -> float:
+    if isinstance(v, dict):
+        return float(v.get("count", 0.0))
+    return float(v)
+
+
+def diagnose_history(seg_dir: str, window_s: float = 60.0) -> str:
+    """Reconstruct an incident from a timeline segment directory alone —
+    no live process, no scrape. Prints the segment inventory, every
+    alert edge the recorded alert-state series contains, the
+    flight-recorder dump timestamps, and a table of the breaching
+    series around the newest firing edge. Output is a pure function of
+    the segment bytes (times are printed relative to the first sample),
+    so two identical directories render byte-identical reports."""
+    from mmlspark_tpu.observability.timeline import TimelineStore
+
+    store = TimelineStore(seg_dir)
+    segs = store.segments()
+    out = [f"== timeline history: {os.path.basename(os.path.normpath(seg_dir))} =="]
+    if not segs:
+        out.append("  (no segment files)")
+        return "\n".join(out)
+    t0 = min((s["t_first"] for s in segs if s["intact"]
+              and s["t_first"] is not None), default=0.0)
+
+    def rel(t: "float | None") -> str:
+        return "-" if t is None else f"{t - t0:+.1f}s"
+
+    rows = [[f"{s['seq']:d}", str(s["samples"]),
+             rel(s["t_first"]), rel(s["t_last"]),
+             "ok" if s["intact"] else "CORRUPT"] for s in segs]
+    out.append(_render_table(rows, ["seg", "samples", "first", "last",
+                                    "integrity"]))
+    # alert edges: every labelset of the recorded alert-state series
+    alert_series = store.series(_ALERT_STATE)
+    edges = []       # (t_edge, rule, severity, series, final_state)
+    for lbl_json, pts in sorted(alert_series.items()):
+        lbl = json.loads(lbl_json or "{}")
+        prev = 0.0
+        edge_t = None
+        for t, v in pts:
+            v = _history_scalar(v)
+            if v >= 2.0 > prev:
+                edge_t = t
+            prev = v
+        final = _STATE_NAMES.get(int(prev), str(prev))
+        edges.append((edge_t, lbl.get("rule", "?"),
+                      lbl.get("severity", "?"), lbl.get("series", "?"),
+                      final))
+    out.append("")
+    if not edges:
+        out.append("  (no alert-state series recorded)")
+        return "\n".join(out)
+    rows = [[rule, sev, series, final, rel(t)]
+            for t, rule, sev, series, final in edges]
+    out.append(_render_table(rows, ["rule", "severity", "series",
+                                    "state", "firing_edge"]))
+    # flight-recorder dumps, as recorded into the segments
+    dump_pts = [(t, _history_scalar(v))
+                for pts in store.series(_DUMP_TS).values()
+                for t, v in pts if _history_scalar(v) > 0]
+    dump_ts = sorted({v for _t, v in dump_pts})
+    out.append("")
+    if dump_ts:
+        out.append("  dumps triggered at: "
+                   + ", ".join(rel(v) for v in dump_ts))
+    else:
+        out.append("  dumps triggered at: (none recorded)")
+    # the incident table: breaching series around the newest firing edge
+    fired = [(t, rule, series) for t, rule, _sev, series, _f in edges
+             if t is not None]
+    if not fired:
+        return "\n".join(out)
+    edge_t, rule, breaching = max(fired)
+    out.append("")
+    out.append(f"== incident: {rule} (series {breaching}) "
+               f"fired {rel(edge_t)} ==")
+    series_pts = []
+    for pts in store.series(breaching, since=edge_t - window_s,
+                            until=edge_t + window_s).values():
+        series_pts.extend((t, _history_scalar(v)) for t, v in pts)
+    series_pts.sort()
+    state_pts = []
+    for lbl_json, pts in alert_series.items():
+        if json.loads(lbl_json or "{}").get("rule") == rule:
+            state_pts.extend((t, _history_scalar(v)) for t, v in pts)
+    state_pts.sort()
+
+    def state_at(t: float) -> str:
+        cur = 0.0
+        for ts, v in state_pts:
+            if ts > t:
+                break
+            cur = v
+        return _STATE_NAMES.get(int(cur), str(cur))
+
+    rows = [[rel(t), _fmt(v, 3), state_at(t),
+             "<-- edge" if t >= edge_t and (i == 0 or
+                                            series_pts[i - 1][0] < edge_t)
+             else ""]
+            for i, (t, v) in enumerate(series_pts)]
+    out.append(_render_table(rows, ["t", breaching, "alert", ""]))
+    return "\n".join(out)
+
+
+def history_selftest() -> int:
+    """Synthetic 3-segment incident, asserted end to end: a gauge spike
+    drives an AlertEngine rule through pending into firing on a
+    FakeClock, the firing edge triggers a flight-recorder dump, and the
+    retrospective table rebuilt from the segment files alone names the
+    breaching series, the alert edge, and the dump timestamp —
+    byte-identically across two independent runs."""
+    import shutil
+    import tempfile
+
+    from mmlspark_tpu.observability.metrics import MetricsRegistry
+    from mmlspark_tpu.observability.recorder import FlightRecorder
+    from mmlspark_tpu.observability.timeline import (
+        AlertEngine, AlertRule, TimelineRecorder, TimelineStore)
+    from mmlspark_tpu.resilience.policy import FakeClock
+
+    def run_once(root: str) -> "tuple[str, list[str]]":
+        seg_dir = os.path.join(root, "segments")
+        dump_dir = os.path.join(root, "dumps")
+        clk = FakeClock()
+        reg = MetricsRegistry()
+        g = reg.gauge("mmlspark_tpu_serving_queue_depth", "t")
+        store = TimelineStore(seg_dir, keep=8, segment_samples=6)
+        fr = FlightRecorder(dump_dir=dump_dir, clock=clk, registry=reg,
+                            process="selftest")
+        engine = AlertEngine(store, [AlertRule(
+            "queue_hot",
+            "avg_over(mmlspark_tpu_serving_queue_depth[6s]) > 50",
+            for_s=4.0, severity="page", dump=True)],
+            clock=clk, recorder=fr)
+        rec = TimelineRecorder(store, reg, clock=clk, alerts=engine)
+        for i in range(16):
+            g.set(3.0 if i < 8 else 100.0)
+            rec.sample()
+            clk.sleep(2.0)
+        dumps = sorted(os.listdir(dump_dir)) if os.path.isdir(dump_dir) \
+            else []
+        n_segs = len([f for f in os.listdir(seg_dir)
+                      if f.startswith("seg-")])
+        return diagnose_history(seg_dir), dumps, n_segs
+
+    root = tempfile.mkdtemp(prefix="mml_history_selftest_")
+    try:
+        report_a, dumps_a, segs_a = run_once(os.path.join(root, "a"))
+        report_b, _dumps_b, _segs_b = run_once(os.path.join(root, "b"))
+        checks = {
+            "3 segments on disk": segs_a == 3,
+            "breaching series named":
+                "mmlspark_tpu_serving_queue_depth" in report_a,
+            "alert edge found": "firing" in report_a
+                                and "<-- edge" in report_a,
+            "rule named": "queue_hot" in report_a,
+            "dump landed on disk": len(dumps_a) == 1,
+            "dump timestamp recorded":
+                "dumps triggered at: +" in report_a,
+            "byte-stable across runs": report_a == report_b,
+        }
+        failed = [name for name, ok in checks.items() if not ok]
+        if failed:
+            print(report_a)
+            print(f"history selftest FAILED: {failed}", file=sys.stderr)
+            return 1
+        print(f"history selftest OK ({len(checks)} checks)")
+        return 0
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+# --------------------------------------------------------------------- #
+# --watch: refreshing one-screen live dashboard                         #
+# --------------------------------------------------------------------- #
+
+def diagnose_watch(url: str, interval_s: float = 2.0,
+                   iterations: "int | None" = None) -> int:
+    """Refreshing one-screen dashboard off repeated scrapes: clears the
+    terminal, reprints the fleet table, and shows the request rate
+    measured BETWEEN scrapes (the live delta a single snapshot cannot
+    show). Ctrl-C stops; `iterations` bounds the loop for tests."""
+    import time as _time
+
+    if not url.rstrip("/").endswith("/metrics"):
+        url = url.rstrip("/") + "/metrics"
+    n = 0
+    prev_seen: "float | None" = None
+    prev_t: "float | None" = None
+    try:
+        while iterations is None or n < iterations:
+            text = _fetch(url)
+            now = _time.monotonic()
+            reader = SeriesReader(_snapshot_of_text(text))
+            seen = reader.counter(_SEEN)
+            rate = ""
+            if prev_seen is not None and now > prev_t:
+                rate = (f"  rate {((seen - prev_seen) / (now - prev_t)):.1f}"
+                        " req/s")
+            prev_seen, prev_t = seen, now
+            n += 1
+            body = diagnose_text(text)
+            sys.stdout.write("\x1b[2J\x1b[H"
+                             f"watch #{n}  {url}{rate}  (Ctrl-C stops)\n\n"
+                             + body + "\n")
+            sys.stdout.flush()
+            if iterations is not None and n >= iterations:
+                break
+            _time.sleep(interval_s)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def _snapshot_of_text(text: str) -> dict:
+    """Fleet-merged snapshot from one exposition text (the --watch
+    reader path: merge policies applied exactly as the aggregator
+    would)."""
+    agg = MetricsAggregator()
+    agg.push("watch", text)
+    return agg.snapshot()
+
+
 def main(argv: "list[str] | None" = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     g = ap.add_mutually_exclusive_group()
@@ -1594,6 +1842,18 @@ def main(argv: "list[str] | None" = None) -> int:
                          "training checkpoint directory (with "
                          "--selftest: real in-process elastic fit with "
                          "a kill + a join, then assert the table)")
+    ap.add_argument("--history", nargs="?", const="", metavar="DIR",
+                    help="retrospective incident table from a telemetry "
+                         "timeline segment directory — alert edges, "
+                         "breaching series, dump timestamps — no live "
+                         "process needed (with --selftest: synthetic "
+                         "3-segment incident asserted end to end)")
+    ap.add_argument("--watch", metavar="URL",
+                    help="refreshing one-screen live dashboard off "
+                         "repeated scrapes of a /metrics URL "
+                         "(Ctrl-C stops)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="--watch refresh cadence in seconds")
     ap.add_argument("--selftest", action="store_true",
                     help="run a 2-replica fleet and diagnose it (with "
                          "--postmortem/--streaming: the matching "
@@ -1603,11 +1863,22 @@ def main(argv: "list[str] | None" = None) -> int:
     args = ap.parse_args(argv)
     modes = [args.rendezvous, args.urls, args.gateway, args.serving,
              args.postmortem, args.streaming, args.perf, args.checkpoints,
-             args.sweep, args.training, args.selftest or None]
+             args.sweep, args.training, args.history, args.watch,
+             args.selftest or None]
     if not any(m for m in modes):
         ap.error("pick a mode: --rendezvous/--urls/--gateway/--serving/"
                  "--postmortem/--streaming/--perf/--checkpoints/"
-                 "--sweep/--training/--selftest")
+                 "--sweep/--training/--history/--watch/--selftest")
+    if args.history is not None:
+        if args.selftest:
+            return history_selftest()
+        if not args.history:
+            ap.error("--history needs a timeline segment directory "
+                     "(or --selftest)")
+        print(diagnose_history(args.history))
+        return 0
+    if args.watch:
+        return diagnose_watch(args.watch, interval_s=args.interval)
     if args.training is not None:
         if args.selftest:
             return training_selftest()
