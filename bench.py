@@ -2086,6 +2086,230 @@ def bench_serving_hot_path() -> dict:
             "crossover": servers["hot"].hot_path.snapshot()["crossover"]}
 
 
+def bench_serving_binary_wire() -> dict:
+    """Binary wire protocol vs JSON on the SAME hot-path server, PAIRED:
+    identical feature rows posted over persistent connections as framed
+    binary (io_http/wire.py — no JSON parse, no decimal float round
+    trip) and as JSON, at client concurrency 1/32/256. Rows are client
+    RTT p50/p99 per protocol, keyed per concurrency so bench_gate
+    tracks each rung; the json_vs_binary ratios are the headline."""
+    import http.client
+
+    from mmlspark_tpu.core.schema import Table
+    from mmlspark_tpu.gbdt.estimators import GBDTRegressor
+    from mmlspark_tpu.io_http import wire
+    from mmlspark_tpu.io_http.schema import HTTPRequestData
+    from mmlspark_tpu.io_http.serving import serve_model
+
+    x, y = make_dataset(2048, 8, seed=13)
+    x = x.astype(np.float32).astype(np.float64)
+    model = GBDTRegressor(num_iterations=10, num_leaves=15).fit(
+        Table({"features": x, "label": y.astype(np.float64)}))
+    cols = [f"f{j}" for j in range(8)]
+    warm = HTTPRequestData.from_json(
+        "/", {c: float(x[0, j]) for j, c in enumerate(cols)})
+    json_bodies = [json.dumps(
+        {c: float(x[i, j]) for j, c in enumerate(cols)}).encode()
+        for i in range(64)]
+    bin_bodies = [wire.encode_features_request(x[i:i + 1])
+                  for i in range(64)]
+    json_hdrs = {"Content-Type": "application/json"}
+    bin_hdrs = {"Content-Type": wire.WIRE_CONTENT_TYPE,
+                "Accept": wire.WIRE_CONTENT_TYPE}
+
+    srv = serve_model(model, cols, max_batch_size=256, warmup_request=warm)
+
+    def drive(bodies, headers, n_clients, per_client):
+        rtt, errors = [], []
+        barrier = threading.Barrier(n_clients)
+
+        def client(k):
+            conn = http.client.HTTPConnection(srv.host, srv.port,
+                                              timeout=60)
+            try:
+                conn.connect()
+                barrier.wait()
+                for i in range(per_client):
+                    body = bodies[(k * per_client + i) % len(bodies)]
+                    t0 = time.perf_counter()
+                    for attempt in (0, 1):
+                        try:
+                            conn.request("POST", srv.api_path, body=body,
+                                         headers=headers)
+                            r = conn.getresponse()
+                            r.read()
+                            break
+                        except (OSError, http.client.HTTPException):
+                            conn.close()
+                            conn = http.client.HTTPConnection(
+                                srv.host, srv.port, timeout=60)
+                            if attempt:
+                                raise
+                    if r.status != 200:
+                        errors.append(r.status)
+                    rtt.append(time.perf_counter() - t0)
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(repr(e))
+            finally:
+                conn.close()
+
+        threads = [threading.Thread(target=client, args=(k,))
+                   for k in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise RuntimeError(f"binary-wire bench clients failed: "
+                               f"{errors[:3]} (+{max(len(errors)-3, 0)})")
+        return np.asarray(rtt) * 1e3
+
+    out: dict = {}
+    try:
+        deadline = time.monotonic() + 180.0
+        while not srv.ready:
+            if time.monotonic() > deadline:
+                raise TimeoutError("serving server never became ready")
+            time.sleep(0.02)
+        for n_clients in (1, 32, 256):
+            per_client = max(2, 512 // n_clients) if n_clients > 1 else 100
+            # two alternating passes per protocol, best-of: clock drift
+            # on a busy box would otherwise bias whichever ran second
+            for proto, bodies, hdrs in 2 * (
+                    ("binary", bin_bodies, bin_hdrs),
+                    ("json", json_bodies, json_hdrs)):
+                drive(bodies, hdrs, min(n_clients, 8), 3)   # warm conns
+                ms = drive(bodies, hdrs, n_clients, per_client)
+                for q, tag in ((50, "p50"), (99, "p99")):
+                    key = f"{proto}_c{n_clients}_rtt_{tag}_ms"
+                    val = float(np.percentile(ms, q))
+                    out[key] = min(out.get(key, val), val)
+            out[f"json_vs_binary_c{n_clients}_rtt_p50"] = (
+                out[f"json_c{n_clients}_rtt_p50_ms"]
+                / max(out[f"binary_c{n_clients}_rtt_p50_ms"], 1e-9))
+        # the protocol counter must agree that both wires were exercised
+        protos = srv.protocol_counts()
+        out["binary_requests"] = int(protos.get("binary", 0))
+        out["json_requests"] = int(protos.get("json", 0))
+    finally:
+        srv.stop()
+    return out
+
+
+def bench_gateway_tier() -> dict:
+    """One gateway process vs an SO_REUSEPORT tier of N workers on the
+    SAME backend fleet: sustained throughput over many keep-alive client
+    connections (the kernel balances the tier by CONNECTION, so the
+    drive spreads sockets), then a kill window where a tier worker is
+    SIGKILLed mid-drive and every request goes through the pooled
+    product client — the stale-socket retry must absorb the death, so
+    the honest error count is 0."""
+    import http.client
+    import os as _os
+    import urllib.parse
+
+    from mmlspark_tpu.io_http.clients import http_send
+    from mmlspark_tpu.io_http.gateway import GatewayTier, ServingGateway
+    from mmlspark_tpu.io_http.schema import HTTPRequestData
+    from mmlspark_tpu.io_http.serving import ServingFleet
+
+    n_workers = max(2, min(8, _os.cpu_count() or 1))
+    fleet = ServingFleet(_fleet_gateway_factory, n_hosts=2).start()
+    body = json.dumps({"x": 2.0}).encode()
+
+    def throughput(url, n_conns=16, seconds=3.0):
+        p = urllib.parse.urlsplit(url)
+        stop_at = [0.0]
+        counts = [0] * n_conns
+        barrier = threading.Barrier(n_conns)
+
+        def client(k):
+            conn = http.client.HTTPConnection(p.hostname, p.port,
+                                              timeout=30)
+            try:
+                conn.connect()
+                barrier.wait()
+                if k == 0:
+                    stop_at[0] = time.monotonic() + seconds
+                while not stop_at[0]:
+                    time.sleep(0.001)
+                while time.monotonic() < stop_at[0]:
+                    try:
+                        conn.request("POST", p.path or "/", body=body,
+                                     headers={"Content-Type":
+                                              "application/json"})
+                        r = conn.getresponse()
+                        r.read()
+                        if r.status == 200:
+                            counts[k] += 1
+                    except (OSError, http.client.HTTPException):
+                        conn.close()
+                        conn = http.client.HTTPConnection(
+                            p.hostname, p.port, timeout=30)
+            finally:
+                conn.close()
+
+        threads = [threading.Thread(target=client, args=(k,))
+                   for k in range(n_conns)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = max(time.monotonic() - t0, 1e-9)
+        return sum(counts) / wall
+
+    gw = ServingGateway(urls=fleet.urls).start()
+    tier = None
+    try:
+        single_rps = throughput(gw.url)
+        gw.stop()
+        gw = None
+        tier = GatewayTier(urls=fleet.urls, n_workers=n_workers).start()
+        throughput(tier.url, seconds=1.0)          # warm all workers
+        tier_rps = throughput(tier.url)
+
+        # kill window: product client (pool + stale retry) under threads,
+        # one tier worker SIGKILLed mid-window, then respawned
+        statuses: list = []
+        lock = threading.Lock()
+
+        def pooled_client():
+            for _ in range(40):
+                r = http_send(HTTPRequestData.from_json(
+                    tier.url, {"x": 2.0}))
+                with lock:
+                    statuses.append(r.status_code)
+
+        threads = [threading.Thread(target=pooled_client)
+                   for _ in range(4)]
+        killer = threading.Timer(0.05, tier.kill_worker, args=(1,))
+        killer.start()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        killer.join()
+        tier.respawn_worker(1)
+        kill_errors = sum(1 for s in statuses if s != 200)
+        alive = sum(1 for w in tier.workers() if w["alive"])
+    finally:
+        if gw is not None:
+            gw.stop()
+        if tier is not None:
+            tier.stop()
+        fleet.stop()
+    return {
+        "single_requests_per_sec": single_rps,
+        "tier_requests_per_sec": tier_rps,
+        "tier_vs_single_x": tier_rps / max(single_rps, 1e-9),
+        "tier_workers": n_workers,
+        "kill_errors": kill_errors,
+        "kill_requests": len(statuses),
+        "workers_alive_after_respawn": alive,
+    }
+
+
 def bench_recommendation_topk() -> dict:
     """Device-resident SAR top-k serving vs the handler path, PAIRED: the
     same fitted model served twice (`hot_path=False` is exactly the
@@ -2750,6 +2974,17 @@ def _run_suite(platform: str) -> dict:
               file=sys.stderr)
         hot_serving = None
     try:
+        binary_wire = bench_serving_binary_wire()
+    except Exception as e:  # noqa: BLE001 — wire row is auxiliary
+        print(f"bench: serving binary wire bench failed ({e!r})",
+              file=sys.stderr)
+        binary_wire = None
+    try:
+        gateway_tier = bench_gateway_tier()
+    except Exception as e:  # noqa: BLE001 — tier row is auxiliary
+        print(f"bench: gateway tier bench failed ({e!r})", file=sys.stderr)
+        gateway_tier = None
+    try:
         rec_topk = bench_recommendation_topk()
     except Exception as e:  # noqa: BLE001 — recommender row is auxiliary
         print(f"bench: recommendation topk bench failed ({e!r})",
@@ -2931,6 +3166,25 @@ def _run_suite(platform: str) -> dict:
                 if hot_serving else None),
             "serving_hot_path_crossover": (
                 hot_serving["crossover"] if hot_serving else None),
+            "serving_binary_wire": ({
+                k: (round(v, 3) if isinstance(v, float) else v)
+                for k, v in binary_wire.items()}
+                if binary_wire else None),
+            "gateway_tier_single_requests_per_sec": round(
+                gateway_tier["single_requests_per_sec"], 1)
+                if gateway_tier else None,
+            "gateway_tier_requests_per_sec": round(
+                gateway_tier["tier_requests_per_sec"], 1)
+                if gateway_tier else None,
+            "gateway_tier_vs_single_x": round(
+                gateway_tier["tier_vs_single_x"], 3)
+                if gateway_tier else None,
+            "gateway_tier_workers": (
+                gateway_tier["tier_workers"] if gateway_tier else None),
+            "gateway_tier_kill_errors": (
+                gateway_tier["kill_errors"] if gateway_tier else None),
+            "gateway_tier_kill_requests": (
+                gateway_tier["kill_requests"] if gateway_tier else None),
             "recommendation_topk_rows_per_sec": _r1(
                 rec_topk, "hot_rows_per_sec"),
             "recommendation_topk_client_rtt_p50_ms": round(

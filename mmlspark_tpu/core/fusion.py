@@ -120,7 +120,11 @@ class DeviceKernel:
     in a mesh-aware body (e.g. tensor-parallel matmuls) with explicit
     param placement, or None to accept the default.  Any specialized body
     must still produce byte-identical values.  `mesh_desc` is the
-    human-readable sharding contract `fusion_report` prints."""
+    human-readable sharding contract `fusion_report` prints, and
+    `kernel_label` names the device program variant (e.g. the GBDT
+    models' `fused_traverse`) so the plan output pins WHICH kernel a
+    segment compiles — a silent fallback to a slower variant shows up
+    as a diff in CI."""
 
     fn: Callable[[Any, dict], dict]
     input_cols: tuple[str, ...]
@@ -133,6 +137,7 @@ class DeviceKernel:
     ready_values: "Callable[[dict], Any] | None" = None
     mesh_fn: "Callable[[Any], tuple | None] | None" = None
     mesh_desc: str = "rows P(data) / params replicated"
+    kernel_label: str = ""
 
 
 @dataclass
@@ -200,9 +205,11 @@ class FusionPlan:
                 name = type(sp.stage).__name__
                 if seg.fused:
                     k = sp.kernel
+                    label = f" kernel={k.kernel_label}" if k.kernel_label \
+                        else ""
                     lines.append(
                         f"  {name}: {','.join(k.input_cols)} -> "
-                        f"{','.join(k.output_cols)}")
+                        f"{','.join(k.output_cols)}{label}")
                     lines.append(f"    sharding: {k.mesh_desc}")
                 else:
                     lines.append(f"  {name}: {sp.reason}")

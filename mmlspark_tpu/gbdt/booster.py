@@ -973,15 +973,26 @@ class Booster:
         the executable as constants (so they upload once per segment, not
         once per compiled shape).
 
+        This is the fused decode->bin->traverse inference kernel: ONE
+        jitted program from the raw f32 feature matrix to margins, with
+        binning as a vectorized `searchsorted` over ADJUSTED float32
+        boundary keys (O(n*F*log B) instead of the O(n*F*B) broadcast
+        compare it replaces).
+
         Bit-identity with the staged path: the traversal mirrors
         `_traverse_fn` exactly (same blocking, same tree-order float32
         accumulation), and binning replays the host's float64
-        `searchsorted(ub, x, 'left')` == count(ub < x) with a tie
-        adjustment: for float32-representable x, `ub < x` differs from
-        `f32(ub) < x` only when f32(ub) rounded UP to exactly x, so
-        `(f32(ub) < x) | ((f32(ub) == x) & rounded_up)` reproduces the
-        float64 comparison bit-for-bit. Callers must guarantee x is
-        f32-representable (the estimator's `ready` check)."""
+        `searchsorted(ub, x, 'left')` == count(ub < x) via per-boundary
+        keys `key = pred(f32(ub)) if f32(ub) rounded up else f32(ub)`:
+        for float32-representable x, `key < x  <=>  ub < x` in both
+        rounding cases (not-rounded-up: no f32 lies in (ub, f32(ub)], so
+        f32(ub) < x iff ub < x; rounded-up: x > pred(f32(ub)) iff
+        x >= f32(ub) iff ub < x, since no f32 lies strictly between ub
+        and f32(ub)), and the keys stay nondecreasing (a decrease would
+        need ub_i <= f32-midpoint < ub_{i+1} < the same midpoint). So
+        `searchsorted(keys, x, 'left')` == count(ub < x) bit-for-bit.
+        Callers must guarantee x is f32-representable (the estimator's
+        `ready_values` check)."""
         from .binning import MISSING_BIN
 
         mapper = self.bin_mapper
@@ -992,6 +1003,12 @@ class Booster:
         ub64 = np.asarray(mapper.upper_bounds[:, 1:max(nb_max, 2)], np.float64)
         ub32 = ub64.astype(np.float32)
         rounded_up = ub32.astype(np.float64) > ub64
+        # +inf padding boundaries have rounded_up False, so they keep the
+        # key +inf and never count; finite ub beyond f32 range maps to
+        # nextafter(inf) == f32max, matching the old compare for every
+        # f32-representable x
+        keys = np.where(rounded_up,
+                        np.nextafter(ub32, np.float32(-np.inf)), ub32)
 
         max_steps = int(self.feature.shape[1] // 2 + 1)
         k = self.num_class
@@ -1010,7 +1027,7 @@ class Booster:
             return np.ascontiguousarray(a).reshape((-1, block) + a.shape[1:])
 
         params = dict(
-            ub=ub32, rounded_up=rounded_up,
+            keys=keys,
             nb=np.asarray(mapper.num_bins, np.int32),
             trees=dict(
                 feature=blocked(padded(self.feature, -1)),
@@ -1028,10 +1045,13 @@ class Booster:
 
         def fn(params, x):
             x = x.astype(jnp.float32)
-            ub, adj, nb = params["ub"], params["rounded_up"], params["nb"]
-            xv = x[:, :, None]
-            cnt = ((ub[None] < xv) | ((ub[None] == xv) & adj[None])).sum(
-                -1).astype(jnp.int32)
+            keys, nb = params["keys"], params["nb"]
+            # one binary search per (row, feature) against the adjusted
+            # keys — the NaN result is overwritten by the isnan select
+            cnt = jax.vmap(
+                lambda kys, col: jnp.searchsorted(kys, col, side="left"),
+                in_axes=(0, 1), out_axes=1,
+            )(keys, x).astype(jnp.int32)
             b = jnp.clip(cnt + 1, 1, jnp.maximum(nb[None] - 1, 1))
             b = jnp.where(jnp.isnan(x), MISSING_BIN, b)
             # host transform skips nb<=1 columns entirely (even NaN stays 0)
@@ -1080,7 +1100,7 @@ class Booster:
     def device_predict_shardings(self, mesh, params=None):
         """Placement of `device_predict_fn` params under a mesh: everything
         REPLICATED — every row's traversal reads the whole binning table
-        (ub/rounded_up/nb) and every tree SoA, while rows themselves shard
+        (keys/nb) and every tree SoA, while rows themselves shard
         over the data axis (the fusion engine's default input sharding).
         Stating the contract explicitly keeps the scoring path's placement
         pinned even if the engine's default ever changes."""

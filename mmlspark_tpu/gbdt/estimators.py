@@ -418,8 +418,11 @@ class GBDTRegressionModel(_BoosterModelMixin, HasFeaturesCol, HasPredictionCol, 
         )
 
     def device_kernel(self):
-        """Fusion kernel (core/fusion.py): on-device binning + the booster's
-        params-passing traversal (tree tables device-resident). Regression
+        """Fusion kernel (core/fusion.py): the booster's fused
+        decode->bin->traverse program (`fused_traverse`) — searchsorted
+        binning against adjusted device-pinned boundary keys plus the
+        fixed-depth gather traversal, ONE dispatch from raw features to
+        margins with the tree tables device-resident. Regression
         objectives only — their transform_score is the identity, so the
         float64 output is an exact widening of the float32 margins. The
         `ready` check pins the binning bit-identity precondition: feature
@@ -479,7 +482,8 @@ class GBDTRegressionModel(_BoosterModelMixin, HasFeaturesCol, HasPredictionCol, 
             out_dtypes={out_col: np.float64},
             out_meta={out_col: {SCORE_KIND: "prediction"}}, ready=ready,
             ready_values=ready_values, mesh_fn=mesh_fn,
-            mesh_desc="rows P(data); binning table + tree SoAs replicated")
+            mesh_desc="rows P(data); binning table + tree SoAs replicated",
+            kernel_label="fused_traverse")
 
     def native_score_fn(self):
         """Host-side scorer for the serving hot path's auto-pick route

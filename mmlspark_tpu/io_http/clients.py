@@ -3,8 +3,9 @@
 Reference: src/io/http/src/main/scala/HTTPClients.scala:19-151 — retry with
 exponential backoff and 429 Retry-After handling (:64-105),
 `SingleThreadedHTTPClient` and `AsyncHTTPClient` (sliding window of Futures,
-Clients.scala:102-116 + AsyncUtils.bufferedAwait). Here: urllib on threads;
-the async window is utils.async_utils.buffered_map.
+Clients.scala:102-116 + AsyncUtils.bufferedAwait). Here: pooled keep-alive
+http.client connections on threads (`_ConnectionPool`); the async window is
+utils.async_utils.buffered_map.
 
 Retry semantics are delegated to resilience.policy.RetryPolicy (one
 implementation for the whole package); the legacy `retries`/`backoff_ms`
@@ -24,11 +25,10 @@ through it, so replica failover has exactly one tested implementation.
 from __future__ import annotations
 
 import hashlib
+import http.client
 import itertools
 import threading
-import urllib.error
 import urllib.parse
-import urllib.request
 from typing import Iterable, Sequence
 
 from ..observability.sanitizer import make_lock
@@ -60,6 +60,160 @@ def _breaker_open_response(breaker: CircuitBreaker) -> HTTPResponseData:
     )
 
 
+class _ConnectionPool:
+    """Process-wide keep-alive socket pool keyed by (scheme, host, port).
+
+    Every `http_send` borrows a connection here instead of opening a
+    fresh TCP socket per request — the three-way handshake was the
+    single biggest fixed cost on the sub-millisecond serving path. Idle
+    connections per endpoint are capped (`max_per_host`); a release over
+    the cap closes the socket instead of pooling it, so a burst against
+    many replicas cannot accumulate unbounded open sockets.
+
+    A borrowed connection is exclusively owned until released, so no
+    locking is needed around the exchange itself — only the idle lists
+    are guarded."""
+
+    def __init__(self, max_per_host: int = 8):
+        self.max_per_host = max_per_host
+        self._idle: "dict[tuple, list[http.client.HTTPConnection]]" = {}
+        self._lock = make_lock("_ConnectionPool._lock")
+        self.creates = 0
+        self.reuses = 0
+        self.stale_retries = 0
+
+    @staticmethod
+    def _new(scheme: str, host: str, port: int,
+             timeout: float) -> http.client.HTTPConnection:
+        cls = (http.client.HTTPSConnection if scheme == "https"
+               else http.client.HTTPConnection)
+        return cls(host, port, timeout=timeout)
+
+    def acquire(self, scheme: str, host: str, port: int, timeout: float
+                ) -> "tuple[http.client.HTTPConnection, bool]":
+        """(connection, reused) — reused=True means the socket has served
+        a previous exchange and may have been closed server-side since."""
+        key = (scheme, host, port)
+        with self._lock:
+            idle = self._idle.get(key)
+            while idle:
+                conn = idle.pop()
+                if conn.sock is not None:
+                    try:
+                        # a locally closed fd is detectable for free —
+                        # skip it; only remotely half-closed sockets ever
+                        # reach the stale-retry path in _send_once
+                        conn.sock.settimeout(timeout)
+                    except OSError:
+                        conn.close()
+                        continue
+                    self.reuses += 1
+                    return conn, True
+                conn.close()
+            self.creates += 1
+        return self._new(scheme, host, port, timeout), False
+
+    def release(self, scheme: str, host: str, port: int,
+                conn: http.client.HTTPConnection) -> None:
+        if conn.sock is None:
+            return
+        with self._lock:
+            idle = self._idle.setdefault((scheme, host, port), [])
+            if len(idle) < self.max_per_host:
+                idle.append(conn)
+                return
+        conn.close()
+
+    def close_all(self) -> None:
+        with self._lock:
+            conns = [c for idle in self._idle.values() for c in idle]
+            self._idle.clear()
+        for c in conns:
+            c.close()
+
+    def stats(self) -> dict:
+        with self._lock:
+            idle = sum(len(v) for v in self._idle.values())
+        return {"idle": idle, "creates": self.creates,
+                "reuses": self.reuses, "stale_retries": self.stale_retries,
+                "max_per_host": self.max_per_host}
+
+
+_POOL = _ConnectionPool()
+
+
+def connection_pool_stats() -> dict:
+    """Live keep-alive pool counters (idle sockets, creates vs reuses,
+    stale-socket retries) — surfaced by diagnose --serving."""
+    return _POOL.stats()
+
+
+def configure_connection_pool(max_per_host: int) -> None:
+    """Resize the per-endpoint idle-socket cap (existing idle sockets
+    above the new cap drain as they are next released)."""
+    _POOL.max_per_host = int(max_per_host)
+
+
+def _header(headers: dict, name: str) -> "str | None":
+    low = name.lower()
+    for k, v in headers.items():
+        if k.lower() == low:
+            return v
+    return None
+
+
+def _send_once(method: str, url: str, body: "bytes | None",
+               headers: dict, timeout: float) -> HTTPResponseData:
+    """One HTTP exchange over a pooled keep-alive connection. Returns a
+    response for ANY status the server answers with — status policy
+    (retryable vs not) stays in http_send.
+
+    Stale-socket retry-once: a connection-level failure on a REUSED
+    socket before the status line arrives means the server closed an
+    idle keep-alive connection — a normal race, not an endpoint failure
+    — so the exchange transparently replays ONCE on a brand-new socket.
+    Fresh-socket failures (and anything after the status line) propagate
+    to the caller's retry/breaker logic unchanged."""
+    parts = urllib.parse.urlsplit(url)
+    scheme = parts.scheme or "http"
+    host = parts.hostname or ""
+    port = parts.port or (443 if scheme == "https" else 80)
+    path = parts.path or "/"
+    if parts.query:
+        path = f"{path}?{parts.query}"
+    conn, reused = _POOL.acquire(scheme, host, port, timeout)
+    for attempt in (0, 1):
+        try:
+            conn.request(method, path, body=body, headers=headers)
+            resp = conn.getresponse()
+        except Exception:
+            conn.close()
+            if reused and attempt == 0:
+                _POOL.stale_retries += 1
+                conn, reused = _POOL._new(scheme, host, port, timeout), False
+                continue
+            raise
+        try:
+            # the body must be fully drained before the socket can carry
+            # the next exchange; a mid-body failure is a REAL failure
+            # (the server answered, then died) — no transparent replay
+            entity = resp.read()
+        except Exception:
+            conn.close()
+            raise
+        if resp.will_close:
+            conn.close()
+        else:
+            _POOL.release(scheme, host, port, conn)
+        return HTTPResponseData(
+            status_code=resp.status,
+            reason=resp.reason or "",
+            headers=dict(resp.getheaders()),
+            entity=entity,
+        )
+    raise RuntimeError("unreachable")  # pragma: no cover
+
+
 def http_send(
     req: HTTPRequestData,
     timeout: float = 60.0,
@@ -71,7 +225,14 @@ def http_send(
     """One request with the reference's retry semantics
     (HTTPClients.scala:64-105): retry on 429/5xx/connection errors, honor
     Retry-After (capped by the policy — an adversarial `Retry-After: 1e9`
-    must not hang the pipeline thread), back off between attempts."""
+    must not hang the pipeline thread), back off between attempts.
+
+    Transport: pooled keep-alive connections (`_ConnectionPool`), so
+    repeated sends to the same endpoint skip the TCP handshake. Breaker
+    and retry accounting are UNCHANGED from the one-socket-per-request
+    era: a stale reused socket replays once transparently inside
+    `_send_once` without touching the breaker, while genuine connection
+    failures still record_failure and surface as status 0."""
     if policy is None:
         policy = _legacy_policy(retries, backoff_ms)
     if breaker is not None and not breaker.allow():
@@ -90,40 +251,8 @@ def http_send(
     last_exc: Exception | None = None
     while True:
         try:
-            r = urllib.request.Request(
-                req.url, data=req.entity, headers=headers,
-                method=req.method,
-            )
-            with urllib.request.urlopen(r, timeout=timeout) as resp:
-                if breaker is not None:
-                    breaker.record_success()
-                return HTTPResponseData(
-                    status_code=resp.status,
-                    reason=getattr(resp, "reason", "") or "",
-                    headers=dict(resp.headers),
-                    entity=resp.read(),
-                )
-        except urllib.error.HTTPError as e:
-            body = e.read()
-            if is_retryable_status(e.code):
-                if breaker is not None:
-                    breaker.record_failure()
-                if sess.should_retry():
-                    retry_after = e.headers.get("Retry-After")
-                    try:
-                        retry_after_s = (float(retry_after)
-                                         if retry_after is not None else None)
-                    except ValueError:
-                        retry_after_s = None
-                    sess.backoff(retry_after_s=retry_after_s)
-                    continue
-            elif breaker is not None:
-                # non-retryable 4xx: the endpoint answered — it is healthy
-                breaker.record_success()
-            return HTTPResponseData(
-                status_code=e.code, reason=str(e.reason),
-                headers=dict(e.headers), entity=body,
-            )
+            resp = _send_once(req.method, req.url, req.entity, headers,
+                              timeout)
         except Exception as e:  # noqa: BLE001 — connection-level retry
             last_exc = e
             if breaker is not None:
@@ -133,6 +262,24 @@ def http_send(
                 continue
             return HTTPResponseData(
                 status_code=0, reason=str(last_exc), entity=None)
+        if resp.status_code >= 400 and is_retryable_status(resp.status_code):
+            if breaker is not None:
+                breaker.record_failure()
+            if sess.should_retry():
+                retry_after = _header(resp.headers, "Retry-After")
+                try:
+                    retry_after_s = (float(retry_after)
+                                     if retry_after is not None else None)
+                except ValueError:
+                    retry_after_s = None
+                sess.backoff(retry_after_s=retry_after_s)
+                continue
+            return resp
+        if breaker is not None:
+            # any answered status — including a non-retryable 4xx — means
+            # the endpoint is healthy
+            breaker.record_success()
+        return resp
 
 
 class _Target:

@@ -31,17 +31,21 @@ from __future__ import annotations
 
 import itertools
 import json
+import multiprocessing
+import os
+import socket
 import threading
 import time
 from http.server import ThreadingHTTPServer
 from typing import Any, Callable
 
+from ..observability.sanitizer import make_lock
 from ..resilience.policy import RetryPolicy, SYSTEM_CLOCK
 from .clients import TargetPool
 from .schema import HTTPRequestData, HTTPResponseData
 from .serving import SingleSegmentHandler
 
-__all__ = ["ServingGateway"]
+__all__ = ["ServingGateway", "GatewayTier"]
 
 _GW_SEQ = itertools.count()
 
@@ -81,11 +85,19 @@ class ServingGateway:
         recorder: Any = None,
         timeline_dir: "str | None" = None,
         timeline_interval_s: float = 5.0,
+        reuse_port: bool = False,
+        worker_label: "str | None" = None,
         **breaker_kw,
     ):
         if strategy not in ("least_loaded", "round_robin", "hash"):
             raise ValueError(f"unknown routing strategy {strategy!r}")
         self.host, self.port = host, port
+        # gateway-tier membership: reuse_port binds the listener with
+        # SO_REUSEPORT so N worker processes share ONE port (the kernel
+        # balances accepted connections across them); worker_label tags
+        # this process's requests in the per-worker counter
+        self.reuse_port = bool(reuse_port)
+        self.worker_label = worker_label
         self.strategy = strategy
         self.routing_key_header = routing_key_header.lower()
         self.timeout_s = timeout_s
@@ -186,6 +198,15 @@ class ServingGateway:
             "mmlspark_tpu_gateway_latency_seconds",
             "gateway latency, request read to reply written",
             labels=("server",), exemplars=self.exemplars).labels(**lbl)
+        # tier accounting: each worker process counts its own requests
+        # under its worker label, so a scrape across the tier shows the
+        # kernel's SO_REUSEPORT balance directly
+        self._c_worker = None
+        if self.worker_label is not None:
+            self._c_worker = self.metrics.counter(
+                "mmlspark_tpu_gateway_worker_requests_total",
+                "requests handled per gateway-tier worker process",
+                labels=("worker",)).labels(worker=self.worker_label)
         self._update_pool_gauges()
 
     def _update_pool_gauges(self) -> None:
@@ -405,6 +426,8 @@ class ServingGateway:
                            "unrouted" if status in (502, 503) else "error")
                 outer._c_requests.labels(server=outer.server_label,
                                          outcome=outcome).inc()
+                if outer._c_worker is not None:
+                    outer._c_worker.inc()
                 self.send_response(status)
                 entity = resp.entity or b""
                 for k, v in (resp.headers or {}).items():
@@ -472,13 +495,31 @@ class ServingGateway:
             def log_message(self, *a):
                 pass
 
-        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        server_cls = _ReusePortServer if self.reuse_port \
+            else ThreadingHTTPServer
+        self._server = server_cls((self.host, self.port), Handler)
         self.port = self._server.server_address[1]
         threading.Thread(target=self._server.serve_forever,
                          daemon=True).start()
         if self.timeline is not None:
             self.timeline.start()
         return self
+
+    def worker_stats(self) -> dict:
+        """This process's tier-worker snapshot (GatewayTier aggregates
+        one per worker into the /workers table)."""
+        states = self.pool.states()
+        return {
+            "worker": self.worker_label,
+            "pid": os.getpid(),
+            "port": self.port,
+            "requests": (int(self._c_worker.value)
+                         if self._c_worker is not None else 0),
+            "outcomes": {vals[1]: int(c.value)
+                         for vals, c in self._c_requests.children()
+                         if vals[0] == self.server_label},
+            "n_live": sum(1 for s in states.values() if s["live"]),
+        }
 
     @property
     def url(self) -> str:
@@ -503,3 +544,315 @@ class ServingGateway:
                 self.recorder.trigger_dump("drain", force=True)
             except Exception:
                 pass
+
+
+class _ReusePortServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that joins an SO_REUSEPORT listener group:
+    every gateway-tier worker binds the SAME (host, port) and the kernel
+    load-balances accepted connections across the listening sockets —
+    no user-space distributor process on the data path."""
+
+    def server_bind(self):
+        if not hasattr(socket, "SO_REUSEPORT"):  # pragma: no cover
+            raise OSError("SO_REUSEPORT is not available on this platform")
+        self.socket.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        super().server_bind()
+
+
+def _gateway_tier_worker(conn, index: int, host: str, port: int,
+                         urls, checkpoint_dir, gateway_kw) -> None:
+    """Tier-worker process entry: one full ServingGateway bound into the
+    shared-port listener group, driven by the parent over a pipe
+    (membership broadcasts, stats polls, graceful stop)."""
+    import signal
+
+    gw = ServingGateway(
+        urls=urls, host=host, port=port, reuse_port=True,
+        worker_label=f"w{index}", checkpoint_dir=checkpoint_dir,
+        **gateway_kw).start()
+    # a SIGTERM'd (or SIGKILL'd) worker exits without ceremony: the
+    # journal shard is append-only with torn-tail recovery, so the
+    # respawned worker replays exactly-once state from disk
+    signal.signal(signal.SIGTERM, lambda *_: os._exit(0))
+    conn.send(("ready", gw.port, os.getpid()))
+    try:
+        while True:
+            try:
+                cmd = conn.recv()
+            except (EOFError, OSError):
+                break
+            op = cmd[0]
+            if op == "stop":
+                break
+            if op == "admit":
+                gw.admit(cmd[1])
+            elif op == "remove":
+                gw.remove(cmd[1])
+            elif op == "stats":
+                conn.send(gw.worker_stats())
+    finally:
+        gw.stop()
+        conn.close()
+
+
+class GatewayTier:
+    """N gateway worker PROCESSES sharing one port via SO_REUSEPORT —
+    the multi-process front tier a single-process gateway caps out on.
+
+    * the parent reserves the shared port with a bound-but-never-
+      listening SO_REUSEPORT placeholder socket (held for the tier's
+      lifetime, so the port cannot be stolen between worker restarts);
+      only LISTENING sockets join the kernel's balance group, so the
+      placeholder never receives a connection
+    * each worker is a full `ServingGateway` (same TargetPool breakers,
+      hedging, consistent-hash stickiness — the blake2b ring is
+      deterministic, so every worker maps a routing key to the SAME
+      replica with no cross-process coordination)
+    * fleet membership propagates through the watch protocol: the parent
+      subscribes once via `attach_fleet` and broadcasts admit/remove to
+      every worker pipe
+    * the accept/reply journal shards per worker
+      (`checkpoint_dir/worker-N`): any single worker's death loses
+      nothing — its shard replays on respawn, and no two workers ever
+      contend on one journal file
+    * `kill_worker`/`respawn_worker` are the chaos hooks the bench's
+      kill-window drill drives; a killed worker's in-flight connections
+      reset, which clients absorb with a status-0-safe resend
+    * a small control server (`control_url`, parent process) serves
+      GET /workers for `diagnose.py --gateway` — the shared data port
+      deliberately serves ONLY gateway traffic
+    """
+
+    def __init__(self, urls=(), n_workers: int = 2,
+                 host: str = "127.0.0.1", port: int = 0,
+                 checkpoint_dir: "str | None" = None,
+                 start_timeout_s: float = 30.0,
+                 **gateway_kw):
+        if n_workers < 1:
+            raise ValueError("a gateway tier needs at least one worker")
+        self.host = host
+        self.port = port
+        self.n_workers = int(n_workers)
+        self.checkpoint_dir = checkpoint_dir
+        self.start_timeout_s = start_timeout_s
+        # everything here crosses the spawn boundary — keep it picklable
+        # (no live metrics registries / recorders; workers build their own)
+        self.gateway_kw = dict(gateway_kw)
+        self._members: "list[str]" = list(urls)
+        self._ctx = multiprocessing.get_context("spawn")
+        self._procs: "list[Any]" = [None] * self.n_workers
+        self._pipes: "list[Any]" = [None] * self.n_workers
+        self._pids: "list[int | None]" = [None] * self.n_workers
+        # one lock per worker pipe: stats polls and membership broadcasts
+        # interleave from different threads but each pipe is half-duplex
+        self._pipe_locks = [make_lock(f"GatewayTier.pipe{i}")
+                            for i in range(self.n_workers)]
+        self._reserve: "socket.socket | None" = None
+        self._control: "ThreadingHTTPServer | None" = None
+        self._fleet = None
+
+    # -- lifecycle ------------------------------------------------------ #
+
+    def _shard_dir(self, index: int) -> "str | None":
+        if self.checkpoint_dir is None:
+            return None
+        return os.path.join(self.checkpoint_dir, f"worker-{index}")
+
+    def _spawn(self, index: int) -> None:
+        parent_conn, child_conn = self._ctx.Pipe()
+        proc = self._ctx.Process(
+            target=_gateway_tier_worker,
+            args=(child_conn, index, self.host, self.port,
+                  list(self._members), self._shard_dir(index),
+                  self.gateway_kw),
+            daemon=True)
+        proc.start()
+        child_conn.close()
+        if not parent_conn.poll(self.start_timeout_s):
+            proc.kill()
+            raise TimeoutError(f"gateway worker {index} failed to start")
+        msg = parent_conn.recv()
+        if msg[0] != "ready" or msg[1] != self.port:
+            proc.kill()
+            raise RuntimeError(f"gateway worker {index} bad handshake: {msg}")
+        self._procs[index] = proc
+        self._pipes[index] = parent_conn
+        self._pids[index] = msg[2]
+
+    def start(self) -> "GatewayTier":
+        if not hasattr(socket, "SO_REUSEPORT"):  # pragma: no cover
+            raise OSError("GatewayTier requires SO_REUSEPORT")
+        # reserve the shared port BEFORE any worker exists: bound with
+        # SO_REUSEPORT (so workers can join) but never listen()ed (so the
+        # kernel never routes a connection here)
+        self._reserve = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._reserve.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._reserve.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        self._reserve.bind((self.host, self.port))
+        self.port = self._reserve.getsockname()[1]
+        for i in range(self.n_workers):
+            self._spawn(i)
+        self._start_control()
+        return self
+
+    # -- membership ----------------------------------------------------- #
+
+    def _command(self, index: int, cmd: tuple, reply: bool = False):
+        pipe = self._pipes[index]
+        proc = self._procs[index]
+        if pipe is None or proc is None or not proc.is_alive():
+            return None
+        with self._pipe_locks[index]:
+            try:
+                pipe.send(cmd)
+                if reply:
+                    if not pipe.poll(self.start_timeout_s):
+                        return None
+                    return pipe.recv()
+            except (BrokenPipeError, EOFError, OSError):
+                return None
+        return None
+
+    def _broadcast(self, cmd: tuple) -> None:
+        for i in range(self.n_workers):
+            self._command(i, cmd)
+
+    def admit(self, url: str) -> None:
+        if url not in self._members:
+            self._members.append(url)
+        self._broadcast(("admit", url))
+
+    def remove(self, url: str) -> None:
+        if url in self._members:
+            self._members.remove(url)
+        self._broadcast(("remove", url))
+
+    def attach_fleet(self, fleet) -> "GatewayTier":
+        """Track a ServingFleet: seed every worker with the current
+        membership, then forward watch events to all worker pipes."""
+        self._fleet = fleet
+        for u in fleet.urls:
+            self.admit(u)
+
+        def _on_change(event: str, url: str) -> None:
+            if event == "added":
+                self.admit(url)
+            elif event == "removed":
+                self.remove(url)
+
+        fleet.watch(_on_change)
+        return self
+
+    # -- chaos hooks ---------------------------------------------------- #
+
+    def kill_worker(self, index: int) -> None:
+        """SIGKILL one worker — the kill-window drill. The shared port
+        keeps serving through the surviving listeners immediately."""
+        proc = self._procs[index]
+        if proc is not None and proc.is_alive():
+            proc.kill()
+            proc.join(timeout=5)
+
+    def respawn_worker(self, index: int) -> None:
+        """Refill a dead worker slot: same index, same journal shard —
+        the new process replays the shard's exactly-once state."""
+        proc = self._procs[index]
+        if proc is not None and proc.is_alive():
+            raise RuntimeError(f"worker {index} is still alive")
+        pipe = self._pipes[index]
+        if pipe is not None:
+            pipe.close()
+        self._spawn(index)
+
+    # -- observability -------------------------------------------------- #
+
+    def workers(self) -> "list[dict]":
+        """One row per worker slot: alive + the worker's own counters
+        (None stats for a dead worker — the row still shows the death)."""
+        rows = []
+        for i in range(self.n_workers):
+            proc = self._procs[i]
+            alive = bool(proc is not None and proc.is_alive())
+            stats = self._command(i, ("stats",), reply=True) if alive \
+                else None
+            rows.append({
+                "index": i, "alive": alive, "pid": self._pids[i],
+                "journal_shard": self._shard_dir(i),
+                "stats": stats,
+            })
+        return rows
+
+    def _start_control(self) -> None:
+        outer = self
+
+        class Control(SingleSegmentHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _reply_json(self, status: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                path = self.path.split("?", 1)[0]
+                if path == "/workers":
+                    self._reply_json(200, {
+                        "tier": True, "host": outer.host,
+                        "port": outer.port,
+                        "n_workers": outer.n_workers,
+                        "members": list(outer._members),
+                        "workers": outer.workers(),
+                    })
+                    return
+                if path == "/healthz":
+                    alive = sum(1 for p in outer._procs
+                                if p is not None and p.is_alive())
+                    self._reply_json(200 if alive else 503, {
+                        "status": "ok" if alive else "dead",
+                        "alive": alive, "n_workers": outer.n_workers})
+                    return
+                self._reply_json(404, {"error": "unknown path"})
+
+            def log_message(self, *a):
+                pass
+
+        self._control = ThreadingHTTPServer((self.host, 0), Control)
+        threading.Thread(target=self._control.serve_forever,
+                         daemon=True).start()
+
+    @property
+    def url(self) -> str:
+        """The shared data port every client targets."""
+        return f"http://{self.host}:{self.port}/"
+
+    @property
+    def control_url(self) -> str:
+        """The parent's control endpoint (GET /workers) for diagnose."""
+        assert self._control is not None, "tier not started"
+        return f"http://{self.host}:{self._control.server_address[1]}/"
+
+    def stop(self) -> None:
+        for i in range(self.n_workers):
+            self._command(i, ("stop",))
+        for proc in self._procs:
+            if proc is not None:
+                proc.join(timeout=5)
+                if proc.is_alive():
+                    proc.kill()
+                    proc.join(timeout=5)
+        for pipe in self._pipes:
+            if pipe is not None:
+                try:
+                    pipe.close()
+                except OSError:
+                    pass
+        if self._control is not None:
+            self._control.shutdown()
+            self._control.server_close()
+            self._control = None
+        if self._reserve is not None:
+            self._reserve.close()
+            self._reserve = None

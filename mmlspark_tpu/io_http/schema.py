@@ -115,27 +115,43 @@ class RequestDecoder:
     falls back to the full `parse_request` handler path, which either
     scores the request the slow way or raises the same errors it always
     did.  `null` decodes to NaN, booleans to 0/1, exactly as
-    `parse_request`'s float64 conversion would."""
+    `parse_request`'s float64 conversion would.
+
+    Binary-wire requests (Content-Type `application/x-mmlspark-rows`,
+    io_http/wire.py) skip JSON entirely: the frame's `features` block is
+    `np.frombuffer`-decoded straight into the same preallocated matrix.
+    JSON and binary requests mix freely within one batch."""
 
     def __init__(self, input_cols: "list[str] | tuple[str, ...]"):
         self.cols = tuple(input_cols)
         self.schema_locked = False
         self.hits = 0
         self.fallbacks = 0
+        self.binary_hits = 0
 
     def decode(self, requests: list, n_target: "int | None" = None
                ) -> "np.ndarray | None":
         """(n_target, n_cols) float64 features, or None when any request
         falls outside the cached schema."""
+        from .wire import (content_type_of, decode_features_request,
+                           is_wire_content_type)
+
         n = len(requests)
         if n == 0:
             return None
         target = n if n_target is None else int(n_target)
         out = np.empty((target, len(self.cols)), np.float64)
         cols = self.cols
+        binary = 0
         try:
             for i, r in enumerate(requests):
                 entity = r.entity if isinstance(r, HTTPRequestData) else None
+                if entity and is_wire_content_type(
+                        content_type_of(r.headers)):
+                    # zero-copy lane: raw f64 bytes -> this row, no parse
+                    out[i] = decode_features_request(entity, len(cols))[0]
+                    binary += 1
+                    continue
                 body = json.loads(entity) if entity else None
                 row = out[i]
                 for j, c in enumerate(cols):
@@ -149,6 +165,7 @@ class RequestDecoder:
         except (TypeError, KeyError, ValueError, AttributeError):
             self.fallbacks += 1
             return None
+        self.binary_hits += binary
         if target > n:
             out[n:] = out[n - 1]
         self.schema_locked = True

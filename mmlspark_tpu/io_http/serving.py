@@ -39,6 +39,8 @@ from ..core.schema import Table
 from ..observability.sanitizer import make_lock, make_rlock
 from .schema import (HTTPRequestData, HTTPResponseData, RequestDecoder,
                      make_reply, parse_request)
+from .wire import (WIRE_CONTENT_TYPE, accepts_wire, encode_reply,
+                   is_wire_content_type)
 
 __all__ = ["ServingServer", "ServingFleet", "MicroBatchQuery", "serve_model",
            "ServiceInfo", "FleetRendezvous"]
@@ -56,6 +58,34 @@ def _prof_ledger(kind: str, segment: str, span: Any = None, **meta: Any):
     from ..observability.profiler import get_profiler
 
     return get_profiler().ledger(kind, segment, span=span, **meta)
+
+
+def _negotiate_reply(resp: "HTTPResponseData",
+                     request: "HTTPRequestData") -> "HTTPResponseData":
+    """Honor a binary-Accept-ing client on routes that replied JSON (the
+    handler fallback path): a 200 single-value ``{col: v}`` JSON reply is
+    re-framed as the binary wire reply. Hot-path routes frame binary
+    replies directly (`replies_for`'s binary_mask), so this is a no-op
+    for them; error statuses and non-scalar bodies pass through as JSON
+    — the negotiation rule is 'binary clients must also accept JSON',
+    never the reverse."""
+    if (resp.status_code != 200 or not resp.entity
+            or not accepts_wire(request.headers)):
+        return resp
+    ct = resp.headers.get("Content-Type", "")
+    if is_wire_content_type(ct) or not ct.startswith("application/json"):
+        return resp
+    try:
+        body = json.loads(resp.entity)
+        (col, v), = body.items()
+        if v is None or isinstance(v, (bool, str, dict)):
+            return resp
+        return HTTPResponseData(
+            status_code=200, reason="OK",
+            headers={"Content-Type": WIRE_CONTENT_TYPE},
+            entity=encode_reply(col, v))
+    except Exception:  # noqa: BLE001 — negotiation never breaks a reply
+        return resp
 
 
 def _handler_error_response(e: Exception) -> "HTTPResponseData":
@@ -182,15 +212,29 @@ class _HotPath:
             # checked
             return self.crossover.get(bucket, "host")
 
-    def replies_for(self, vals: np.ndarray) -> "list[HTTPResponseData]":
+    def replies_for(self, vals: np.ndarray,
+                    binary_mask: "list[bool] | None" = None
+                    ) -> "list[HTTPResponseData]":
         """Score column -> replies, byte-for-byte what the handler path's
-        `make_reply` produces (tolist() -> Python float -> json.dumps)."""
+        `make_reply` produces (tolist() -> Python float -> json.dumps).
+        `binary_mask[i]` True swaps row i's reply for the binary wire
+        frame (the request Accept-ed it) — raw f64 bytes, no json.dumps
+        on the hot path."""
         col = self.output_col
-        return [HTTPResponseData(
-            status_code=200, reason="OK",
-            headers={"Content-Type": "application/json"},
-            entity=json.dumps({col: v}).encode(),
-        ) for v in np.asarray(vals).tolist()]
+        vlist = np.asarray(vals).tolist()
+        if binary_mask is None:
+            binary_mask = [False] * len(vlist)
+        return [
+            HTTPResponseData(
+                status_code=200, reason="OK",
+                headers={"Content-Type": WIRE_CONTENT_TYPE},
+                entity=encode_reply(col, v),
+            ) if binary else HTTPResponseData(
+                status_code=200, reason="OK",
+                headers={"Content-Type": "application/json"},
+                entity=json.dumps({col: v}).encode(),
+            )
+            for v, binary in zip(vlist, binary_mask)]
 
     def native_values(self, feats: np.ndarray) -> np.ndarray:
         return np.asarray(self.native_fn(feats), np.float64)
@@ -332,7 +376,9 @@ class _HotPath:
                 "round_trips_per_resident_request": (
                     self.resident_batches / res_req if res_req else 0.0),
                 "decoder": {"hits": self.decoder.hits,
-                            "fallbacks": self.decoder.fallbacks},
+                            "fallbacks": self.decoder.fallbacks,
+                            "binary_hits": getattr(
+                                self.decoder, "binary_hits", 0)},
             }
 
 
@@ -505,6 +551,13 @@ class ServingServer:
             "mmlspark_tpu_serving_path_total",
             "requests scored per hot-path route (resident/native/host)",
             labels=("server", "path"))
+        # wire-protocol mix: which framing each accepted request arrived
+        # in (json vs the zero-copy binary protocol, io_http/wire.py)
+        self._c_proto = self.metrics.counter(
+            "mmlspark_tpu_serving_protocol_requests_total",
+            "requests received per wire protocol (json/binary)",
+            labels=("server", "proto"))
+        self._proto_counts = {"json": 0, "binary": 0}
         self._c_round_trips = _own(
             "mmlspark_tpu_serving_host_round_trips_total",
             "host<->device round-trips spent scoring (one per resident "
@@ -560,6 +613,12 @@ class ServingServer:
     @property
     def requests_failed(self) -> int:
         return int(self._c_failed.value)
+
+    def protocol_counts(self) -> dict:
+        """Accepted requests per wire protocol (the info() `protocols`
+        block diagnose --serving prints as the protocol mix)."""
+        with self._counter_lock:
+            return dict(self._proto_counts)
 
     # -- health / readiness --------------------------------------------- #
 
@@ -733,6 +792,12 @@ class ServingServer:
                     self.end_headers()
                     return
                 outer._c_accepted.inc()
+                proto = ("binary" if is_wire_content_type(
+                    self.headers.get("Content-Type")) else "json")
+                outer._c_proto.labels(server=outer.server_label,
+                                      proto=proto).inc()
+                with outer._counter_lock:
+                    outer._proto_counts[proto] += 1
                 now = time.perf_counter()
                 ex = _Exchange(HTTPRequestData(
                     method="POST", url=self.path,
@@ -775,6 +840,7 @@ class ServingServer:
                     self.end_headers()
                     return
                 resp = ex.response or HTTPResponseData(500, "no response")
+                resp = _negotiate_reply(resp, ex.request)
                 span.set(status=resp.status_code or 500)
                 self.send_response(resp.status_code or 500)
                 entity = resp.entity or b""
@@ -875,6 +941,7 @@ class ServingServer:
                                       if outer.bucketer is not None
                                       else [outer.max_batch_size]),
                     "latency": outer.latency_stats(),
+                    "protocols": outer.protocol_counts(),
                     "hot_path": (outer.hot_path.snapshot()
                                  if outer.hot_path is not None else None),
                     "profiler": outer._profiler_info(),
@@ -1220,7 +1287,9 @@ class ServingServer:
             # reply materialization is host readback work too — without
             # it the phase sum can't explain the measured RTT
             with ledger.phase("d2h"):
-                replies = hp.replies_for(vals)
+                replies = hp.replies_for(
+                    vals, binary_mask=[accepts_wire(ex.request.headers)
+                                       for ex in batch])
         except Exception as e:  # noqa: BLE001 — batch failure -> 500s
             self._c_failed.inc(len(batch))
             replies = [_handler_error_response(e)] * len(batch)
@@ -1250,7 +1319,10 @@ class ServingServer:
             ledger.add("prepare", time.perf_counter() - t_score)
         try:
             with ledger.phase("compute"):
-                replies = hp.replies_for(hp.native_values(feats))
+                replies = hp.replies_for(
+                    hp.native_values(feats),
+                    binary_mask=[accepts_wire(ex.request.headers)
+                                 for ex in batch])
         except Exception as e:  # noqa: BLE001 — batch failure -> 500s
             self._c_failed.inc(len(batch))
             replies = [_handler_error_response(e)] * len(batch)

@@ -384,6 +384,12 @@ GAUGE_MERGE_POLICIES: dict[str, str] = {
     # if several scrape sources ever report them
     "mmlspark_tpu_autoscaler_queue_slope_rate": "max",
     "mmlspark_tpu_autoscaler_p99_slope_rate": "max",
+    # serving protocol mix (serving_protocol_requests_total{proto}) and
+    # gateway tier traffic (gateway_worker_requests_total{worker}) are
+    # COUNTERS: merge_policy_for resolves them to "sum" by kind before
+    # this table is consulted. Written down here so rule M5's audit trail
+    # covers them — they carry per-process label sets (worker=w0..wN-1,
+    # proto=json|binary) that genuinely add across replicas/workers.
 }
 
 _SUFFIX_POLICIES: tuple[tuple[str, str], ...] = (

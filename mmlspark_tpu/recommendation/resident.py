@@ -197,7 +197,10 @@ class SARHotPath(_HotPath):
         res = self.executor.fetch(outs, n_valid, ledger=ledger)
         return res["recommendations"], res["ratings"]
 
-    def replies_for(self, vals) -> "list[HTTPResponseData]":
+    def replies_for(self, vals, binary_mask=None
+                    ) -> "list[HTTPResponseData]":
+        # the two-column top-k reply stays JSON regardless of Accept —
+        # binary negotiation covers single-value scoring replies only
         ids, ratings = vals
         return [HTTPResponseData(
             status_code=200, reason="OK",

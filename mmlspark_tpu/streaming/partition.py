@@ -289,48 +289,55 @@ class PartitionWorkerFactory:
     def __call__(self):
         from ..core.serialize import stage_from_blob
         from ..io_http.schema import HTTPResponseData
+        from ..io_http.wire import (WIRE_CONTENT_TYPE, content_type_of,
+                                    decode_message, encode_message,
+                                    is_wire_content_type)
 
         blob = self.blob
         query_name = self.query_name
         chains: dict[int, Any] = {}
         chain_ops: dict[int, list] = {}
         last: dict[int, int] = {}            # partition -> folded through
-        cache: dict[int, tuple[int, dict]] = {}
+        cache: dict[int, tuple] = {}         # p -> (bid, meta_doc, out)
 
         def _fresh(p: int) -> None:
             c = stage_from_blob(blob) if blob else None
             chains[p] = c
             chain_ops[p] = _chain_ops(c)
 
-        def _apply(body: dict) -> dict:
+        def _apply(body: dict, in_table: "Table | None" = None):
+            """-> (doc, out_table): out_table None for control replies
+            (need_state); otherwise the handler frames the rows in the
+            REQUEST's protocol — JSON columnar, or the shared binary
+            wire when the driver opted in (`binary_wire=True`)."""
             p = int(body["partition"])
             bid = int(body["batch_id"])
             hit = cache.get(p)
             if hit is not None and hit[0] == bid:
-                return hit[1]
+                return hit[1], hit[2]
             if p not in chains:
                 if bid != 0:
-                    return {"need_state": True, "have": last.get(p)}
+                    return {"need_state": True, "have": last.get(p)}, None
                 _fresh(p)
                 last[p] = -1
             if last.get(p, -2) != bid - 1:
-                return {"need_state": True, "have": last.get(p)}
+                return {"need_state": True, "have": last.get(p)}, None
             t0 = time.perf_counter()
-            table = _decode_rows(body["rows"])
+            table = (in_table if in_table is not None
+                     else _decode_rows(body["rows"]))
             ops = chain_ops[p]
             _set_time_hints(ops, body.get("hints") or {})
             out = (chains[p].transform(table)
                    if chains[p] is not None else table)
             reply = {
-                "rows": _encode_rows(out),
                 "state": {"ops": [op.state_doc() for op in ops]},
                 "watermark": _ops_watermark(ops),
                 "spilled_bytes": _ops_spilled(ops),
                 "seconds": time.perf_counter() - t0,
             }
             last[p] = bid
-            cache[p] = (bid, reply)
-            return reply
+            cache[p] = (bid, reply, out)
+            return reply, out
 
         def _load_state(body: dict) -> dict:
             p = int(body["partition"])
@@ -355,10 +362,33 @@ class PartitionWorkerFactory:
             replies = []
             for req in table["request"]:
                 try:
-                    body = req.json() or {}
+                    binary = is_wire_content_type(
+                        content_type_of(req.headers))
+                    in_table = None
+                    if binary:
+                        body, cols = decode_message(req.entity)
+                        # frombuffer views are read-only; ops may fold
+                        # in place, so pay one memcpy per array column
+                        in_table = Table({
+                            k: (np.array(v) if isinstance(v, np.ndarray)
+                                else v)
+                            for k, v in cols.items()})
+                    else:
+                        body = req.json() or {}
                     op = body.get("op")
                     if op == "apply":
-                        doc = _apply(body)
+                        doc, out = _apply(body, in_table)
+                        if out is not None:
+                            if binary:
+                                replies.append(HTTPResponseData(
+                                    200, "OK",
+                                    {"Content-Type": WIRE_CONTENT_TYPE},
+                                    encode_message(
+                                        doc,
+                                        {c: out[c] for c in out.columns},
+                                        n_rows=out.num_rows)))
+                                continue
+                            doc = {"rows": _encode_rows(out), **doc}
                     elif op == "load_state":
                         doc = _load_state(body)
                     elif op == "status":
@@ -405,6 +435,7 @@ class ParallelStreamingQuery(StreamingQuery):
                  fleet: Any = None,
                  fleet_kw: "dict | None" = None,
                  worker_request_timeout_s: float = 60.0,
+                 binary_wire: bool = False,
                  timeline_dir: "str | None" = None,
                  **kw: Any) -> None:
         if workers not in ("thread", "fleet"):
@@ -422,6 +453,10 @@ class ParallelStreamingQuery(StreamingQuery):
         self._worker_mode = workers
         self._num_workers = int(num_workers or self.num_partitions)
         self._worker_request_timeout_s = worker_request_timeout_s
+        # opt-in: ship fleet apply slices over the length-prefixed binary
+        # wire (io_http/wire.py) instead of JSON columnar — same rows,
+        # same replies, no float round-tripping through decimal strings
+        self.binary_wire = bool(binary_wire)
         self._pre = pipeline_model(*pre) if pre else None
         if any(isinstance(s, StatefulOperator) for s in pre):
             raise ValueError(
@@ -612,11 +647,26 @@ class ParallelStreamingQuery(StreamingQuery):
 
     def _fleet_apply_one(self, p: int, bid: int, part: Table,
                          hints: dict) -> dict:
-        body = {"op": "apply", "partition": p, "batch_id": bid,
-                "rows": _encode_rows(part), "hints": hints}
+        if self.binary_wire:
+            from ..io_http.schema import HTTPRequestData
+            from ..io_http.wire import WIRE_CONTENT_TYPE, encode_message
+
+            meta = {"op": "apply", "partition": p, "batch_id": bid,
+                    "hints": hints}
+            req = HTTPRequestData(
+                "POST", "/", {"Content-Type": WIRE_CONTENT_TYPE},
+                encode_message(meta, {c: part[c] for c in part.columns},
+                               n_rows=part.num_rows))
+            send = lambda: self._pool.send(  # noqa: E731
+                req, timeout=self._worker_request_timeout_s,
+                strategy="hash", key=f"{self.name}/p{p}")
+        else:
+            body = {"op": "apply", "partition": p, "batch_id": bid,
+                    "rows": _encode_rows(part), "hints": hints}
+            send = lambda: self._send(body, p)  # noqa: E731
         last_err: "Exception | None" = None
         for attempt in range(8):
-            resp = self._send(body, p)
+            resp = send()
             if resp.status_code in (0, 503):
                 # connection-level death or no live worker: heal the
                 # fleet and retry — the respawned worker answers
@@ -627,7 +677,7 @@ class ParallelStreamingQuery(StreamingQuery):
                 self._heal()
                 time.sleep(min(0.1 * (attempt + 1), 1.0))
                 continue
-            doc = resp.json() or {}
+            doc = self._decode_apply_reply(resp)
             if resp.status_code != 200:
                 raise RuntimeError(
                     f"partition {p} worker error: "
@@ -638,6 +688,22 @@ class ParallelStreamingQuery(StreamingQuery):
             return doc
         raise last_err or RuntimeError(
             f"partition {p}: apply did not converge")
+
+    @staticmethod
+    def _decode_apply_reply(resp) -> dict:
+        """Worker apply replies arrive framed (binary wire, rows as raw
+        column blocks) or as JSON columnar; either way normalize to the
+        reply doc with the decoded Table stashed under ``_table``."""
+        from ..io_http.wire import (content_type_of, decode_message,
+                                    is_wire_content_type)
+
+        if is_wire_content_type(content_type_of(resp.headers)):
+            meta, cols = decode_message(resp.entity)
+            doc = dict(meta)
+            doc.pop("json_columns", None)
+            doc["_table"] = Table(dict(cols))
+            return doc
+        return resp.json() or {}
 
     # -- hooks over the base micro-batch loop ------------------------------ #
 
@@ -698,7 +764,8 @@ class ParallelStreamingQuery(StreamingQuery):
         if err is not None:
             raise err
         for p, doc in sorted(docs.items()):
-            outs[p] = _decode_rows(doc["rows"])
+            outs[p] = (doc.pop("_table") if "_table" in doc
+                       else _decode_rows(doc["rows"]))
             if self._stateful:
                 self._pending[p] = doc["state"]
             self._pinfo[p] = {

@@ -135,6 +135,14 @@ class TestMergePolicies:
         for name, pol in GAUGE_MERGE_POLICIES.items():
             assert merge_policy_for(name) == pol
 
+    def test_serving_protocol_and_gateway_worker_counters_sum(self):
+        # PR 20's wire/tier counters: per-proto and per-worker traffic
+        # genuinely adds across replicas — counter kind resolves first
+        for fam in ("mmlspark_tpu_serving_protocol_requests_total",
+                    "mmlspark_tpu_gateway_worker_requests_total"):
+            assert merge_policy_for(fam, "counter") == "sum"
+            assert merge_policy_for(fam) == "sum"   # _total suffix too
+
     def test_suffix_defaults_and_unknown(self):
         assert merge_policy_for("mmlspark_tpu_x_depth") == "sum"
         assert merge_policy_for("mmlspark_tpu_x_ratio") == "max"

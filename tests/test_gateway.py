@@ -112,6 +112,11 @@ def _send(url: str, payload: dict, headers=None, retries=1):
     return http_send(_post(url, payload, headers), retries=retries)
 
 
+def _get_json(url: str, timeout=10) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
 # module-level factories: fleet workers use the spawn context, so the
 # factory must be importable from this file
 
@@ -815,3 +820,98 @@ class TestChaosSoak:
             assert j.unanswered() == {}
         finally:
             j.close()
+
+
+# --------------------------------------------------------------------- #
+# GatewayTier                                                           #
+# --------------------------------------------------------------------- #
+
+
+@pytest.mark.slow
+class TestGatewayTier:
+    def test_tier_lifecycle_kill_window_and_respawn(self, tmp_path):
+        """One pass over the whole tier contract (single test to pay the
+        worker-spawn cost once): N processes answer on ONE shared port,
+        workers() and the control endpoint expose per-worker rows, a
+        SIGKILL'd worker costs zero failed sends (the surviving listeners
+        keep the port), and respawn refills the slot onto the same
+        journal shard."""
+        from mmlspark_tpu.io_http.gateway import GatewayTier
+
+        a, b = _EchoServer("a"), _EchoServer("b")
+        tier = None
+        try:
+            tier = GatewayTier(
+                urls=[a.url, b.url], n_workers=2,
+                checkpoint_dir=str(tmp_path)).start()
+
+            # the kernel balances CONNECTIONS across listeners, so fresh
+            # connections (retries=1 client default creates per-send when
+            # none pooled) exercise the shared port
+            for i in range(8):
+                r = _send(tier.url, {"x": float(i)})
+                assert r.status_code == 200, r
+                assert r.json()["tag"] in ("a", "b")
+
+            rows = tier.workers()
+            assert [row["index"] for row in rows] == [0, 1]
+            assert all(row["alive"] for row in rows)
+            pids = {row["pid"] for row in rows}
+            assert len(pids) == 2  # two real processes
+            assert all(str(tmp_path) in row["journal_shard"]
+                       for row in rows)
+            served = sum(row["stats"]["requests"] for row in rows
+                         if row["stats"])
+            assert served >= 8
+
+            # control endpoint: what diagnose.py --gateway renders
+            doc = _get_json(tier.control_url + "workers")
+            assert doc["tier"] is True and doc["n_workers"] == 2
+            assert doc["port"] == tier.port
+            assert set(doc["members"]) == {a.url, b.url}
+            assert len(doc["workers"]) == 2
+
+            # kill window: SIGKILL worker 1; every send keeps succeeding
+            tier.kill_worker(1)
+            for i in range(8):
+                r = _send(tier.url, {"x": float(i)}, retries=3)
+                assert r.status_code == 200, \
+                    f"send failed during kill window: {r.status_code}"
+            rows = tier.workers()
+            assert rows[0]["alive"] and not rows[1]["alive"]
+            assert rows[1]["stats"] is None  # death visible, row stays
+
+            tier.respawn_worker(1)
+            rows = tier.workers()
+            assert all(row["alive"] for row in rows)
+            assert rows[1]["pid"] not in pids  # a NEW process, same slot
+            r = _send(tier.url, {"x": 1.0})
+            assert r.status_code == 200
+        finally:
+            if tier is not None:
+                tier.stop()
+            a.stop()
+            b.stop()
+
+    def test_tier_membership_broadcast(self, tmp_path):
+        """admit/remove reach every worker: after removing replica A,
+        no reply carries A's tag regardless of which worker answered."""
+        from mmlspark_tpu.io_http.gateway import GatewayTier
+
+        a, b = _EchoServer("a"), _EchoServer("b")
+        tier = None
+        try:
+            tier = GatewayTier(urls=[a.url], n_workers=2).start()
+            tier.admit(b.url)
+            tier.remove(a.url)
+            tags = set()
+            for i in range(8):
+                r = _send(tier.url, {"x": float(i)}, retries=3)
+                assert r.status_code == 200
+                tags.add(r.json()["tag"])
+            assert tags == {"b"}
+        finally:
+            if tier is not None:
+                tier.stop()
+            a.stop()
+            b.stop()

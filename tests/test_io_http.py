@@ -8,6 +8,7 @@ hits live Azure, gated on keys).
 """
 
 import json
+import socket
 import threading
 import time
 import urllib.request
@@ -42,10 +43,14 @@ from mmlspark_tpu.io_http import (
 @pytest.fixture()
 def echo_server():
     """Local JSON echo service; /flaky returns 429 twice then succeeds."""
-    calls = {"flaky": 0, "posts": []}
+    calls = {"flaky": 0, "posts": [], "conns": []}
 
     class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"   # keep-alive: the client pools us
+
         def do_POST(self):
+            if self.connection not in calls["conns"]:
+                calls["conns"].append(self.connection)
             n = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(n)
             calls["posts"].append(body)
@@ -54,12 +59,14 @@ def echo_server():
                 if calls["flaky"] <= 2:
                     self.send_response(429)
                     self.send_header("Retry-After", "0.01")
+                    self.send_header("Content-Length", "0")
                     self.end_headers()
                     return
             payload = json.loads(body or b"{}")
             out = json.dumps({"echo": payload}).encode()
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(out)))
             self.end_headers()
             self.wfile.write(out)
 
@@ -86,6 +93,135 @@ class TestClients:
         req = HTTPRequestData.from_json("http://127.0.0.1:1/none", {})
         resp = http_send(req, retries=2, backoff_ms=(1,))
         assert resp.status_code == 0 and not resp.ok
+
+    def test_pool_reuses_keep_alive_sockets(self, echo_server):
+        from mmlspark_tpu.io_http.clients import connection_pool_stats
+
+        url, _ = echo_server
+        before = connection_pool_stats()
+        for i in range(5):
+            assert http_send(
+                HTTPRequestData.from_json(url + "/ka", {"i": i})).ok
+        after = connection_pool_stats()
+        # first send may create; the rest must ride the pooled socket
+        assert after["reuses"] - before["reuses"] >= 4
+
+    def test_stale_pooled_socket_replays_once_transparently(
+            self, echo_server):
+        """A keep-alive socket the server closed while idle must cost a
+        transparent replay, not a status-0 (no breaker failure)."""
+        import urllib.parse
+
+        from mmlspark_tpu.io_http.clients import (_POOL,
+                                                  connection_pool_stats)
+        from mmlspark_tpu.resilience import CircuitBreaker
+
+        url, calls = echo_server
+        assert http_send(HTTPRequestData.from_json(url + "/s", {})).ok
+        p = urllib.parse.urlsplit(url)
+        with _POOL._lock:
+            idle = list(_POOL._idle.get(("http", p.hostname, p.port), []))
+        assert idle, "expected a pooled idle socket"
+        # sever SERVER-side: the pooled client socket stays open locally
+        # but is half-closed remotely — the genuinely-stale case.
+        # shutdown() forces the FIN out; close() alone defers while the
+        # handler's makefile() refs are live
+        for c in calls["conns"]:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            c.close()
+        calls["conns"].clear()
+        before = connection_pool_stats()
+        breaker = CircuitBreaker(name="stale-test")
+        resp = http_send(HTTPRequestData.from_json(url + "/s", {"x": 2}),
+                         breaker=breaker)
+        assert resp.ok and resp.json()["echo"] == {"x": 2}
+        after = connection_pool_stats()
+        assert after["stale_retries"] >= before["stale_retries"] + 1
+        assert breaker.state == "closed" and breaker.failure_rate() == 0.0
+
+    def test_status_zero_failover_over_reused_socket(self):
+        """The satellite regression: replica A serves keep-alive traffic
+        (its socket sits in the pool), then dies HARD. The pooled stale
+        socket must surface status 0 — TargetPool failover and breaker
+        accounting fire exactly as in the socket-per-request era."""
+        from http.server import ThreadingHTTPServer
+
+        from mmlspark_tpu.io_http.clients import TargetPool
+        from mmlspark_tpu.resilience import RetryPolicy
+
+        conns = {}   # server port -> live handler connections
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"   # keep-alive, so A's socket
+            # sits in the pool when A dies
+
+            def do_POST(self):
+                conns.setdefault(
+                    self.server.server_address[1], []).append(
+                        self.connection)
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                body = b'{"ok": true}'
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        servers = [ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+                   for _ in range(2)]
+        for s in servers:
+            threading.Thread(target=s.serve_forever, daemon=True).start()
+        url_a, url_b = (f"http://127.0.0.1:{s.server_address[1]}"
+                        for s in servers)
+        pool = TargetPool([url_a, url_b])
+        try:
+            # prime a keep-alive socket to BOTH replicas
+            for u in (url_a, url_b):
+                assert pool.send(HTTPRequestData.from_json(u, {}),
+                                 target=u).ok
+            servers[0].shutdown()
+            servers[0].server_close()     # A now refuses reconnects too
+            # kill A's established keep-alive conns: shutdown() only stops
+            # the listener, handler threads would keep serving the pooled
+            # socket and A would answer from beyond the grave
+            for c in conns.get(servers[0].server_address[1], []):
+                try:
+                    c.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                c.close()
+            failovers = []
+            resps = [pool.send(
+                HTTPRequestData.from_json("/", {"i": i}), timeout=2.0,
+                policy=RetryPolicy(max_retries=0, backoffs_ms=[1]),
+                on_failover=lambda u, r: failovers.append(
+                    (u, r.status_code)))
+                for i in range(4)]
+            assert all(r.status_code == 200 for r in resps)
+            # the dead replica answered status 0 (never a phantom reply
+            # off the stale socket) and the pool failed over
+            assert failovers
+            assert all(u == url_a and s == 0 for u, s in failovers)
+            # breaker accounting unchanged: A recorded real failures
+            assert pool.breaker_for(url_a).failure_rate() > 0.0
+            assert pool.breaker_for(url_b).state == "closed"
+            # lease accounting drained on both the failed and the
+            # successful attempt
+            assert pool.inflight(url_a) == 0 and pool.inflight(url_b) == 0
+        finally:
+            for s in servers:
+                try:
+                    s.shutdown()
+                    s.server_close()
+                except OSError:
+                    pass
 
 
 class TestTransformers:

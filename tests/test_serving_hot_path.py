@@ -13,6 +13,7 @@ trip per resident request.
 
 import json
 import threading
+import urllib.error
 import urllib.request
 
 import numpy as np
@@ -271,3 +272,72 @@ class TestGatewaySwap:
                     srv.stop()
                 except Exception:  # noqa: BLE001 — already stopped
                     pass
+
+
+class TestBinaryWireServing:
+    """Content-negotiated binary protocol on the scoring routes: a framed
+    request scores to a framed reply, JSON clients keep byte-identical
+    replies, and a malformed frame degrades to an HTTP error without
+    dropping the connection."""
+
+    def _post_binary(self, srv, row, timeout=30):
+        from mmlspark_tpu.io_http import wire
+
+        req = urllib.request.Request(
+            srv.url, data=wire.encode_features_request(row),
+            headers={"Content-Type": wire.WIRE_CONTENT_TYPE,
+                     "Accept": wire.WIRE_CONTENT_TYPE})
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.headers.get("Content-Type"), r.read()
+
+    def test_binary_request_scores_to_binary_reply(self, hot_server):
+        from mmlspark_tpu.io_http import wire
+
+        srv = hot_server
+        row = np.asarray([_payload(5)[c] for c in COLS])
+        ct, entity = self._post_binary(srv, row)
+        assert wire.is_wire_content_type(ct)
+        col, vals = wire.decode_reply(entity)
+        assert col == "prediction" and vals.shape[0] == 1
+        # the framed value is BIT-identical to what the JSON path says
+        json_val = json.loads(_post_raw(srv.url, _payload(5)))["prediction"]
+        assert float(np.asarray(vals).ravel()[0]) == json_val
+
+    def test_json_replies_byte_identical_around_binary_traffic(
+            self, hot_server):
+        srv = hot_server
+        before = [_post_raw(srv.url, _payload(i)) for i in range(5)]
+        for i in range(5):
+            row = np.asarray([_payload(i)[c] for c in COLS])
+            self._post_binary(srv, row)
+        after = [_post_raw(srv.url, _payload(i)) for i in range(5)]
+        assert before == after  # JSON clients never see the upgrade
+
+    def test_protocol_mix_counted(self, hot_server):
+        srv = hot_server
+        base = dict(srv.protocol_counts())
+        hits0 = srv.hot_path.decoder.binary_hits
+        row = np.asarray([_payload(2)[c] for c in COLS])
+        for _ in range(3):
+            self._post_binary(srv, row)
+        _post_raw(srv.url, _payload(2))
+        counts = srv.protocol_counts()
+        assert counts["binary"] >= base.get("binary", 0) + 3
+        assert counts["json"] >= base.get("json", 0) + 1
+        assert srv.hot_path.decoder.binary_hits >= hits0 + 3
+
+    def test_bad_frame_is_an_http_error_not_a_dropped_socket(
+            self, hot_server):
+        from mmlspark_tpu.io_http import wire
+
+        srv = hot_server
+        req = urllib.request.Request(
+            srv.url, data=b"MSWRgarbage-not-a-frame",
+            headers={"Content-Type": wire.WIRE_CONTENT_TYPE})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code >= 400
+        # the server (and schema cache) survive: a JSON request right
+        # after scores normally
+        out = json.loads(_post_raw(srv.url, _payload(4)))
+        assert "prediction" in out

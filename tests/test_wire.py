@@ -1,0 +1,176 @@
+"""Zero-copy binary wire codec (io_http/wire.py): frame round trips,
+JSON-columnar fallback for non-numeric columns, version/shape rejection,
+the scoring request/reply helpers, and HTTP content negotiation — the
+protocol contract both the serving hot path and the streaming fleet
+workers ride."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.io_http import wire
+from mmlspark_tpu.io_http.wire import (
+    WIRE_CONTENT_TYPE,
+    WireError,
+    accepts_wire,
+    content_type_of,
+    decode_features_request,
+    decode_message,
+    decode_reply,
+    encode_features_request,
+    encode_message,
+    encode_reply,
+    is_wire_content_type,
+)
+
+
+class TestFrameRoundTrip:
+    def test_every_numeric_dtype_round_trips_byte_identical(self):
+        rng = np.random.default_rng(0)
+        cols = {}
+        for name in ("float64", "float32", "int64", "int32", "int16",
+                     "int8", "uint64", "uint32", "uint16", "uint8"):
+            cols[name] = (rng.normal(size=7) * 100).astype(name)
+        cols["bool"] = rng.normal(size=7) > 0
+        meta, out = decode_message(
+            encode_message({"k": "v"}, cols, n_rows=7))
+        assert meta["k"] == "v"
+        assert set(out) == set(cols)
+        for name, col in cols.items():
+            assert out[name].dtype == col.dtype, name
+            assert out[name].tobytes() == col.tobytes(), name
+
+    def test_2d_column_keeps_shape_and_row_count(self):
+        feats = np.arange(12, dtype=np.float64).reshape(3, 4)
+        buf = encode_message({}, {"features": feats})
+        meta, out = decode_message(buf)
+        assert out["features"].shape == (3, 4)
+        np.testing.assert_array_equal(out["features"], feats)
+
+    def test_decoded_columns_are_zero_copy_readonly_views(self):
+        buf = encode_message({}, {"a": np.arange(5, dtype=np.int64)})
+        _, out = decode_message(buf)
+        assert not out["a"].flags.writeable  # frombuffer view, not a copy
+        with pytest.raises((ValueError, RuntimeError)):
+            out["a"][0] = 9
+
+    def test_non_numeric_columns_ride_json_columns(self):
+        cols = {"x": np.asarray([1.0, 2.0]),
+                "label": np.asarray(["a", "b"]),
+                "tags": [["t1"], ["t2", "t3"]]}
+        buf = encode_message({"n": 1}, cols, n_rows=2)
+        meta, out = decode_message(buf)
+        assert out["x"].dtype == np.float64
+        assert list(out["label"]) == ["a", "b"]
+        assert out["tags"] == [["t1"], ["t2", "t3"]]
+        # the fallback is visible in meta, so any JSON-capable peer can
+        # decode the same table
+        assert set(meta["json_columns"]) == {"label", "tags"}
+
+    def test_big_endian_host_array_lands_little_endian(self):
+        be = np.arange(4, dtype=">f8")
+        _, out = decode_message(encode_message({}, {"a": be}))
+        assert out["a"].dtype == np.dtype("<f8")
+        np.testing.assert_array_equal(out["a"], be.astype("<f8"))
+
+
+class TestFrameRejection:
+    def test_bad_magic(self):
+        buf = bytearray(encode_message({}, {"a": np.zeros(2)}))
+        buf[:4] = b"NOPE"
+        with pytest.raises(WireError, match="magic"):
+            decode_message(bytes(buf))
+
+    def test_unknown_version(self):
+        buf = bytearray(encode_message({}, {"a": np.zeros(2)}))
+        buf[4] = wire.WIRE_VERSION + 1
+        with pytest.raises(WireError, match="version"):
+            decode_message(bytes(buf))
+
+    def test_short_frame(self):
+        with pytest.raises(WireError, match="short"):
+            decode_message(b"MSWR")
+
+    def test_truncated_payload(self):
+        buf = encode_message({}, {"a": np.arange(16, dtype=np.float64)})
+        with pytest.raises(WireError):
+            decode_message(buf[:-8])
+
+    def test_row_count_mismatch(self):
+        # frame header says 3 rows, the column carries 2
+        buf = encode_message({}, {"a": np.zeros(2)}, n_rows=3)
+        with pytest.raises(WireError, match="dim 0"):
+            decode_message(buf)
+
+    def test_corrupt_meta_blob(self):
+        buf = bytearray(encode_message({"k": 1}, {}))
+        buf[wire._HEADER.size] = ord("x")  # break the JSON
+        with pytest.raises(WireError, match="meta"):
+            decode_message(bytes(buf))
+
+    def test_unknown_dtype_tag(self):
+        buf = bytearray(encode_message({}, {"ab": np.zeros(2)}))
+        # tag byte sits right after the 2-byte name length + name
+        off = wire._HEADER.size + len(b"{}") + 2 + 2
+        (name_len,) = struct.unpack_from("<H", buf, off - 4)
+        assert name_len == 2
+        buf[off] = 200
+        with pytest.raises(WireError, match="dtype tag"):
+            decode_message(bytes(buf))
+
+
+class TestScoringHelpers:
+    def test_features_request_round_trip(self):
+        row = np.asarray([1.5, -2.25, 3.0])
+        out = decode_features_request(encode_features_request(row), 3)
+        assert out.shape == (1, 3) and out.dtype == np.float64
+        np.testing.assert_array_equal(out[0], row)
+
+    def test_features_request_batch_shape(self):
+        x = np.arange(8, dtype=np.float64).reshape(2, 4)
+        out = decode_features_request(encode_features_request(x), 4)
+        np.testing.assert_array_equal(out, x)
+
+    def test_features_request_wrong_width_rejected(self):
+        buf = encode_features_request(np.zeros(3))
+        with pytest.raises(WireError, match="shape"):
+            decode_features_request(buf, 5)
+
+    def test_features_request_missing_column_rejected(self):
+        buf = encode_message({}, {"not_features": np.zeros((1, 3))})
+        with pytest.raises(WireError, match="features"):
+            decode_features_request(buf, 3)
+
+    def test_reply_round_trip_scalar_and_vector(self):
+        col, vals = decode_reply(encode_reply("prediction", 2.5))
+        assert col == "prediction"
+        np.testing.assert_array_equal(vals, [2.5])
+        col, vals = decode_reply(encode_reply("scores", [0.1, 0.9]))
+        assert col == "scores" and vals.shape == (1, 2)
+
+    def test_reply_missing_value_column_rejected(self):
+        with pytest.raises(WireError, match="value column"):
+            decode_reply(encode_message({}, {"x": np.zeros(1)}))
+
+
+class TestContentNegotiation:
+    def test_is_wire_content_type(self):
+        assert is_wire_content_type(WIRE_CONTENT_TYPE)
+        assert is_wire_content_type(
+            WIRE_CONTENT_TYPE.upper() + "; charset=binary")
+        assert not is_wire_content_type("application/json")
+        assert not is_wire_content_type(None)
+
+    def test_accepts_wire_scans_accept_list(self):
+        assert accepts_wire(
+            {"Accept": f"application/json, {WIRE_CONTENT_TYPE}"})
+        assert accepts_wire({"accept": WIRE_CONTENT_TYPE})
+        assert not accepts_wire({"Accept": "application/json"})
+        assert not accepts_wire({})
+        assert not accepts_wire(None)
+
+    def test_content_type_of_is_case_insensitive(self):
+        assert content_type_of({"content-type": "a/b"}) == "a/b"
+        assert content_type_of({"Content-Type": "a/b"}) == "a/b"
+        assert content_type_of({}) is None

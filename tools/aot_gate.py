@@ -179,6 +179,13 @@ def _resident_executor(n_data=0, donate=True):
 def serving_resident_build(n, n_data=0, donate=True):
     """The serving hot path's resident executable at ONE bucket rung.
 
+    Since the fused decode->bin->traverse rewrite this program is ONE
+    jitted body from the raw f32 feature matrix to scores: vmapped
+    `searchsorted` against device-pinned adjusted bin keys, then the
+    fixed-depth gather walk over the SoA node arrays — no separate
+    binning dispatch exists anymore, so this gate IS the compile
+    evidence for the fused kernel across (bucket x mesh x donation).
+
     io_http/serving.py routes live request batches straight onto these
     programs (params pinned on device, one upload per batch), and its
     warmup refuses to flip /readyz until the full ladder is compiled —

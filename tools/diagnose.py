@@ -224,8 +224,35 @@ def diagnose_urls(urls: list[str]) -> str:
 
 
 def diagnose_gateway(url: str) -> str:
-    """Routing table + autoscaler state from a running ServingGateway."""
+    """Routing table + autoscaler state from a running ServingGateway —
+    or, pointed at a GatewayTier control endpoint, the worker tier table
+    (shared port, per-worker pid/traffic/journal shard)."""
     url = url.rstrip("/")
+    try:
+        tier = json.loads(_fetch(url + "/workers"))
+    except Exception:  # noqa: BLE001 — not a tier control endpoint
+        tier = None
+    if isinstance(tier, dict) and tier.get("tier"):
+        out = [
+            f"gateway tier: {tier.get('host')}:{tier.get('port')} "
+            f"workers={tier.get('n_workers')} "
+            f"members={len(tier.get('members') or [])}"
+        ]
+        rows = []
+        for w in tier.get("workers", []):
+            st = w.get("stats") or {}
+            rows.append([
+                st.get("worker") or f"w{w.get('index')}",
+                "y" if w.get("alive") else "n",
+                str(w.get("pid") or "-"),
+                _fmt(st.get("requests", 0)),
+                _fmt(st.get("n_live", 0)),
+                w.get("journal_shard") or "-",
+            ])
+        out.append(_render_table(
+            rows, ["worker", "alive", "pid", "requests", "live",
+                   "journal_shard"]))
+        return "\n".join(out)
     routes = json.loads(_fetch(url + "/routes"))
     out = [
         f"gateway: strategy={routes['strategy']} "
@@ -292,6 +319,12 @@ def diagnose_serving(url: str) -> str:
         f"misses={_fmt(info.get('executable_cache_misses', 0))} "
         f"recompiles={_fmt(info.get('executable_cache_recompiles', 0))}",
     ]
+    prot = info.get("protocols") or {}
+    if prot:
+        total = sum(prot.values()) or 1
+        out.append("protocol mix: " + " ".join(
+            f"{k}={_fmt(v)} ({100.0 * v / total:.1f}%)"
+            for k, v in sorted(prot.items())))
     hp = info.get("hot_path")
     if not hp:
         out.append("hot path: none (handler-only server)")
@@ -316,6 +349,15 @@ def diagnose_serving(url: str) -> str:
             rows, ["bucket", "route", "native_ms", "resident_ms"]))
     else:
         out.append("(no crossover measured — server not warmed?)")
+    by_route: dict = {}
+    for t in timings.values():
+        for route, ms in t.items():
+            if isinstance(ms, (int, float)):
+                by_route.setdefault(route, []).append(float(ms))
+    if by_route:
+        out.append("per-path rtt_ms: " + " ".join(
+            f"{r}={_fmt(sum(v) / len(v), 3)}"
+            for r, v in sorted(by_route.items())))
     paths = hp.get("paths") or {}
     out.append("paths: " + " ".join(
         f"{k}={_fmt(v)}" for k, v in sorted(paths.items())))
@@ -326,7 +368,8 @@ def diagnose_serving(url: str) -> str:
         f"{_fmt(hp.get('round_trips_per_resident_request', 0), 3)}")
     dec = hp.get("decoder") or {}
     out.append(f"decoder: hits={_fmt(dec.get('hits', 0))} "
-               f"fallbacks={_fmt(dec.get('fallbacks', 0))}")
+               f"fallbacks={_fmt(dec.get('fallbacks', 0))} "
+               f"binary={_fmt(dec.get('binary_hits', 0))}")
     return "\n".join(out)
 
 

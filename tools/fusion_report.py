@@ -94,13 +94,21 @@ def main() -> int:
         if depth is None:
             depth = fm.get("readback_lag")
         print(f"== {title} ==")
-        print(plan.describe(mesh=mesh, donate=fm.get("donate_buffers"),
-                            pipeline_depth=depth))
+        desc = plan.describe(mesh=mesh, donate=fm.get("donate_buffers"),
+                             pipeline_depth=depth)
+        print(desc)
         print(f"   transfers/batch: fused={fused_t} staged={staged_t}")
         if plan.fusion_ratio < expected_ratio:
             failures.append(
                 f"{title}: fusion ratio {plan.fusion_ratio:.2f} < "
                 f"expected {expected_ratio:.2f}")
+        # the GBDT segment must advertise the fused decode->bin->traverse
+        # kernel — losing the label means the model kernel regressed to
+        # an unlabeled (two-dispatch era) program
+        if "gbdt" in title and "kernel=fused_traverse" not in desc:
+            failures.append(
+                f"{title}: describe() lacks kernel=fused_traverse — "
+                "GBDT segment lost the fused inference kernel label")
         print()
     if failures:
         print("FUSION REPORT FAILURES:")
